@@ -1,0 +1,33 @@
+"""parallel_convolution_tpu — a TPU-native iterative 2D stencil framework.
+
+A ground-up re-design of the capabilities of ``jimouris/parallel-convolution``
+(C + MPI + OpenMP iterative image convolution) for TPU hardware:
+
+* the ``MPI_Cart_create`` R×C process grid  → a 2D :class:`jax.sharding.Mesh`
+* ``MPI_Isend/Irecv`` ghost-row/column halo → :func:`jax.lax.ppermute`
+  (XLA ``collective-permute`` over ICI)
+* the OpenMP per-tile convolution loop      → a Pallas 2D stencil kernel
+* ``MPI_Allreduce`` convergence check       → :func:`jax.lax.psum`
+
+See ``SURVEY.md`` at the repo root for the structural map of the reference
+(component inventory C1–C13) and how each maps onto this package.
+
+Layout
+------
+``ops/``       filters (C3), NumPy oracle (C1/C2), lax reference conv, Pallas
+               stencil kernels (C2).
+``parallel/``  mesh topology (C4), ppermute halo exchange (C5), the jitted
+               iteration step with double buffering + convergence (C6/C8).
+``models/``    end-to-end pipelines: the flagship distributed ConvolutionModel
+               and the Jacobi run-to-convergence solver.
+``utils/``     raw image I/O (C7), benchmark timers (C10), tracing, config.
+``cli.py``     command-line entrypoint mirroring the reference's argv
+               vocabulary (C12).
+"""
+
+from parallel_convolution_tpu.ops.filters import Filter, get_filter, FILTERS
+from parallel_convolution_tpu.ops import oracle
+
+__version__ = "0.1.0"
+
+__all__ = ["Filter", "get_filter", "FILTERS", "oracle", "__version__"]

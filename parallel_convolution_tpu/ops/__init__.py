@@ -1,0 +1,1 @@
+"""Compute layer: filter definitions, serial oracle, lax + Pallas kernels."""

@@ -1,0 +1,1 @@
+"""Runtime-support layer: raw image I/O, benchmarking, tracing, config."""

@@ -1,0 +1,43 @@
+"""Test environment: hermetic multi-device CPU JAX.
+
+The reference needed a real MPI cluster to exercise >1 rank; this framework's
+tests instead force 8 virtual CPU devices (SURVEY.md §4), so halo exchange,
+corner propagation, and convergence psum are all testable on any machine.
+These env vars must be set before jax initializes a backend, hence here at
+conftest import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+from parallel_convolution_tpu.utils import imageio
+
+
+@pytest.fixture(scope="session")
+def grey_small():
+    return imageio.generate_test_image(24, 36, "grey", seed=1)
+
+
+@pytest.fixture(scope="session")
+def rgb_small():
+    return imageio.generate_test_image(24, 36, "rgb", seed=2)
+
+
+@pytest.fixture(scope="session")
+def grey_odd():
+    # Deliberately awkward dims: prime-ish, non-divisible by mesh shapes.
+    return imageio.generate_test_image(37, 53, "grey", seed=3)
+
+
+@pytest.fixture(scope="session")
+def rgb_odd():
+    return imageio.generate_test_image(41, 29, "rgb", seed=4)

@@ -1,0 +1,50 @@
+import numpy as np
+import pytest
+
+from parallel_convolution_tpu.ops import filters
+
+
+def test_registry_contents():
+    for name in ["blur3", "box3", "gaussian5", "edge3", "edge5", "sharpen3",
+                 "identity3", "jacobi3"]:
+        f = filters.get_filter(name)
+        assert f.name == name
+        assert f.taps.dtype == np.float32
+        assert f.size in (3, 5)
+        assert f.radius == f.size // 2
+
+
+def test_blur3_is_reference_kernel():
+    f = filters.get_filter("blur3")
+    expected = np.array([[1, 2, 1], [2, 4, 2], [1, 2, 1]], np.float32) / 16.0
+    np.testing.assert_array_equal(f.taps, expected)
+    assert abs(float(f.taps.sum()) - 1.0) < 1e-7
+
+
+def test_normalized_filters_sum_to_one():
+    for name in ["blur3", "box3", "gaussian5", "jacobi3"]:
+        assert abs(float(filters.get_filter(name).taps.sum()) - 1.0) < 1e-6
+
+
+def test_unknown_filter_raises():
+    with pytest.raises(KeyError, match="unknown filter"):
+        filters.get_filter("nope")
+
+
+def test_even_size_rejected():
+    with pytest.raises(ValueError):
+        filters.make_filter("bad", np.ones((4, 4)))
+
+
+def test_gaussian_builder():
+    g = filters.gaussian(7, 1.5)
+    assert g.size == 7 and g.radius == 3
+    assert abs(float(g.taps.sum()) - 1.0) < 1e-6
+    # symmetric
+    np.testing.assert_allclose(g.taps, g.taps[::-1, ::-1])
+
+
+def test_custom_filter_any_odd_size():
+    f = filters.make_filter("box7", np.ones((7, 7)), divisor=49)
+    assert f.size == 7
+    assert abs(float(f.taps.sum()) - 1.0) < 1e-6

@@ -1,0 +1,65 @@
+import numpy as np
+import pytest
+
+from parallel_convolution_tpu.utils import imageio
+
+
+@pytest.mark.parametrize("mode,shape", [("grey", (10, 14)), ("rgb", (10, 14, 3))])
+def test_roundtrip(tmp_path, mode, shape):
+    img = imageio.generate_test_image(10, 14, mode, seed=7)
+    assert img.shape == shape
+    p = tmp_path / "img.raw"
+    imageio.write_raw(p, img)
+    assert p.stat().st_size == img.size
+    back = imageio.read_raw(p, 10, 14, mode)
+    np.testing.assert_array_equal(back, img)
+
+
+def test_size_mismatch_raises(tmp_path):
+    p = tmp_path / "img.raw"
+    p.write_bytes(b"\x00" * 99)
+    with pytest.raises(ValueError, match="expected"):
+        imageio.read_raw(p, 10, 10, "grey")
+
+
+def test_bad_mode():
+    with pytest.raises(ValueError, match="grey"):
+        imageio.image_shape(4, 4, "cmyk")
+
+
+@pytest.mark.parametrize("mode", ["grey", "rgb"])
+def test_block_io_matches_whole(tmp_path, mode):
+    img = imageio.generate_test_image(16, 24, mode, seed=8)
+    p = tmp_path / "img.raw"
+    imageio.write_raw(p, img)
+    blk = imageio.read_block(p, 16, 24, mode, 4, 12, 6, 18)
+    np.testing.assert_array_equal(blk, img[4:12, 6:18])
+
+    # scatter-write the image block-wise into a fresh file, reassemble
+    q = tmp_path / "out.raw"
+    imageio.allocate_raw(q, 16, 24, mode)
+    for bi in range(2):
+        for bj in range(3):
+            r0, r1 = imageio.block_bounds(16, 2, bi)
+            c0, c1 = imageio.block_bounds(24, 3, bj)
+            imageio.write_block(q, 16, 24, mode, r0, c0, img[r0:r1, c0:c1])
+    np.testing.assert_array_equal(imageio.read_raw(q, 16, 24, mode), img)
+
+
+def test_block_bounds_non_divisible():
+    # 10 split 3 ways -> 4,3,3 ; covers the non-divisible-dims requirement
+    bounds = [imageio.block_bounds(10, 3, i) for i in range(3)]
+    assert bounds == [(0, 4), (4, 7), (7, 10)]
+    with pytest.raises(IndexError):
+        imageio.block_bounds(10, 3, 3)
+
+
+def test_planar_roundtrip():
+    img = imageio.generate_test_image(6, 8, "rgb", seed=9)
+    pl = imageio.interleaved_to_planar(img)
+    assert pl.shape == (3, 6, 8)
+    np.testing.assert_array_equal(imageio.planar_to_interleaved(pl), img)
+    g = imageio.generate_test_image(6, 8, "grey", seed=9)
+    gp = imageio.interleaved_to_planar(g)
+    assert gp.shape == (1, 6, 8)
+    np.testing.assert_array_equal(imageio.planar_to_interleaved(gp), g)
