@@ -1,0 +1,123 @@
+"""Single-device JAX convolution paths (reference components C1/C2/C8).
+
+Two implementations of one zero-padded cross-correlation step:
+
+* :func:`correlate_shifted` — the **normative** fixed-order shifted
+  multiply-add (same op sequence as the NumPy oracle, see ops/oracle.py), so
+  float32 results are bit-identical to the oracle on every XLA backend.  This
+  is also the decomposition the Pallas kernel uses, and what the sharded path
+  applies per block.
+* :func:`correlate_xla_conv` — ``lax.conv_general_dilated`` (XLA's native
+  convolution, MXU-eligible); used for cross-checking and benchmarking
+  against the Pallas kernel.
+
+Internal layout is **planar float32** ``(C, H, W)``: TPU wants the large
+spatial dims trailing (lane dim = W), not the 3-wide interleaved channel axis
+of the raw file format.  ``utils/imageio`` converts at the boundary.
+Grayscale is ``C == 1``.
+
+The iteration drivers mirror the reference's hot loop (SURVEY.md §3.1):
+``for t in loops: convolute; swap(src, dst)`` becomes a ``lax.fori_loop``
+whose functional carry *is* the double buffer (C8) — with buffer donation at
+the jit boundary XLA reuses the storage just like the pointer swap did.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from parallel_convolution_tpu.ops.filters import Filter
+
+
+def pad_zero(x: jnp.ndarray, r: int) -> jnp.ndarray:
+    """Zero ghost ring of width ``r`` around the spatial dims of (C, H, W)."""
+    return jnp.pad(x, ((0, 0), (r, r), (r, r)))
+
+
+def correlate_padded(padded: jnp.ndarray, filt: Filter) -> jnp.ndarray:
+    """Normative correlation over an already-padded (C, H+2r, W+2r) block.
+
+    Fixed row-major tap order; one multiply-add per tap in float32.  Exposed
+    separately because the sharded path pads via halo exchange, not zeros.
+    """
+    k = filt.size
+    C, Hp, Wp = padded.shape
+    H, W = Hp - 2 * filt.radius, Wp - 2 * filt.radius
+    taps = [float(t) for t in filt.taps.reshape(-1)]
+    acc = jnp.zeros((C, H, W), jnp.float32)
+    i = 0
+    for dy in range(k):
+        for dx in range(k):
+            acc = acc + jnp.float32(taps[i]) * padded[:, dy : dy + H, dx : dx + W]
+            i += 1
+    return acc
+
+
+def correlate_shifted(x: jnp.ndarray, filt: Filter) -> jnp.ndarray:
+    """One zero-padded correlation step on (C, H, W) float32."""
+    return correlate_padded(pad_zero(x, filt.radius), filt)
+
+
+def correlate_xla_conv(x: jnp.ndarray, filt: Filter) -> jnp.ndarray:
+    """Same step via XLA's native conv (cross-check / benchmark path).
+
+    Channels are independent (the reference's per-channel RGB loop), so C is
+    the conv batch dim with a single feature channel.
+    """
+    r = filt.radius
+    lhs = x[:, None, :, :].astype(jnp.float32)  # (C, 1, H, W)
+    rhs = jnp.asarray(filt.taps, jnp.float32)[None, None, :, :]  # (1, 1, k, k)
+    out = jax.lax.conv_general_dilated(
+        lhs, rhs, window_strides=(1, 1), padding=[(r, r), (r, r)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    return out[:, 0, :, :]
+
+
+def quantize_f32(acc: jnp.ndarray) -> jnp.ndarray:
+    """uint8 store-back semantics, kept in f32: clip(rint(acc), 0, 255).
+
+    The values are exact small integers in float32, so carrying f32 across
+    iterations is bit-identical to the reference's ``unsigned char`` buffers
+    while avoiding per-iteration dtype churn on the VPU.
+    """
+    return jnp.clip(jnp.rint(acc), 0.0, 255.0)
+
+
+def _step_u8(x: jnp.ndarray, filt: Filter, correlate) -> jnp.ndarray:
+    return quantize_f32(correlate(x, filt))
+
+
+@partial(jax.jit, static_argnames=("filt", "iters", "use_xla_conv"),
+         donate_argnums=0)
+def iterate_u8(x: jnp.ndarray, filt: Filter, iters: int,
+               use_xla_conv: bool = False) -> jnp.ndarray:
+    """``iters`` u8-semantics iterations on planar f32 (C, H, W).
+
+    The fori_loop carry is the double buffer (C8); ``donate_argnums=0`` lets
+    XLA alias input and output storage (the reference's pointer swap).
+    """
+    correlate = correlate_xla_conv if use_xla_conv else correlate_shifted
+    body = lambda _, v: _step_u8(v, filt, correlate)
+    return jax.lax.fori_loop(0, iters, body, x)
+
+
+@partial(jax.jit, static_argnames=("filt", "iters", "use_xla_conv"),
+         donate_argnums=0)
+def iterate_f32(x: jnp.ndarray, filt: Filter, iters: int,
+                use_xla_conv: bool = False) -> jnp.ndarray:
+    """``iters`` float-mode iterations (Jacobi smoothing — no quantization)."""
+    correlate = correlate_xla_conv if use_xla_conv else correlate_shifted
+    body = lambda _, v: correlate(v, filt)
+    return jax.lax.fori_loop(0, iters, body, x)
+
+
+def run_u8(img_u8_planar, filt: Filter, iters: int):
+    """Convenience: uint8 planar in → uint8 planar out, single device."""
+    x = jnp.asarray(img_u8_planar, jnp.float32)
+    out = iterate_u8(x, filt, iters)
+    return jnp.asarray(out, jnp.uint8)

@@ -18,7 +18,7 @@ import dataclasses
 import numpy as np
 
 
-@dataclasses.dataclass(frozen=True)
+@dataclasses.dataclass(frozen=True, eq=False)
 class Filter:
     """An odd-sized square stencil filter.
 
@@ -41,6 +41,18 @@ class Filter:
         if t.ndim != 2 or t.shape[0] != t.shape[1] or t.shape[0] % 2 == 0:
             raise ValueError(f"filter taps must be odd square, got {t.shape}")
         object.__setattr__(self, "taps", t)
+
+    # Hashable/comparable by value so a Filter can be a static jit argument.
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, Filter)
+            and self.name == other.name
+            and self.taps.shape == other.taps.shape
+            and bool(np.all(self.taps == other.taps))
+        )
+
+    def __hash__(self) -> int:
+        return hash((self.name, self.taps.shape, self.taps.tobytes()))
 
     @property
     def size(self) -> int:

@@ -1,0 +1,76 @@
+"""Single-device JAX paths vs the NumPy oracle (bit-exact golden tests)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from parallel_convolution_tpu.ops import conv, filters, oracle
+from parallel_convolution_tpu.utils import imageio
+
+
+def _planar_f32(img_u8):
+    return jnp.asarray(imageio.interleaved_to_planar(img_u8), jnp.float32)
+
+
+def _run_oracle(img_u8, filt, iters):
+    return oracle.run_serial_u8(img_u8, filt, iters)
+
+
+@pytest.mark.parametrize("name", ["blur3", "gaussian5", "edge3", "edge5",
+                                  "sharpen3", "box3"])
+@pytest.mark.parametrize("fixture", ["grey_small", "rgb_small"])
+def test_shifted_bitexact_vs_oracle(request, fixture, name):
+    img = request.getfixturevalue(fixture)
+    filt = filters.get_filter(name)
+    want = _run_oracle(img, filt, 3)
+    got = np.asarray(conv.run_u8(imageio.interleaved_to_planar(img), filt, 3))
+    np.testing.assert_array_equal(imageio.planar_to_interleaved(got), want)
+
+
+@pytest.mark.parametrize("fixture", ["grey_odd", "rgb_odd"])
+def test_odd_shapes_bitexact(request, fixture):
+    img = request.getfixturevalue(fixture)
+    filt = filters.get_filter("blur3")
+    want = _run_oracle(img, filt, 7)
+    got = np.asarray(conv.run_u8(imageio.interleaved_to_planar(img), filt, 7))
+    np.testing.assert_array_equal(imageio.planar_to_interleaved(got), want)
+
+
+def test_zero_iters_is_identity(grey_small):
+    filt = filters.get_filter("blur3")
+    got = np.asarray(conv.run_u8(imageio.interleaved_to_planar(grey_small), filt, 0))
+    np.testing.assert_array_equal(got[0], grey_small)
+
+
+def test_xla_conv_path_matches_oracle_quantized(grey_small):
+    # conv_general_dilated may reassociate, but for the dyadic blur3 the
+    # accumulation is exact, so even 100 quantized iterations stay identical.
+    filt = filters.get_filter("blur3")
+    want = _run_oracle(grey_small, filt, 100)
+    x = _planar_f32(grey_small)
+    got = np.asarray(conv.iterate_u8(x, filt, 100, use_xla_conv=True))
+    np.testing.assert_array_equal(got[0].astype(np.uint8), want)
+
+
+def test_xla_conv_close_to_shifted_nondyadic(rgb_small):
+    filt = filters.get_filter("box3")  # 1/9 taps: non-dyadic
+    x = _planar_f32(rgb_small)
+    a = np.asarray(conv.correlate_shifted(x, filt))
+    b = np.asarray(conv.correlate_xla_conv(x, filt))
+    np.testing.assert_allclose(a, b, atol=1e-3)
+
+
+def test_f32_mode_no_quantization(grey_small):
+    filt = filters.get_filter("jacobi3")
+    x = _planar_f32(grey_small)
+    got = np.asarray(conv.iterate_f32(x, filt, 5))
+    want = oracle.run_serial_f32(grey_small.astype(np.float32), filt, 5)
+    np.testing.assert_array_equal(got[0], want)
+
+
+def test_100_iteration_golden_grey(grey_small):
+    # The reference's canonical workload is 100 iterations (BASELINE).
+    filt = filters.get_filter("blur3")
+    want = _run_oracle(grey_small, filt, 100)
+    got = np.asarray(conv.run_u8(imageio.interleaved_to_planar(grey_small), filt, 100))
+    np.testing.assert_array_equal(imageio.planar_to_interleaved(got), want)
