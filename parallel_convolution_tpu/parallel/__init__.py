@@ -1,0 +1,8 @@
+"""Distributed layer: mesh topology, halo exchange, sharded iteration.
+
+The reference fuses communication and compute inside one per-iteration MPI
+loop (SURVEY.md §1); here they are separate composable pieces — ``mesh.py``
+(topology ≙ MPI_Cart_create), ``halo.py`` (ghost exchange ≙ MPI_Isend/Irecv),
+``step.py`` (iteration + convergence ≙ the main loop + MPI_Allreduce) — and
+XLA fuses them back together at compile time.
+"""

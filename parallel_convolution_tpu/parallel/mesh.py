@@ -1,0 +1,83 @@
+"""2D device-grid topology (reference component C4, SURVEY.md §2).
+
+The reference maps P MPI ranks onto an R×C Cartesian grid with
+``MPI_Dims_create`` + ``MPI_Cart_create`` and derives each rank's block and
+neighbors.  On TPU the topology object is :class:`jax.sharding.Mesh`: XLA
+knows the physical ICI graph, neighbor discovery is implicit in
+``lax.ppermute`` index pairs, and block offsets fall out of the sharding.
+
+Axis convention used across the package: mesh axes ``('x', 'y')`` shard the
+planar image ``(C, H, W)`` as ``P(None, 'x', 'y')`` — 'x' splits rows (H),
+'y' splits columns (W).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("x", "y")
+
+
+def dims_create(n: int) -> tuple[int, int]:
+    """Near-square factorization of ``n`` — the MPI_Dims_create contract.
+
+    Returns (R, C) with R*C == n and R <= C, R as close to sqrt(n) as the
+    factorization allows.
+    """
+    if n < 1:
+        raise ValueError("need at least one device")
+    r = int(math.isqrt(n))
+    while n % r:
+        r -= 1
+    return r, n // r
+
+
+def make_grid_mesh(
+    devices=None, shape: tuple[int, int] | None = None
+) -> Mesh:
+    """Build the 2D ('x', 'y') mesh — the MPI_Cart_create equivalent.
+
+    ``shape`` defaults to :func:`dims_create` over all available devices.
+    Device order follows ``jax.devices()`` reshaped row-major, which on real
+    TPU slices keeps mesh neighbors ICI neighbors for the common topologies
+    (use ``jax.experimental.mesh_utils`` for exotic slices).
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if shape is None:
+        shape = dims_create(len(devices))
+    r, c = shape
+    if r * c != len(devices):
+        raise ValueError(f"mesh shape {shape} != {len(devices)} devices")
+    arr = np.empty((r, c), dtype=object)
+    for i, d in enumerate(devices):
+        arr[i // c, i % c] = d
+    return Mesh(arr, AXES)
+
+
+def block_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding of a planar (C, H, W) image over the grid: P(None, 'x', 'y')."""
+    return NamedSharding(mesh, P(None, *AXES))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def grid_shape(mesh: Mesh) -> tuple[int, int]:
+    return mesh.shape[AXES[0]], mesh.shape[AXES[1]]
+
+
+def padded_extent(total: int, parts: int) -> int:
+    """Smallest multiple of ``parts`` ≥ ``total``.
+
+    shard_map needs equal per-device blocks; the reference simply required
+    divisible dimensions, this framework pads and masks instead
+    (SURVEY.md §7 hard parts: 2520 does not divide by every mesh shape).
+    """
+    return -(-total // parts) * parts

@@ -1,0 +1,199 @@
+"""Sharded iteration driver: the reference's main loop, SPMD-compiled.
+
+Reference components C6 + C8 (SURVEY.md §2) and the hot loop of §3.2:
+
+    for t in loops:
+        exchange halos (Isend/Irecv + Waitall)
+        convolute(block)
+        swap(src, dst)
+        every N iters: local diff → MPI_Allreduce → maybe break
+
+becomes one ``jax.jit``-compiled ``shard_map`` over the ('x','y') mesh whose
+body runs the whole iteration loop on-device: ``lax.fori_loop`` (fixed
+iteration count) or ``lax.while_loop`` (run-to-convergence, the
+``MPI_Allreduce`` becoming ``lax.pmax`` of the per-block max-abs diff).
+The functional loop carry is the double buffer; donated input storage gives
+XLA the reference's pointer swap for free.
+
+Non-divisible images (e.g. 2520 over a 4-high grid) are padded to the next
+block multiple and re-masked to zero every iteration, which keeps the pad
+region behaving exactly like the oracle's zero ghost ring — outputs stay
+bit-identical to the serial oracle for any mesh shape.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from parallel_convolution_tpu.ops import conv
+from parallel_convolution_tpu.ops.filters import Filter
+from parallel_convolution_tpu.parallel import halo
+from parallel_convolution_tpu.parallel.mesh import (
+    AXES,
+    block_sharding,
+    grid_shape,
+    make_grid_mesh,
+    padded_extent,
+)
+
+
+def _valid_mask(valid_hw, block_hw):
+    """Per-block (1, bh, bw) mask of globally-valid pixels (pad region = 0)."""
+    H, W = valid_hw
+    bh, bw = block_hw
+    row0 = lax.axis_index("x") * bh
+    col0 = lax.axis_index("y") * bw
+    rows = row0 + lax.broadcasted_iota(jnp.int32, (bh, bw), 0)
+    cols = col0 + lax.broadcasted_iota(jnp.int32, (bh, bw), 1)
+    return ((rows < H) & (cols < W))[None].astype(jnp.float32)
+
+
+def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
+                     correlate_padded):
+    """One iteration on a local block: halo pad → stencil → [quantize] → mask."""
+    needs_mask = (valid_hw[0] != block_hw[0] * grid[0]
+                  or valid_hw[1] != block_hw[1] * grid[1])
+
+    def step(v):
+        padded = halo.halo_exchange(v, filt.radius, grid)
+        out = correlate_padded(padded, filt)
+        if quantize:
+            out = conv.quantize_f32(out)
+        if needs_mask:
+            out = out * _valid_mask(valid_hw, block_hw)
+        return out
+
+    return step
+
+
+def _check_block_size(filt: Filter, block_hw) -> None:
+    if min(block_hw) < filt.radius:
+        raise ValueError(
+            f"per-device block {block_hw} smaller than filter radius "
+            f"{filt.radius}; use a smaller mesh for this image"
+        )
+
+
+@lru_cache(maxsize=64)
+def _build_iterate(mesh: Mesh, filt: Filter, iters: int, quantize: bool,
+                   valid_hw, block_hw, backend: str):
+    """Compile the fixed-count iteration runner for one (mesh, config)."""
+    grid = grid_shape(mesh)
+    _check_block_size(filt, block_hw)
+    correlate = _correlate_for_backend(backend)
+    step = _make_block_step(filt, grid, valid_hw, block_hw, quantize, correlate)
+
+    def body(block):
+        return lax.fori_loop(0, iters, lambda _, v: step(v), block)
+
+    sharded = jax.shard_map(
+        body, mesh=mesh, in_specs=P(None, *AXES), out_specs=P(None, *AXES)
+    )
+    return jax.jit(sharded, donate_argnums=0)
+
+
+@lru_cache(maxsize=64)
+def _build_converge(mesh: Mesh, filt: Filter, tol: float, max_iters: int,
+                    check_every: int, quantize: bool, valid_hw, block_hw,
+                    backend: str):
+    """Compile the run-to-convergence runner (C6: every-N diff + allreduce)."""
+    grid = grid_shape(mesh)
+    _check_block_size(filt, block_hw)
+    correlate = _correlate_for_backend(backend)
+    step = _make_block_step(filt, grid, valid_hw, block_hw, quantize, correlate)
+
+    def body(block):
+        def chunk(carry):
+            cur, done, _ = carry
+            n = jnp.minimum(check_every, max_iters - done)
+
+            def inner(_, pc):
+                prev, cur = pc
+                del prev
+                return cur, step(cur)
+
+            prev, cur = lax.fori_loop(0, n, inner, (cur, cur))
+            # The MPI_Allreduce: global max of one iteration's change.
+            diff = lax.pmax(jnp.max(jnp.abs(cur - prev)), AXES)
+            return cur, done + n, diff
+
+        def cond(carry):
+            _, done, diff = carry
+            return (done < max_iters) & (diff >= tol)
+
+        init = (block, jnp.int32(0), jnp.float32(jnp.inf))
+        cur, done, _ = lax.while_loop(cond, chunk, init)
+        return cur, lax.pmax(done, AXES)
+
+    sharded = jax.shard_map(
+        body, mesh=mesh, in_specs=P(None, *AXES),
+        out_specs=(P(None, *AXES), P()),
+    )
+    return jax.jit(sharded, donate_argnums=0)
+
+
+def _correlate_for_backend(backend: str):
+    if backend == "shifted":
+        return conv.correlate_padded
+    if backend == "xla_conv":
+        return _correlate_padded_xla
+    if backend == "pallas":
+        from parallel_convolution_tpu.ops import pallas_stencil
+
+        return pallas_stencil.correlate_padded_pallas
+    raise ValueError(f"unknown backend {backend!r}")
+
+
+def _correlate_padded_xla(padded: jnp.ndarray, filt: Filter) -> jnp.ndarray:
+    r = filt.radius
+    lhs = padded[:, None, :, :]
+    rhs = jnp.asarray(filt.taps, jnp.float32)[None, None]
+    out = lax.conv_general_dilated(
+        lhs, rhs, (1, 1), "VALID", dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        precision=lax.Precision.HIGHEST,
+    )
+    return out[:, 0]
+
+
+def _prepare(x, mesh: Mesh, r: int):
+    """Pad a global (C, H, W) f32 image to block multiples and shard it."""
+    x = jnp.asarray(x, jnp.float32)
+    C, H, W = x.shape
+    R, Cc = grid_shape(mesh)
+    Hp, Wp = padded_extent(H, R), padded_extent(W, Cc)
+    if (Hp, Wp) != (H, W):
+        x = jnp.pad(x, ((0, 0), (0, Hp - H), (0, Wp - W)))
+    x = jax.device_put(x, block_sharding(mesh))
+    return x, (H, W), (Hp // R, Wp // Cc)
+
+
+def sharded_iterate(x, filt: Filter, iters: int, mesh: Mesh | None = None,
+                    quantize: bool = True, backend: str = "shifted"):
+    """Run ``iters`` stencil iterations of a global (C, H, W) f32 image
+    sharded over the 2D mesh.  Returns the global (C, H, W) f32 result
+    (bit-identical to the serial oracle for any mesh shape)."""
+    if mesh is None:
+        mesh = make_grid_mesh()
+    xs, valid_hw, block_hw = _prepare(x, mesh, filt.radius)
+    fn = _build_iterate(mesh, filt, iters, quantize, valid_hw, block_hw, backend)
+    out = fn(xs)
+    return out[:, : valid_hw[0], : valid_hw[1]]
+
+
+def sharded_converge(x, filt: Filter, tol: float, max_iters: int,
+                     check_every: int = 1, mesh: Mesh | None = None,
+                     quantize: bool = False, backend: str = "shifted"):
+    """Run-to-convergence (BASELINE config 5).  Returns (result, iters_run)."""
+    if mesh is None:
+        mesh = make_grid_mesh()
+    xs, valid_hw, block_hw = _prepare(x, mesh, filt.radius)
+    fn = _build_converge(mesh, filt, float(tol), int(max_iters),
+                         int(check_every), quantize, valid_hw, block_hw, backend)
+    out, done = fn(xs)
+    return out[:, : valid_hw[0], : valid_hw[1]], int(done)

@@ -9,12 +9,19 @@ conftest import time.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+# Force CPU even when the ambient env pins a TPU platform (JAX_PLATFORMS=axon
+# here).  jax may already be imported by a site hook with the old env
+# snapshot, so go through jax.config (valid until a backend initializes).
+# Override with PCTPU_TEST_PLATFORM=tpu to run the suite on a real chip.
+jax.config.update("jax_platforms", os.environ.get("PCTPU_TEST_PLATFORM", "cpu"))
 
 import numpy as np
 import pytest
