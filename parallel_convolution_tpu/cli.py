@@ -1,0 +1,155 @@
+"""Command-line entrypoint (reference component C12, SURVEY.md §2).
+
+Mirrors the reference's argv vocabulary — ``image path, rows, cols, loops,
+grey|rgb`` — and replaces its ad-hoc workflow (qsub scripts + manual ``cmp``
+of raw outputs) with subcommands:
+
+  run       filter a raw image on the TPU mesh (the parallel main())
+  serial    same via the NumPy oracle (the serial main(); golden path)
+  generate  create a deterministic test image (the bundled-waterfall analog)
+  compare   byte-compare two raw images (the reference's validation step)
+  info      devices / mesh / filters at a glance
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def _add_image_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("image", help="input .raw image path")
+    p.add_argument("rows", type=int)
+    p.add_argument("cols", type=int)
+    p.add_argument("loops", type=int)
+    p.add_argument("mode", choices=["grey", "rgb"])
+
+
+def _mesh_from_flag(spec: str | None):
+    from parallel_convolution_tpu.parallel.mesh import make_grid_mesh
+
+    if not spec:
+        return make_grid_mesh()
+    r, c = (int(v) for v in spec.lower().split("x"))
+    import jax
+
+    return make_grid_mesh(jax.devices()[: r * c], (r, c))
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="pconv-tpu", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    run = sub.add_parser("run", help="distributed filtering on the TPU mesh")
+    _add_image_args(run)
+    run.add_argument("-o", "--output", required=True)
+    run.add_argument("--filter", default="blur3", dest="filter_name")
+    run.add_argument("--mesh", default=None,
+                     help="RxC grid, e.g. 2x4 (default: all devices)")
+    run.add_argument("--backend", default="shifted",
+                     choices=["shifted", "pallas", "xla_conv"])
+    run.add_argument("--converge", type=float, default=None, metavar="TOL",
+                     help="run to convergence (loops becomes max iters)")
+    run.add_argument("--check-every", type=int, default=10)
+
+    ser = sub.add_parser("serial", help="NumPy oracle (golden reference)")
+    _add_image_args(ser)
+    ser.add_argument("-o", "--output", required=True)
+    ser.add_argument("--filter", default="blur3", dest="filter_name")
+
+    gen = sub.add_parser("generate", help="write a deterministic test image")
+    gen.add_argument("output")
+    gen.add_argument("rows", type=int)
+    gen.add_argument("cols", type=int)
+    gen.add_argument("mode", choices=["grey", "rgb"])
+    gen.add_argument("--seed", type=int, default=0)
+
+    cmp_ = sub.add_parser("compare", help="byte-compare two raw images")
+    cmp_.add_argument("a")
+    cmp_.add_argument("b")
+
+    sub.add_parser("info", help="devices, default mesh, filters")
+
+    args = ap.parse_args(argv)
+
+    from parallel_convolution_tpu.utils import imageio
+
+    if args.cmd == "generate":
+        img = imageio.generate_test_image(args.rows, args.cols, args.mode,
+                                          seed=args.seed)
+        imageio.write_raw(args.output, img)
+        print(f"wrote {args.output}: {args.rows}x{args.cols} {args.mode}")
+        return 0
+
+    if args.cmd == "compare":
+        a = np.fromfile(args.a, dtype=np.uint8)
+        b = np.fromfile(args.b, dtype=np.uint8)
+        if a.shape == b.shape and np.array_equal(a, b):
+            print("identical")
+            return 0
+        if a.shape != b.shape:
+            print(f"size mismatch: {a.size} vs {b.size} bytes")
+        else:
+            n = int((a != b).sum())
+            print(f"differ: {n} bytes ({100.0 * n / a.size:.4f}%), "
+                  f"max delta {int(np.abs(a.astype(int) - b.astype(int)).max())}")
+        return 1
+
+    if args.cmd == "info":
+        import jax
+        from parallel_convolution_tpu.ops.filters import FILTERS
+        from parallel_convolution_tpu.parallel.mesh import dims_create
+
+        devs = jax.devices()
+        print(f"backend: {jax.default_backend()}  devices: {len(devs)}")
+        for d in devs[:8]:
+            print(f"  {d}")
+        print(f"default mesh: {dims_create(len(devs))}")
+        print(f"filters: {', '.join(sorted(FILTERS))}")
+        return 0
+
+    if args.cmd == "serial":
+        from parallel_convolution_tpu.ops import oracle
+        from parallel_convolution_tpu.ops.filters import get_filter
+
+        img = imageio.read_raw(args.image, args.rows, args.cols, args.mode)
+        out = oracle.run_serial_u8(img, get_filter(args.filter_name), args.loops)
+        imageio.write_raw(args.output, out)
+        print(f"serial: {args.loops} x {args.filter_name} -> {args.output}")
+        return 0
+
+    # run
+    from parallel_convolution_tpu.models import ConvolutionModel, JacobiSolver
+
+    mesh = _mesh_from_flag(args.mesh)
+    if args.converge is not None:
+        solver = JacobiSolver(
+            filt=args.filter_name, tol=args.converge, max_iters=args.loops,
+            check_every=args.check_every, mesh=mesh, backend=args.backend,
+            quantize=True,
+        )
+        img = imageio.read_raw(args.image, args.rows, args.cols, args.mode)
+        x = imageio.interleaved_to_planar(img).astype(np.float32)
+        out, iters = solver.solve(x)
+        imageio.write_raw(
+            args.output,
+            imageio.planar_to_interleaved(out.astype(np.uint8)),
+        )
+        print(f"converged after {iters} iters (tol {args.converge}) "
+              f"-> {args.output}")
+        return 0
+
+    model = ConvolutionModel(filt=args.filter_name, mesh=mesh,
+                             backend=args.backend)
+    model.run_raw_file(args.image, args.output, args.rows, args.cols,
+                       args.mode, args.loops)
+    r, c = mesh.shape["x"], mesh.shape["y"]
+    print(f"ran {args.loops} x {args.filter_name} on {r}x{c} mesh "
+          f"({args.backend}) -> {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

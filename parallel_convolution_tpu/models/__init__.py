@@ -1,0 +1,13 @@
+"""End-to-end pipelines: the user-facing 'model' layer.
+
+The reference's user surface is three ``main()`` binaries (serial / MPI /
+hybrid) that read a raw image, iterate a filter, and write the result.  Here
+that surface is :class:`ConvolutionModel` (the flagship distributed
+pipeline) and :class:`JacobiSolver` (run-to-convergence smoothing, BASELINE
+config 5), both driving the same sharded step machinery.
+"""
+
+from parallel_convolution_tpu.models.pipeline import ConvolutionModel
+from parallel_convolution_tpu.models.jacobi import JacobiSolver
+
+__all__ = ["ConvolutionModel", "JacobiSolver"]

@@ -1,0 +1,72 @@
+"""The flagship model: distributed iterative image convolution end-to-end.
+
+Equivalent user surface to the reference's parallel ``main()`` (SURVEY.md
+§3.2) — read raw image, decompose over the device grid, iterate the stencil
+with halo exchange, write raw output — as a reusable object instead of an
+inlined program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from parallel_convolution_tpu.ops.filters import Filter, get_filter
+from parallel_convolution_tpu.parallel import step as step_lib
+from parallel_convolution_tpu.parallel.mesh import make_grid_mesh
+from parallel_convolution_tpu.utils import imageio
+
+
+@dataclasses.dataclass
+class ConvolutionModel:
+    """Iterative stencil filtering of grey/RGB images over a 2D TPU mesh.
+
+    Args:
+      filt: a :class:`Filter` or registry name (default: the reference's
+        blur kernel).
+      mesh: the 2D ('x','y') device mesh; defaults to all devices in a
+        near-square grid (the MPI_Dims_create default).
+      backend: 'shifted' (normative XLA path), 'pallas' (TPU stencil
+        kernel), or 'xla_conv' (conv_general_dilated).
+      quantize: apply uint8 store-back semantics each iteration (the
+        reference's behavior for images); False = float Jacobi mode.
+    """
+
+    filt: Filter | str = "blur3"
+    mesh: Mesh | None = None
+    backend: str = "shifted"
+    quantize: bool = True
+
+    def __post_init__(self) -> None:
+        if isinstance(self.filt, str):
+            self.filt = get_filter(self.filt)
+        if self.mesh is None:
+            self.mesh = make_grid_mesh()
+
+    # -- array-level API ----------------------------------------------------
+    def run_planar(self, x, iters: int) -> jnp.ndarray:
+        """(C, H, W) f32 in → (C, H, W) f32 out after ``iters`` iterations."""
+        return step_lib.sharded_iterate(
+            x, self.filt, iters, mesh=self.mesh,
+            quantize=self.quantize, backend=self.backend,
+        )
+
+    def run_image(self, img: np.ndarray, iters: int) -> np.ndarray:
+        """uint8 (H, W[, 3]) in → uint8 out; the one-call user entrypoint."""
+        x = imageio.interleaved_to_planar(img).astype(np.float32)
+        out = self.run_planar(x, iters)
+        return imageio.planar_to_interleaved(
+            np.asarray(out).astype(np.uint8)
+        )
+
+    # -- file-level API (the reference CLI contract) ------------------------
+    def run_raw_file(
+        self, src: str, dst: str, rows: int, cols: int, mode: str, iters: int
+    ) -> None:
+        """raw file → raw file, the reference's ``main()`` end to end."""
+        img = imageio.read_raw(src, rows, cols, mode)
+        imageio.write_raw(dst, self.run_image(img, iters))

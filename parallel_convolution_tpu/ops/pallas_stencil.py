@@ -1,0 +1,148 @@
+"""Pallas TPU stencil kernel (reference component C2 + C9, SURVEY.md §2).
+
+The reference's hot loop is a per-pixel k×k multiply-add nest, multithreaded
+with ``#pragma omp parallel for`` in the hybrid build.  Its TPU-native
+equivalent is this Pallas kernel: the image block lives in HBM, a grid of
+programs each DMAs one overlapping ``(TH+2r, TW+2r)`` window into VMEM
+scratch, and the VPU (8×128 lanes — the OpenMP thread pool analog) computes
+the same fixed-order shifted multiply-add the oracle defines, writing a
+``(TH, TW)`` output tile.
+
+Overlapping input windows cannot be expressed with blocked ``BlockSpec``
+index maps (block start = index × block size), so the input uses
+``memory_space=ANY`` and the kernel issues explicit ``make_async_copy``
+windows — double-buffered across grid steps so the next tile's DMA overlaps
+the current tile's compute (the reference's comm/compute-overlap idiom,
+SURVEY.md §3.2, reborn on-chip).
+
+Semantics contract: identical op order to ``ops.oracle.correlate_once`` /
+``ops.conv.correlate_padded`` → float32 results are bit-identical, so the
+kernel drops into the sharded step as a backend with no semantic change.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from parallel_convolution_tpu.ops.filters import Filter
+
+# Default output-tile shape: multiples of the f32 (8, 128) VMEM tile; two
+# ~0.5 MB input windows + accumulator fit comfortably in 16 MB VMEM.
+DEFAULT_TILE = (256, 512)
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def _stencil_kernel(hbm_ref, out_ref, scratch, sems, *, taps, k, r, th, tw):
+    """One grid program: DMA window c,i,j → VMEM, stencil it, emit tile.
+
+    ``scratch`` holds two (th+2r, tw+2r) slots; program n waits on the
+    window it prefetched during program n-1 and starts program n+1's copy
+    before computing (double buffering, slot = parity of linear step).
+    """
+    c, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    ni, nj = pl.num_programs(1), pl.num_programs(2)
+    step = (c * ni + i) * nj + j
+    slot = jax.lax.rem(step, 2)
+
+    def window_copy(cc, ii, jj, slot):
+        return pltpu.make_async_copy(
+            hbm_ref.at[cc, pl.ds(ii * th, th + 2 * r), pl.ds(jj * tw, tw + 2 * r)],
+            scratch.at[slot],
+            sems.at[slot],
+        )
+
+    # First program primes the pipeline with its own window.
+    @pl.when(step == 0)
+    def _():
+        window_copy(c, i, j, slot).start()
+
+    # Kick off the *next* program's window before waiting on ours.
+    last = step == pl.num_programs(0) * ni * nj - 1
+
+    @pl.when(jnp.logical_not(last))
+    def _():
+        nstep = step + 1
+        nc = nstep // (ni * nj)
+        nij = jax.lax.rem(nstep, ni * nj)
+        window_copy(nc, nij // nj, jax.lax.rem(nij, nj), 1 - slot).start()
+
+    window_copy(c, i, j, slot).wait()
+
+    win = scratch[slot]
+    acc = jnp.zeros((th, tw), jnp.float32)
+    idx = 0
+    for dy in range(k):
+        for dx in range(k):
+            acc = acc + jnp.float32(taps[idx]) * win[dy : dy + th, dx : dx + tw]
+            idx += 1
+    out_ref[0] = acc
+
+
+@functools.partial(
+    jax.jit, static_argnames=("filt", "tile", "interpret")
+)
+def correlate_padded_pallas(
+    padded: jnp.ndarray,
+    filt: Filter,
+    tile: tuple[int, int] = DEFAULT_TILE,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Stencil an already-padded (C, H+2r, W+2r) f32 block → (C, H, W).
+
+    Drop-in replacement for ``ops.conv.correlate_padded`` (same normative op
+    order).  ``interpret=None`` auto-selects the Pallas interpreter off-TPU
+    so the kernel is testable on the forced-CPU mesh.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    r = filt.radius
+    k = filt.size
+    C, Hp, Wp = padded.shape
+    H, W = Hp - 2 * r, Wp - 2 * r
+
+    th = min(tile[0], _round_up(H, 8))
+    tw = min(tile[1], _round_up(W, 128))
+    gh, gw = -(-H // th), -(-W // tw)
+    # Round the compute domain up to whole tiles; the rim is garbage-over-
+    # zeros and sliced off below.
+    eh, ew = gh * th + 2 * r - Hp, gw * tw + 2 * r - Wp
+    if eh or ew:
+        padded = jnp.pad(padded, ((0, 0), (0, eh), (0, ew)))
+
+    taps = tuple(float(t) for t in filt.taps.reshape(-1))
+    kernel = functools.partial(
+        _stencil_kernel, taps=taps, k=k, r=r, th=th, tw=tw
+    )
+    # Propagate varying-mesh-axes so the kernel composes under shard_map
+    # (check_vma needs the out type to declare what it varies over).
+    vma = getattr(jax.typeof(padded), "vma", frozenset())
+    out = pl.pallas_call(
+        kernel,
+        grid=(C, gh, gw),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((1, th, tw), lambda c, i, j: (c, i, j)),
+        out_shape=jax.ShapeDtypeStruct((C, gh * th, gw * tw), jnp.float32,
+                                       vma=vma),
+        scratch_shapes=[
+            pltpu.VMEM((2, th + 2 * r, tw + 2 * r), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+    )(padded)
+    return out[:, :H, :W]
+
+
+def correlate_shifted_pallas(x: jnp.ndarray, filt: Filter, **kw) -> jnp.ndarray:
+    """Zero-padded stencil step on unpadded (C, H, W) via the Pallas kernel."""
+    r = filt.radius
+    return correlate_padded_pallas(
+        jnp.pad(x, ((0, 0), (r, r), (r, r))), filt, **kw
+    )

@@ -1,0 +1,65 @@
+"""End-to-end CLI + models tests — the reference's own validation workflow:
+serial output vs distributed output must be byte-identical."""
+
+import numpy as np
+import pytest
+
+from parallel_convolution_tpu import cli
+from parallel_convolution_tpu.models import ConvolutionModel, JacobiSolver
+from parallel_convolution_tpu.ops import filters, oracle
+from parallel_convolution_tpu.utils import imageio
+
+
+def test_model_run_image_matches_oracle(rgb_odd):
+    m = ConvolutionModel(filt="blur3", backend="shifted")
+    got = m.run_image(rgb_odd, 4)
+    want = oracle.run_serial_u8(rgb_odd, filters.get_filter("blur3"), 4)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_jacobi_solver(grey_small):
+    s = JacobiSolver(tol=0.5, max_iters=200, check_every=5)
+    out, iters = s.solve(
+        imageio.interleaved_to_planar(grey_small).astype(np.float32)
+    )
+    assert 0 < iters <= 200
+    assert out.shape == (1, *grey_small.shape)
+
+
+def test_cli_end_to_end_run_vs_serial(tmp_path, capsys):
+    # generate → serial → run → compare: the full reference workflow.
+    src = str(tmp_path / "in.raw")
+    golden = str(tmp_path / "serial.raw")
+    out = str(tmp_path / "tpu.raw")
+
+    assert cli.main(["generate", src, "31", "45", "rgb", "--seed", "5"]) == 0
+    assert cli.main(["serial", src, "31", "45", "10", "rgb",
+                     "-o", golden, "--filter", "blur3"]) == 0
+    assert cli.main(["run", src, "31", "45", "10", "rgb", "-o", out,
+                     "--filter", "blur3", "--mesh", "2x4"]) == 0
+    assert cli.main(["compare", golden, out]) == 0
+    assert "identical" in capsys.readouterr().out
+
+
+def test_cli_compare_differs(tmp_path, capsys):
+    a, b = str(tmp_path / "a.raw"), str(tmp_path / "b.raw")
+    imageio.write_raw(a, np.zeros((4, 4), np.uint8))
+    imageio.write_raw(b, np.ones((4, 4), np.uint8))
+    assert cli.main(["compare", a, b]) == 1
+    assert "differ: 16 bytes" in capsys.readouterr().out
+
+
+def test_cli_converge(tmp_path, capsys):
+    src = str(tmp_path / "in.raw")
+    out = str(tmp_path / "out.raw")
+    cli.main(["generate", src, "24", "32", "grey"])
+    assert cli.main(["run", src, "24", "32", "500", "grey", "-o", out,
+                     "--filter", "blur3", "--converge", "0.5",
+                     "--check-every", "5", "--mesh", "2x2"]) == 0
+    assert "converged after" in capsys.readouterr().out
+
+
+def test_cli_info(capsys):
+    assert cli.main(["info"]) == 0
+    out = capsys.readouterr().out
+    assert "devices: 8" in out and "blur3" in out
