@@ -1,0 +1,152 @@
+"""Benchmark module (reference component C10, SURVEY.md §2 and §6).
+
+The reference wraps its hot loop in ``MPI_Wtime`` and reduces the max
+elapsed across ranks; numbers land in hand-made README tables.  Here the
+walls are ``jax.block_until_ready`` fences around compiled runners and the
+output is structured rows (dict/JSON) feeding BASELINE.md and the driver's
+``bench.py``:
+
+* **Gpixels/sec/chip** — pixels iterated per second per device
+  (``H*W*iters / wall / n_devices``), the BASELINE.json headline metric.
+* **halo-exchange p50 latency** — median wall of one compiled halo-pad
+  round trip over the mesh, the latency-bound tail the reference measures
+  implicitly at small block sizes.
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from parallel_convolution_tpu.ops.filters import Filter
+from parallel_convolution_tpu.parallel import halo, step as step_lib
+from parallel_convolution_tpu.parallel.mesh import (
+    AXES, block_sharding, grid_shape, make_grid_mesh,
+)
+
+
+def wall(fn, *args, warmup: int = 1, reps: int = 3) -> float:
+    """Median wall-clock seconds of ``fn(*args)`` fully materialized."""
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times)
+
+
+def bench_iterate(
+    shape: tuple[int, int],
+    filt: Filter,
+    iters: int,
+    mesh=None,
+    channels: int = 1,
+    backend: str = "shifted",
+    quantize: bool = True,
+    reps: int = 3,
+) -> dict:
+    """Gpixels/sec/chip for the standard fixed-iteration workload."""
+    if mesh is None:
+        mesh = make_grid_mesh()
+    H, W = shape
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 256, size=(channels, H, W)).astype(np.float32)
+
+    def run(v):
+        return step_lib.sharded_iterate(
+            v, filt, iters, mesh=mesh, quantize=quantize, backend=backend
+        )
+
+    secs = wall(run, x, reps=reps)
+    n_dev = mesh.size
+    gpx = H * W * channels * iters / secs / 1e9
+    return {
+        "workload": f"{filt.name} {H}x{W}x{channels} {iters} iters",
+        "backend": backend,
+        "mesh": "x".join(str(s) for s in grid_shape(mesh)),
+        "devices": n_dev,
+        "wall_s": round(secs, 4),
+        "gpixels_per_s": round(gpx, 3),
+        "gpixels_per_s_per_chip": round(gpx / n_dev, 3),
+    }
+
+
+def bench_halo_p50(
+    block_shape: tuple[int, int],
+    r: int = 1,
+    mesh=None,
+    trials: int = 20,
+) -> dict:
+    """p50 latency of one compiled two-phase halo exchange over the mesh.
+
+    ``block_shape`` is the per-device block (the reference's per-rank tile);
+    latency is what bounds small-block scaling (SURVEY.md §3.2).
+    """
+    if mesh is None:
+        mesh = make_grid_mesh()
+    grid = grid_shape(mesh)
+    bh, bw = block_shape
+    H, W = bh * grid[0], bw * grid[1]
+    x = jax.device_put(
+        np.random.default_rng(0).random((1, H, W)).astype(np.float32),
+        block_sharding(mesh),
+    )
+
+    fn = jax.jit(
+        jax.shard_map(
+            lambda v: halo.halo_exchange(v, r, grid),
+            mesh=mesh, in_specs=P(None, *AXES), out_specs=P(None, *AXES),
+        )
+    )
+    jax.block_until_ready(fn(x))  # compile
+    times = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(x))
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return {
+        "block": f"{bh}x{bw}", "radius": r,
+        "mesh": "x".join(str(s) for s in grid),
+        "p50_us": round(1e6 * times[len(times) // 2], 1),
+        "p90_us": round(1e6 * times[int(len(times) * 0.9)], 1),
+    }
+
+
+def bench_oracle_proxy(shape=(1920, 2520), iters: int = 2) -> dict:
+    """Serial CPU proxy (BASELINE config 1) via the NumPy oracle.
+
+    The reference's own published numbers were unreadable (empty mount —
+    BASELINE.md provenance note), so the honest single-process baseline is
+    measured here, not copied.  Prefers the native C++ serial binary when
+    built (a truer stand-in for the reference's C), else NumPy.
+    """
+    from parallel_convolution_tpu.ops import oracle
+    from parallel_convolution_tpu.ops.filters import get_filter
+
+    H, W = shape
+    img = np.random.default_rng(0).integers(0, 256, size=(H, W)).astype(np.uint8)
+    filt = get_filter("blur3")
+    impl = "numpy-oracle"
+    t0 = time.perf_counter()
+    try:
+        from parallel_convolution_tpu.native import serial_native
+
+        serial_native.run_serial_u8(img, filt, iters)
+        impl = "cpp-serial"
+    except Exception:
+        oracle.run_serial_u8(img, filt, iters)
+    secs = time.perf_counter() - t0
+    return {
+        "workload": f"serial blur3 {H}x{W} {iters} iters",
+        "impl": impl,
+        "wall_s": round(secs, 4),
+        "gpixels_per_s": round(H * W * iters / secs / 1e9, 5),
+    }
