@@ -1,0 +1,53 @@
+"""Native host runtime (C++): serial baseline, block I/O, layout conversion.
+
+Built on demand with ``python -m parallel_convolution_tpu.native.build``
+(plain g++, no external deps).  Everything here has a NumPy fallback in the
+pure-Python modules — the native tier exists because the reference's serial
+baseline and I/O are native C, and a Python stand-in would not be an honest
+baseline for benchmark comparisons.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from pathlib import Path
+
+_LIB_NAME = "libpctpu.so"
+_lib = None
+
+
+def lib_path() -> Path:
+    return Path(__file__).resolve().parent / _LIB_NAME
+
+
+def is_built() -> bool:
+    return lib_path().exists()
+
+
+def load():
+    """Load (building lazily if needed) the native library; raises if absent
+    and unbuildable."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not is_built():
+        from parallel_convolution_tpu.native import build
+
+        build.build()
+    lib = ctypes.CDLL(os.fspath(lib_path()))
+    c = ctypes
+    i64, u8p, fp = c.c_int64, c.POINTER(c.c_uint8), c.POINTER(c.c_float)
+    lib.pctpu_run_serial_u8.argtypes = [
+        u8p, u8p, i64, i64, i64, fp, c.c_int, c.c_int, c.c_int
+    ]
+    lib.pctpu_run_serial_u8.restype = None
+    lib.pctpu_num_threads.restype = c.c_int
+    for fn in (lib.pctpu_read_block, lib.pctpu_write_block):
+        fn.argtypes = [c.c_char_p, i64, i64, i64, i64, i64, i64, i64, u8p]
+        fn.restype = c.c_int
+    for fn in (lib.pctpu_interleaved_to_planar, lib.pctpu_planar_to_interleaved):
+        fn.argtypes = [u8p, u8p, i64, i64, i64]
+        fn.restype = None
+    _lib = lib
+    return lib

@@ -1,0 +1,37 @@
+"""Build the native library with g++ (no network, no external deps).
+
+Usage: ``python -m parallel_convolution_tpu.native.build``
+
+Flag notes: ``-O3 -march=native -fopenmp`` mirror the reference's
+``-O3 -fopenmp`` Makefiles; ``-ffp-contract=off`` is load-bearing — an fma
+contraction of ``acc += tap * px`` would round once instead of twice and
+break bit-exactness against the NumPy/XLA oracle semantics.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from pathlib import Path
+
+HERE = Path(__file__).resolve().parent
+
+
+def build(verbose: bool = False) -> Path:
+    src = HERE / "src" / "pctpu.cpp"
+    out = HERE / "libpctpu.so"
+    cmd = [
+        "g++", "-O3", "-march=native", "-fopenmp", "-fPIC", "-shared",
+        "-ffp-contract=off", "-fno-fast-math",
+        "-o", str(out), str(src),
+    ]
+    if verbose:
+        print(" ".join(cmd))
+    subprocess.run(cmd, check=True, capture_output=not verbose)
+    return out
+
+
+if __name__ == "__main__":
+    path = build(verbose=True)
+    print(f"built {path}")
+    sys.exit(0)

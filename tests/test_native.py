@@ -1,0 +1,78 @@
+"""Native C++ tier vs the oracle (built on demand; skipped without g++)."""
+
+import shutil
+
+import numpy as np
+import pytest
+
+from parallel_convolution_tpu.ops import filters, oracle
+from parallel_convolution_tpu.utils import imageio
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None, reason="no C++ toolchain"
+)
+
+
+@pytest.fixture(scope="session")
+def native():
+    from parallel_convolution_tpu import native as native_pkg
+    from parallel_convolution_tpu.native import serial_native
+
+    native_pkg.load()
+    return serial_native
+
+
+@pytest.mark.parametrize("mode", ["grey", "rgb"])
+@pytest.mark.parametrize("name", ["blur3", "gaussian5", "edge5", "sharpen3"])
+def test_native_serial_bitexact(native, mode, name):
+    img = imageio.generate_test_image(33, 47, mode, seed=21)
+    f = filters.get_filter(name)
+    got = native.run_serial_u8(img, f, 4)
+    want = oracle.run_serial_u8(img, f, 4)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_native_zero_iters(native, grey_small):
+    f = filters.get_filter("blur3")
+    np.testing.assert_array_equal(
+        native.run_serial_u8(grey_small, f, 0), grey_small
+    )
+
+
+@pytest.mark.parametrize("iters", [1, 2, 3, 6])
+def test_native_double_buffer_parity(native, grey_small, iters):
+    # Exercises the even/odd buffer-swap routing.
+    f = filters.get_filter("blur3")
+    got = native.run_serial_u8(grey_small, f, iters)
+    want = oracle.run_serial_u8(grey_small, f, iters)
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("mode", ["grey", "rgb"])
+def test_native_block_io(native, tmp_path, mode):
+    img = imageio.generate_test_image(20, 28, mode, seed=22)
+    p = str(tmp_path / "img.raw")
+    imageio.write_raw(p, img)
+    blk = native.read_block(p, 20, 28, mode, 3, 15, 5, 21)
+    np.testing.assert_array_equal(blk, img[3:15, 5:21])
+
+    q = str(tmp_path / "out.raw")
+    imageio.allocate_raw(q, 20, 28, mode)
+    for bi in range(2):
+        r0, r1 = imageio.block_bounds(20, 2, bi)
+        native.write_block(q, 20, 28, mode, r0, 0, img[r0:r1])
+    np.testing.assert_array_equal(imageio.read_raw(q, 20, 28, mode), img)
+
+
+def test_native_block_io_bounds_error(native, tmp_path):
+    p = str(tmp_path / "img.raw")
+    imageio.write_raw(p, np.zeros((4, 4), np.uint8))
+    with pytest.raises(OSError):
+        native.read_block(p, 4, 4, "grey", 0, 5, 0, 4)
+
+
+def test_native_layout_roundtrip(native):
+    img = imageio.generate_test_image(12, 18, "rgb", seed=23)
+    pl = native.interleaved_to_planar(img)
+    np.testing.assert_array_equal(pl, imageio.interleaved_to_planar(img))
+    np.testing.assert_array_equal(native.planar_to_interleaved(pl), img)
