@@ -70,3 +70,18 @@ class ConvolutionModel:
         """raw file → raw file, the reference's ``main()`` end to end."""
         img = imageio.read_raw(src, rows, cols, mode)
         imageio.write_raw(dst, self.run_image(img, iters))
+
+    def run_raw_file_sharded(
+        self, src: str, dst: str, rows: int, cols: int, mode: str, iters: int
+    ) -> None:
+        """Huge-image path: block-reads from disk straight into the device
+        sharding, iterates, block-writes back — the full image never exists
+        in one host buffer (the MPI-IO workflow, SURVEY.md §7)."""
+        from parallel_convolution_tpu.utils import sharded_io
+
+        xs = sharded_io.load_sharded(src, rows, cols, mode, self.mesh)
+        out = step_lib.iterate_prepared(
+            xs, self.filt, iters, self.mesh, (rows, cols),
+            quantize=self.quantize, backend=self.backend,
+        )
+        sharded_io.save_sharded(dst, out, rows, cols, mode)

@@ -173,6 +173,22 @@ def _prepare(x, mesh: Mesh, r: int):
     return x, (H, W), (Hp // R, Wp // Cc)
 
 
+def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
+                     valid_hw, quantize: bool = True,
+                     backend: str = "shifted"):
+    """Iterate an already-sharded padded (C, Hp, Wp) array in place(-ish).
+
+    The zero-copy entry for huge images loaded via utils.sharded_io: input
+    stays in its blocked sharding, output keeps the padded extent (pass it
+    straight to ``save_sharded``).  The input array is donated.
+    """
+    R, Cc = grid_shape(mesh)
+    block_hw = (xs.shape[1] // R, xs.shape[2] // Cc)
+    fn = _build_iterate(mesh, filt, iters, quantize, tuple(valid_hw),
+                        block_hw, backend)
+    return fn(xs)
+
+
 def sharded_iterate(x, filt: Filter, iters: int, mesh: Mesh | None = None,
                     quantize: bool = True, backend: str = "shifted"):
     """Run ``iters`` stencil iterations of a global (C, H, W) f32 image
@@ -181,8 +197,8 @@ def sharded_iterate(x, filt: Filter, iters: int, mesh: Mesh | None = None,
     if mesh is None:
         mesh = make_grid_mesh()
     xs, valid_hw, block_hw = _prepare(x, mesh, filt.radius)
-    fn = _build_iterate(mesh, filt, iters, quantize, valid_hw, block_hw, backend)
-    out = fn(xs)
+    out = iterate_prepared(xs, filt, iters, mesh, valid_hw,
+                           quantize=quantize, backend=backend)
     return out[:, : valid_hw[0], : valid_hw[1]]
 
 

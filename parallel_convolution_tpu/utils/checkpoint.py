@@ -1,0 +1,132 @@
+"""Checkpoint / resume for long runs (SURVEY.md §5 aux subsystems).
+
+The reference has none (runs are minutes long, output written once at the
+end); this framework adds the natural TPU-native version: every K
+iterations the sharded state is snapshotted **per addressable shard** (no
+host gather — each device block becomes one ``.npy`` keyed by its grid
+coordinates) together with a JSON sidecar recording progress and config.
+A restarted run validates the sidecar against its own config and continues
+from the saved iteration.
+
+Chunked execution does not perturb semantics: in u8 mode every iteration
+ends quantized to exact integers, and float-mode shards are saved as raw
+float32, so save/restore is lossless and the checkpointed run remains
+bit-identical to an uninterrupted one.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from parallel_convolution_tpu.parallel.mesh import block_sharding, grid_shape
+
+META_NAME = "meta.json"
+
+
+def _coords(index, block_hw) -> tuple[int, int]:
+    rs, cs = index[1], index[2]
+    return (rs.start or 0) // block_hw[0], (cs.start or 0) // block_hw[1]
+
+
+def save_state(ckpt_dir, arr: jax.Array, meta: dict) -> None:
+    """Snapshot a sharded padded (C, Hp, Wp) array + metadata."""
+    d = Path(ckpt_dir)
+    d.mkdir(parents=True, exist_ok=True)
+    R_blocks = meta["grid"]
+    block_hw = (arr.shape[1] // R_blocks[0], arr.shape[2] // R_blocks[1])
+    for shard in arr.addressable_shards:
+        r, c = _coords(shard.index, block_hw)
+        np.save(d / f"shard_{r}_{c}.npy", np.asarray(shard.data))
+    tmp = d / (META_NAME + ".tmp")
+    tmp.write_text(json.dumps(meta))
+    os.replace(tmp, d / META_NAME)  # atomic: meta only names complete shards
+
+
+def load_meta(ckpt_dir) -> dict | None:
+    p = Path(ckpt_dir) / META_NAME
+    if not p.exists():
+        return None
+    return json.loads(p.read_text())
+
+
+def load_state(ckpt_dir, mesh: Mesh) -> tuple[jax.Array, dict]:
+    """Restore the sharded array (each device reads only its own shard)."""
+    d = Path(ckpt_dir)
+    meta = load_meta(d)
+    if meta is None:
+        raise FileNotFoundError(f"no checkpoint at {d}")
+    shape = tuple(meta["shape"])
+    grid = grid_shape(mesh)
+    if tuple(meta["grid"]) != grid:
+        raise ValueError(
+            f"checkpoint grid {meta['grid']} != mesh grid {list(grid)}"
+        )
+    block_hw = (shape[1] // grid[0], shape[2] // grid[1])
+
+    def cb(index):
+        r, c = _coords(index, block_hw)
+        return np.load(d / f"shard_{r}_{c}.npy")
+
+    arr = jax.make_array_from_callback(shape, block_sharding(mesh), cb)
+    return arr, meta
+
+
+def run_checkpointed(
+    xs: jax.Array,
+    filt,
+    total_iters: int,
+    mesh: Mesh,
+    valid_hw,
+    ckpt_dir,
+    every: int,
+    quantize: bool = True,
+    backend: str = "shifted",
+) -> jax.Array:
+    """Iterate with a snapshot every ``every`` iterations; auto-resume.
+
+    If ``ckpt_dir`` holds a compatible checkpoint, continues from its
+    iteration count (``xs`` may then be None).  Returns the padded sharded
+    result after ``total_iters`` total iterations.
+    """
+    from parallel_convolution_tpu.parallel import step as step_lib
+
+    grid = grid_shape(mesh)
+    config = {
+        "filter": filt.name,
+        "quantize": quantize,
+        "backend": backend,
+        "valid_hw": list(valid_hw),
+        "grid": list(grid),
+    }
+    meta = load_meta(ckpt_dir)
+    done = 0
+    if meta is not None:
+        saved_cfg = {k: meta[k] for k in config}
+        if saved_cfg != config:
+            raise ValueError(
+                f"checkpoint config mismatch: {saved_cfg} != {config}"
+            )
+        xs, _ = load_state(ckpt_dir, mesh)
+        done = int(meta["iters_done"])
+    if xs is None:
+        raise ValueError("no checkpoint found and no initial state given")
+
+    while done < total_iters:
+        chunk = min(every, total_iters - done)
+        xs = step_lib.iterate_prepared(
+            xs, filt, chunk, mesh, valid_hw,
+            quantize=quantize, backend=backend,
+        )
+        done += chunk
+        if done < total_iters:  # final state is the caller's to persist
+            save_state(
+                ckpt_dir, xs,
+                {**config, "iters_done": done, "shape": list(xs.shape)},
+            )
+    return xs

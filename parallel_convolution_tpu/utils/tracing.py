@@ -1,0 +1,74 @@
+"""Tracing / profiling hooks (SURVEY.md §5 aux subsystems).
+
+The reference's only observability is hand-placed ``MPI_Wtime`` segments
+printed at the end.  Here:
+
+* :class:`PhaseTimer` — named wall-clock phases (load / compile / iterate /
+  write) with a structured report, the upgrade over printf timings.  Device
+  work is fenced with ``jax.block_until_ready`` so a phase means what it
+  says under async dispatch.
+* :func:`device_trace` — context manager around ``jax.profiler.trace``;
+  writes a TensorBoard/Perfetto trace of the XLA execution (the real
+  per-op timeline the reference never had).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+
+import jax
+
+
+class PhaseTimer:
+    """Accumulate named wall-clock phases.
+
+    >>> t = PhaseTimer()
+    >>> with t.phase("iterate"):
+    ...     out = run()          # doctest: +SKIP
+    >>> t.report()               # doctest: +SKIP
+    """
+
+    def __init__(self) -> None:
+        self.walls: dict[str, float] = {}
+        self.counts: dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str, fence=None):
+        """Time a phase; ``fence`` (a jax value/tree) is block_until_ready'd
+        before the clock stops so async device work is charged here."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if fence is not None:
+                jax.block_until_ready(fence)
+            dt = time.perf_counter() - t0
+            self.walls[name] = self.walls.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def report(self) -> dict:
+        total = sum(self.walls.values())
+        return {
+            "total_s": round(total, 4),
+            "phases": {
+                k: {"wall_s": round(v, 4), "calls": self.counts[k],
+                    "share": round(v / total, 3) if total else 0.0}
+                for k, v in sorted(self.walls.items(), key=lambda kv: -kv[1])
+            },
+        }
+
+    def dump(self, path) -> None:
+        with open(path, "w") as f:
+            json.dump(self.report(), f, indent=2)
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str):
+    """Capture an XLA device trace viewable in TensorBoard / Perfetto."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

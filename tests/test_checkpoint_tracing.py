@@ -1,0 +1,96 @@
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from parallel_convolution_tpu.ops import filters, oracle
+from parallel_convolution_tpu.parallel import mesh as mesh_lib, step
+from parallel_convolution_tpu.utils import checkpoint, imageio, tracing
+
+
+def _mesh(shape):
+    return mesh_lib.make_grid_mesh(jax.devices()[: shape[0] * shape[1]], shape)
+
+
+def _prepare(img, m, filt):
+    x = imageio.interleaved_to_planar(img).astype(np.float32)
+    return step._prepare(x, m, filt.radius)
+
+
+def test_checkpointed_run_bitexact(tmp_path, grey_odd):
+    filt = filters.get_filter("blur3")
+    m = _mesh((2, 4))
+    xs, valid_hw, _ = _prepare(grey_odd, m, filt)
+    out = checkpoint.run_checkpointed(
+        xs, filt, total_iters=10, mesh=m, valid_hw=valid_hw,
+        ckpt_dir=tmp_path / "ck", every=3,
+    )
+    got = np.asarray(out)[:, : valid_hw[0], : valid_hw[1]].astype(np.uint8)
+    want = oracle.run_serial_u8(grey_odd, filt, 10)
+    np.testing.assert_array_equal(got[0], want)
+    # intermediate snapshots were written (at 3, 6, 9 but not 10)
+    meta = checkpoint.load_meta(tmp_path / "ck")
+    assert meta["iters_done"] == 9
+
+
+def test_checkpoint_resume_continues(tmp_path, grey_odd):
+    filt = filters.get_filter("blur3")
+    m = _mesh((2, 2))
+    xs, valid_hw, _ = _prepare(grey_odd, m, filt)
+    ck = tmp_path / "ck"
+    # Simulate a killed run: snapshot at iteration 4 by hand.
+    mid = step.iterate_prepared(xs, filt, 4, m, valid_hw)
+    checkpoint.save_state(ck, mid, {
+        "filter": filt.name, "quantize": True, "backend": "shifted",
+        "valid_hw": list(valid_hw), "grid": [2, 2],
+        "iters_done": 4, "shape": list(mid.shape),
+    })
+    # Resume with xs=None: must pick up at 4 and finish 10 total.
+    out = checkpoint.run_checkpointed(
+        None, filt, total_iters=10, mesh=m, valid_hw=valid_hw,
+        ckpt_dir=ck, every=4,
+    )
+    got = np.asarray(out)[:, : valid_hw[0], : valid_hw[1]].astype(np.uint8)
+    want = oracle.run_serial_u8(grey_odd, filt, 10)
+    np.testing.assert_array_equal(got[0], want)
+
+
+def test_checkpoint_config_mismatch_raises(tmp_path, grey_small):
+    filt = filters.get_filter("blur3")
+    m = _mesh((2, 2))
+    xs, valid_hw, _ = _prepare(grey_small, m, filt)
+    ck = tmp_path / "ck"
+    checkpoint.save_state(ck, xs, {
+        "filter": "edge3", "quantize": True, "backend": "shifted",
+        "valid_hw": list(valid_hw), "grid": [2, 2],
+        "iters_done": 2, "shape": list(xs.shape),
+    })
+    with pytest.raises(ValueError, match="config mismatch"):
+        checkpoint.run_checkpointed(None, filt, 10, m, valid_hw, ck, 2)
+
+
+def test_checkpoint_grid_mismatch_raises(tmp_path, grey_small):
+    filt = filters.get_filter("blur3")
+    m = _mesh((2, 2))
+    xs, valid_hw, _ = _prepare(grey_small, m, filt)
+    checkpoint.save_state(tmp_path, xs, {
+        "grid": [2, 2], "shape": list(xs.shape), "iters_done": 0,
+    })
+    with pytest.raises(ValueError, match="grid"):
+        checkpoint.load_state(tmp_path, _mesh((1, 4)))
+
+
+def test_phase_timer(tmp_path):
+    t = tracing.PhaseTimer()
+    with t.phase("a"):
+        pass
+    with t.phase("a"):
+        pass
+    with t.phase("b", fence=jax.numpy.ones((4,))):
+        pass
+    rep = t.report()
+    assert rep["phases"]["a"]["calls"] == 2
+    assert set(rep["phases"]) == {"a", "b"}
+    t.dump(tmp_path / "t.json")
+    assert json.loads((tmp_path / "t.json").read_text())["total_s"] >= 0
