@@ -53,6 +53,13 @@ def main(argv: list[str] | None = None) -> int:
     run.add_argument("--converge", type=float, default=None, metavar="TOL",
                      help="run to convergence (loops becomes max iters)")
     run.add_argument("--check-every", type=int, default=10)
+    run.add_argument("--sharded-io", action="store_true",
+                     help="block-stream the image between disk and devices "
+                          "(huge images; never materializes on one host)")
+    run.add_argument("--checkpoint", default=None, metavar="DIR",
+                     help="snapshot state every --checkpoint-every iters "
+                          "and auto-resume from DIR")
+    run.add_argument("--checkpoint-every", type=int, default=100)
 
     ser = sub.add_parser("serial", help="NumPy oracle (golden reference)")
     _add_image_args(ser)
@@ -143,8 +150,25 @@ def main(argv: list[str] | None = None) -> int:
 
     model = ConvolutionModel(filt=args.filter_name, mesh=mesh,
                              backend=args.backend)
-    model.run_raw_file(args.image, args.output, args.rows, args.cols,
-                       args.mode, args.loops)
+    if args.checkpoint:
+        from parallel_convolution_tpu.parallel import step as step_lib
+        from parallel_convolution_tpu.utils import checkpoint, sharded_io
+
+        xs = sharded_io.load_sharded(args.image, args.rows, args.cols,
+                                     args.mode, mesh)
+        out = checkpoint.run_checkpointed(
+            xs, model.filt, args.loops, mesh, (args.rows, args.cols),
+            ckpt_dir=args.checkpoint, every=args.checkpoint_every,
+            backend=args.backend,
+        )
+        sharded_io.save_sharded(args.output, out, args.rows, args.cols,
+                                args.mode)
+    elif args.sharded_io:
+        model.run_raw_file_sharded(args.image, args.output, args.rows,
+                                   args.cols, args.mode, args.loops)
+    else:
+        model.run_raw_file(args.image, args.output, args.rows, args.cols,
+                           args.mode, args.loops)
     r, c = mesh.shape["x"], mesh.shape["y"]
     print(f"ran {args.loops} x {args.filter_name} on {r}x{c} mesh "
           f"({args.backend}) -> {args.output}")

@@ -1,0 +1,75 @@
+"""Multi-host deployment (the reference's multi-node ``mpiexec`` tier).
+
+The reference scales past one node by launching MPI ranks across hosts; the
+TPU-native equivalent is JAX's multi-controller runtime: one Python process
+per host, ``jax.distributed.initialize`` (the ``MPI_Init`` analog), and a
+mesh over ``jax.devices()`` — which then spans every host's chips.  All the
+machinery in this package (shard_map step, ppermute halos, sharded I/O,
+per-shard checkpoints) is already multi-host-safe because it only ever
+touches ``addressable_shards`` on the host side; XLA routes the halo
+collectives over ICI within a slice and DCN across slices.
+
+Single-host runs need none of this — the module is a thin, documented shim
+so a pod launch is three lines:
+
+    from parallel_convolution_tpu.parallel import multihost
+    multihost.initialize()          # on every host, same flags
+    model = ConvolutionModel()      # mesh spans the whole pod
+
+This environment has one host/one chip, so the path is exercised by the
+single-host no-op branch plus the CPU-mesh tests; the barrier/sync helpers
+wrap ``jax.experimental.multihost_utils``.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """``MPI_Init`` for TPU pods.  No-op when single-process.
+
+    With no arguments, relies on the TPU environment's auto-bootstrap
+    (GKE/GCE metadata), which is the common case on Cloud TPU pods.
+    """
+    if num_processes is not None and num_processes <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def process_info() -> dict:
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }
+
+
+def barrier(name: str = "pctpu_barrier") -> None:
+    """Cross-host sync point (the ``MPI_Barrier`` before/after timing)."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def broadcast_scalar(value: float) -> float:
+    """Agree on one host-side scalar across processes (rank-0 wins)."""
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    import numpy as np
+
+    arr = multihost_utils.broadcast_one_to_all(np.asarray(value))
+    return float(arr)

@@ -63,3 +63,27 @@ def test_cli_info(capsys):
     assert cli.main(["info"]) == 0
     out = capsys.readouterr().out
     assert "devices: 8" in out and "blur3" in out
+
+
+def test_cli_sharded_io_and_checkpoint(tmp_path, capsys):
+    src = str(tmp_path / "in.raw")
+    a, b, c = (str(tmp_path / n) for n in ("a.raw", "b.raw", "c.raw"))
+    cli.main(["generate", src, "30", "44", "grey", "--seed", "6"])
+    assert cli.main(["serial", src, "30", "44", "8", "grey", "-o", a]) == 0
+    assert cli.main(["run", src, "30", "44", "8", "grey", "-o", b,
+                     "--mesh", "2x2", "--sharded-io"]) == 0
+    assert cli.main(["compare", a, b]) == 0
+    assert cli.main(["run", src, "30", "44", "8", "grey", "-o", c,
+                     "--mesh", "2x2", "--checkpoint",
+                     str(tmp_path / "ck"), "--checkpoint-every", "3"]) == 0
+    assert cli.main(["compare", a, c]) == 0
+
+
+def test_multihost_single_process_noops():
+    from parallel_convolution_tpu.parallel import multihost
+
+    multihost.initialize(num_processes=1)
+    info = multihost.process_info()
+    assert info["process_count"] == 1 and info["global_devices"] == 8
+    multihost.barrier()
+    assert multihost.broadcast_scalar(3.5) == 3.5
