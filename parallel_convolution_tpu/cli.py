@@ -50,6 +50,9 @@ def main(argv: list[str] | None = None) -> int:
                      help="RxC grid, e.g. 2x4 (default: all devices)")
     run.add_argument("--backend", default="shifted",
                      choices=["shifted", "pallas", "xla_conv"])
+    run.add_argument("--storage", default="f32", choices=["f32", "bf16"],
+                     help="iteration-carry dtype; bf16 halves HBM/ICI "
+                          "traffic and stays bit-exact for u8 images")
     run.add_argument("--converge", type=float, default=None, metavar="TOL",
                      help="run to convergence (loops becomes max iters)")
     run.add_argument("--check-every", type=int, default=10)
@@ -149,7 +152,7 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     model = ConvolutionModel(filt=args.filter_name, mesh=mesh,
-                             backend=args.backend)
+                             backend=args.backend, storage=args.storage)
     if args.checkpoint:
         from parallel_convolution_tpu.parallel import step as step_lib
         from parallel_convolution_tpu.utils import checkpoint, sharded_io

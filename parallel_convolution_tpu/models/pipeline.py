@@ -40,6 +40,8 @@ class ConvolutionModel:
     mesh: Mesh | None = None
     backend: str = "shifted"
     quantize: bool = True
+    storage: str = "f32"  # 'bf16' halves HBM/ICI traffic, still bit-exact
+    #                        in quantize mode (u8 values are exact in bf16)
 
     def __post_init__(self) -> None:
         if isinstance(self.filt, str):
@@ -53,6 +55,7 @@ class ConvolutionModel:
         return step_lib.sharded_iterate(
             x, self.filt, iters, mesh=self.mesh,
             quantize=self.quantize, backend=self.backend,
+            storage=self.storage,
         )
 
     def run_image(self, img: np.ndarray, iters: int) -> np.ndarray:

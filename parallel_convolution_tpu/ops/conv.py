@@ -47,6 +47,9 @@ def correlate_padded(padded: jnp.ndarray, filt: Filter) -> jnp.ndarray:
     C, Hp, Wp = padded.shape
     H, W = Hp - 2 * filt.radius, Wp - 2 * filt.radius
     taps = [float(t) for t in filt.taps.reshape(-1)]
+    # Accumulate in f32 regardless of storage dtype (bf16 carries hold exact
+    # small integers, but products/sums must not round at bf16).
+    padded = padded.astype(jnp.float32)
     acc = jnp.zeros((C, H, W), jnp.float32)
     i = 0
     for dy in range(k):
@@ -68,7 +71,8 @@ def correlate_xla_conv(x: jnp.ndarray, filt: Filter) -> jnp.ndarray:
     the conv batch dim with a single feature channel.
     """
     r = filt.radius
-    lhs = x[:, None, :, :].astype(jnp.float32)  # (C, 1, H, W)
+    x = x.astype(jnp.float32)
+    lhs = x[:, None, :, :]  # (C, 1, H, W)
     rhs = jnp.asarray(filt.taps, jnp.float32)[None, None, :, :]  # (1, 1, k, k)
     out = jax.lax.conv_general_dilated(
         lhs, rhs, window_strides=(1, 1), padding=[(r, r), (r, r)],

@@ -40,7 +40,8 @@ def _round_up(n: int, m: int) -> int:
     return -(-n // m) * m
 
 
-def _stencil_kernel(hbm_ref, out_ref, scratch, sems, *, taps, k, r, th, tw):
+def _stencil_kernel(hbm_ref, out_ref, scratch, sems, *, taps, k, r, th, tw,
+                    quantize):
     """One grid program: DMA window c,i,j → VMEM, stencil it, emit tile.
 
     ``scratch`` holds two (th+2r, tw+2r) slots; program n waits on the
@@ -81,28 +82,45 @@ def _stencil_kernel(hbm_ref, out_ref, scratch, sems, *, taps, k, r, th, tw):
     idx = 0
     for dy in range(k):
         for dx in range(k):
-            acc = acc + jnp.float32(taps[idx]) * win[dy : dy + th, dx : dx + tw]
+            # f32 accumulation even for bf16 storage (cast is VPU-free-ish).
+            w = win[dy : dy + th, dx : dx + tw].astype(jnp.float32)
+            acc = acc + jnp.float32(taps[idx]) * w
             idx += 1
-    out_ref[0] = acc
+    if quantize:
+        # Fused u8 store-back: saves one full HBM round trip per iteration
+        # vs quantizing in a separate XLA fusion after the kernel.
+        acc = jnp.clip(jnp.rint(acc), 0.0, 255.0)
+    out_ref[0] = acc.astype(out_ref.dtype)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("filt", "tile", "interpret")
+    jax.jit,
+    static_argnames=("filt", "tile", "interpret", "quantize", "out_dtype"),
 )
 def correlate_padded_pallas(
     padded: jnp.ndarray,
     filt: Filter,
     tile: tuple[int, int] = DEFAULT_TILE,
     interpret: bool | None = None,
+    quantize: bool = False,
+    out_dtype=None,
 ) -> jnp.ndarray:
-    """Stencil an already-padded (C, H+2r, W+2r) f32 block → (C, H, W).
+    """Stencil an already-padded (C, H+2r, W+2r) block → (C, H, W).
 
     Drop-in replacement for ``ops.conv.correlate_padded`` (same normative op
     order).  ``interpret=None`` auto-selects the Pallas interpreter off-TPU
     so the kernel is testable on the forced-CPU mesh.
+
+    ``quantize=True`` fuses the u8 store-back into the kernel;
+    ``out_dtype`` (default: input dtype if quantizing, else f32) enables
+    bf16 storage — quantized values are exact integers ≤ 255, which bf16
+    represents exactly, so bf16 carries halve HBM/ICI traffic with no
+    semantic change.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if out_dtype is None:
+        out_dtype = padded.dtype if quantize else jnp.float32
     r = filt.radius
     k = filt.size
     C, Hp, Wp = padded.shape
@@ -119,7 +137,7 @@ def correlate_padded_pallas(
 
     taps = tuple(float(t) for t in filt.taps.reshape(-1))
     kernel = functools.partial(
-        _stencil_kernel, taps=taps, k=k, r=r, th=th, tw=tw
+        _stencil_kernel, taps=taps, k=k, r=r, th=th, tw=tw, quantize=quantize
     )
     # Propagate varying-mesh-axes so the kernel composes under shard_map
     # (check_vma needs the out type to declare what it varies over).
@@ -129,10 +147,10 @@ def correlate_padded_pallas(
         grid=(C, gh, gw),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec((1, th, tw), lambda c, i, j: (c, i, j)),
-        out_shape=jax.ShapeDtypeStruct((C, gh * th, gw * tw), jnp.float32,
+        out_shape=jax.ShapeDtypeStruct((C, gh * th, gw * tw), out_dtype,
                                        vma=vma),
         scratch_shapes=[
-            pltpu.VMEM((2, th + 2 * r, tw + 2 * r), jnp.float32),
+            pltpu.VMEM((2, th + 2 * r, tw + 2 * r), padded.dtype),
             pltpu.SemaphoreType.DMA((2,)),
         ],
         interpret=interpret,

@@ -55,19 +55,31 @@ def _valid_mask(valid_hw, block_hw):
 
 
 def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
-                     correlate_padded):
-    """One iteration on a local block: halo pad → stencil → [quantize] → mask."""
+                     backend: str):
+    """One iteration on a local block: halo pad → stencil → [quantize] → mask.
+
+    The block dtype is the *storage* dtype (f32, or bf16 — exact for
+    quantized u8 values, half the HBM/ICI traffic); accumulation is always
+    f32 inside the correlate implementations.
+    """
     needs_mask = (valid_hw[0] != block_hw[0] * grid[0]
                   or valid_hw[1] != block_hw[1] * grid[1])
 
     def step(v):
         padded = halo.halo_exchange(v, filt.radius, grid)
-        out = correlate_padded(padded, filt)
-        if quantize:
-            out = conv.quantize_f32(out)
+        if backend == "pallas":
+            from parallel_convolution_tpu.ops import pallas_stencil
+
+            out = pallas_stencil.correlate_padded_pallas(
+                padded, filt, quantize=quantize, out_dtype=v.dtype
+            )
+        else:
+            out = _correlate_for_backend(backend)(padded, filt)
+            if quantize:
+                out = conv.quantize_f32(out)
         if needs_mask:
-            out = out * _valid_mask(valid_hw, block_hw)
-        return out
+            out = out * _valid_mask(valid_hw, block_hw).astype(out.dtype)
+        return out.astype(v.dtype)
 
     return step
 
@@ -86,8 +98,7 @@ def _build_iterate(mesh: Mesh, filt: Filter, iters: int, quantize: bool,
     """Compile the fixed-count iteration runner for one (mesh, config)."""
     grid = grid_shape(mesh)
     _check_block_size(filt, block_hw)
-    correlate = _correlate_for_backend(backend)
-    step = _make_block_step(filt, grid, valid_hw, block_hw, quantize, correlate)
+    step = _make_block_step(filt, grid, valid_hw, block_hw, quantize, backend)
 
     def body(block):
         return lax.fori_loop(0, iters, lambda _, v: step(v), block)
@@ -105,8 +116,7 @@ def _build_converge(mesh: Mesh, filt: Filter, tol: float, max_iters: int,
     """Compile the run-to-convergence runner (C6: every-N diff + allreduce)."""
     grid = grid_shape(mesh)
     _check_block_size(filt, block_hw)
-    correlate = _correlate_for_backend(backend)
-    step = _make_block_step(filt, grid, valid_hw, block_hw, quantize, correlate)
+    step = _make_block_step(filt, grid, valid_hw, block_hw, quantize, backend)
 
     def body(block):
         def chunk(carry):
@@ -120,7 +130,8 @@ def _build_converge(mesh: Mesh, filt: Filter, tol: float, max_iters: int,
 
             prev, cur = lax.fori_loop(0, n, inner, (cur, cur))
             # The MPI_Allreduce: global max of one iteration's change.
-            diff = lax.pmax(jnp.max(jnp.abs(cur - prev)), AXES)
+            delta = jnp.abs(cur.astype(jnp.float32) - prev.astype(jnp.float32))
+            diff = lax.pmax(jnp.max(delta), AXES)
             return cur, done + n, diff
 
         def cond(carry):
@@ -138,21 +149,21 @@ def _build_converge(mesh: Mesh, filt: Filter, tol: float, max_iters: int,
     return jax.jit(sharded, donate_argnums=0)
 
 
+BACKENDS = ("shifted", "xla_conv", "pallas")
+STORAGE_DTYPES = {"f32": jnp.float32, "bf16": jnp.bfloat16}
+
+
 def _correlate_for_backend(backend: str):
     if backend == "shifted":
         return conv.correlate_padded
     if backend == "xla_conv":
         return _correlate_padded_xla
-    if backend == "pallas":
-        from parallel_convolution_tpu.ops import pallas_stencil
-
-        return pallas_stencil.correlate_padded_pallas
-    raise ValueError(f"unknown backend {backend!r}")
+    raise ValueError(f"unknown backend {backend!r}; choose from {BACKENDS}")
 
 
 def _correlate_padded_xla(padded: jnp.ndarray, filt: Filter) -> jnp.ndarray:
     r = filt.radius
-    lhs = padded[:, None, :, :]
+    lhs = padded.astype(jnp.float32)[:, None, :, :]
     rhs = jnp.asarray(filt.taps, jnp.float32)[None, None]
     out = lax.conv_general_dilated(
         lhs, rhs, (1, 1), "VALID", dimension_numbers=("NCHW", "OIHW", "NCHW"),
@@ -161,9 +172,9 @@ def _correlate_padded_xla(padded: jnp.ndarray, filt: Filter) -> jnp.ndarray:
     return out[:, 0]
 
 
-def _prepare(x, mesh: Mesh, r: int):
-    """Pad a global (C, H, W) f32 image to block multiples and shard it."""
-    x = jnp.asarray(x, jnp.float32)
+def _prepare(x, mesh: Mesh, r: int, storage: str = "f32"):
+    """Pad a global (C, H, W) image to block multiples and shard it."""
+    x = jnp.asarray(x, STORAGE_DTYPES[storage])
     C, H, W = x.shape
     R, Cc = grid_shape(mesh)
     Hp, Wp = padded_extent(H, R), padded_extent(W, Cc)
@@ -190,26 +201,34 @@ def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
 
 
 def sharded_iterate(x, filt: Filter, iters: int, mesh: Mesh | None = None,
-                    quantize: bool = True, backend: str = "shifted"):
+                    quantize: bool = True, backend: str = "shifted",
+                    storage: str = "f32"):
     """Run ``iters`` stencil iterations of a global (C, H, W) f32 image
     sharded over the 2D mesh.  Returns the global (C, H, W) f32 result
-    (bit-identical to the serial oracle for any mesh shape)."""
+    (bit-identical to the serial oracle for any mesh shape).
+
+    ``storage='bf16'`` halves HBM/ICI traffic by carrying the state in
+    bfloat16 between iterations — still bit-exact in quantize mode (u8
+    values are exact in bf16); in float mode it is a documented
+    precision/bandwidth trade.
+    """
     if mesh is None:
         mesh = make_grid_mesh()
-    xs, valid_hw, block_hw = _prepare(x, mesh, filt.radius)
+    xs, valid_hw, block_hw = _prepare(x, mesh, filt.radius, storage)
     out = iterate_prepared(xs, filt, iters, mesh, valid_hw,
                            quantize=quantize, backend=backend)
-    return out[:, : valid_hw[0], : valid_hw[1]]
+    return out[:, : valid_hw[0], : valid_hw[1]].astype(jnp.float32)
 
 
 def sharded_converge(x, filt: Filter, tol: float, max_iters: int,
                      check_every: int = 1, mesh: Mesh | None = None,
-                     quantize: bool = False, backend: str = "shifted"):
+                     quantize: bool = False, backend: str = "shifted",
+                     storage: str = "f32"):
     """Run-to-convergence (BASELINE config 5).  Returns (result, iters_run)."""
     if mesh is None:
         mesh = make_grid_mesh()
-    xs, valid_hw, block_hw = _prepare(x, mesh, filt.radius)
+    xs, valid_hw, block_hw = _prepare(x, mesh, filt.radius, storage)
     fn = _build_converge(mesh, filt, float(tol), int(max_iters),
                          int(check_every), quantize, valid_hw, block_hw, backend)
     out, done = fn(xs)
-    return out[:, : valid_hw[0], : valid_hw[1]], int(done)
+    return out[:, : valid_hw[0], : valid_hw[1]].astype(jnp.float32), int(done)

@@ -50,6 +50,7 @@ def bench_iterate(
     channels: int = 1,
     backend: str = "shifted",
     quantize: bool = True,
+    storage: str = "f32",
     reps: int = 3,
 ) -> dict:
     """Gpixels/sec/chip for the standard fixed-iteration workload."""
@@ -61,7 +62,8 @@ def bench_iterate(
 
     def run(v):
         return step_lib.sharded_iterate(
-            v, filt, iters, mesh=mesh, quantize=quantize, backend=backend
+            v, filt, iters, mesh=mesh, quantize=quantize, backend=backend,
+            storage=storage,
         )
 
     secs = wall(run, x, reps=reps)
@@ -70,6 +72,7 @@ def bench_iterate(
     return {
         "workload": f"{filt.name} {H}x{W}x{channels} {iters} iters",
         "backend": backend,
+        "storage": storage,
         "mesh": "x".join(str(s) for s in grid_shape(mesh)),
         "devices": n_dev,
         "wall_s": round(secs, 4),

@@ -1,0 +1,58 @@
+"""bf16 storage mode: half the bandwidth, still bit-exact for u8 images."""
+
+import jax
+import numpy as np
+import pytest
+
+from parallel_convolution_tpu.ops import filters, oracle
+from parallel_convolution_tpu.parallel import mesh as mesh_lib, step
+from parallel_convolution_tpu.utils import imageio
+
+
+def _mesh(shape):
+    return mesh_lib.make_grid_mesh(jax.devices()[: shape[0] * shape[1]], shape)
+
+
+@pytest.mark.parametrize("backend", ["shifted", "xla_conv", "pallas"])
+def test_bf16_bitexact_quantized(grey_odd, backend):
+    filt = filters.get_filter("blur3")
+    want = oracle.run_serial_u8(grey_odd, filt, 6)
+    x = imageio.interleaved_to_planar(grey_odd).astype(np.float32)
+    out = step.sharded_iterate(x, filt, 6, mesh=_mesh((2, 4)),
+                               quantize=True, backend=backend,
+                               storage="bf16")
+    got = imageio.planar_to_interleaved(np.asarray(out).astype(np.uint8))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_bf16_rgb_gaussian5(rgb_odd):
+    filt = filters.get_filter("gaussian5")
+    want = oracle.run_serial_u8(rgb_odd, filt, 3)
+    x = imageio.interleaved_to_planar(rgb_odd).astype(np.float32)
+    out = step.sharded_iterate(x, filt, 3, mesh=_mesh((2, 2)),
+                               quantize=True, storage="bf16")
+    got = imageio.planar_to_interleaved(np.asarray(out).astype(np.uint8))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_bf16_converge_quantized(grey_small):
+    # convergence machinery under bf16 carries: exact integer diffs
+    filt = filters.get_filter("blur3")
+    x = imageio.interleaved_to_planar(grey_small).astype(np.float32)
+    out_a, it_a = step.sharded_converge(x, filt, tol=0.5, max_iters=300,
+                                        check_every=5, mesh=_mesh((2, 2)),
+                                        quantize=True, storage="bf16")
+    out_b, it_b = step.sharded_converge(x, filt, tol=0.5, max_iters=300,
+                                        check_every=5, mesh=_mesh((2, 2)),
+                                        quantize=True, storage="f32")
+    assert it_a == it_b
+    np.testing.assert_array_equal(np.asarray(out_a), np.asarray(out_b))
+
+
+def test_bf16_model_api(grey_small):
+    from parallel_convolution_tpu.models import ConvolutionModel
+
+    m = ConvolutionModel(filt="blur3", mesh=_mesh((2, 2)), storage="bf16")
+    got = m.run_image(grey_small, 5)
+    want = oracle.run_serial_u8(grey_small, filters.get_filter("blur3"), 5)
+    np.testing.assert_array_equal(got, want)
