@@ -53,6 +53,8 @@ def main(argv: list[str] | None = None) -> int:
     run.add_argument("--storage", default="f32", choices=["f32", "bf16"],
                      help="iteration-carry dtype; bf16 halves HBM/ICI "
                           "traffic and stays bit-exact for u8 images")
+    run.add_argument("--fuse", type=int, default=1, metavar="T",
+                     help="iterations per halo exchange (temporal fusion)")
     run.add_argument("--converge", type=float, default=None, metavar="TOL",
                      help="run to convergence (loops becomes max iters)")
     run.add_argument("--check-every", type=int, default=10)
@@ -152,7 +154,8 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     model = ConvolutionModel(filt=args.filter_name, mesh=mesh,
-                             backend=args.backend, storage=args.storage)
+                             backend=args.backend, storage=args.storage,
+                             fuse=args.fuse)
     if args.checkpoint:
         from parallel_convolution_tpu.parallel import step as step_lib
         from parallel_convolution_tpu.utils import checkpoint, sharded_io

@@ -42,6 +42,8 @@ class ConvolutionModel:
     quantize: bool = True
     storage: str = "f32"  # 'bf16' halves HBM/ICI traffic, still bit-exact
     #                        in quantize mode (u8 values are exact in bf16)
+    fuse: int = 1  # iterations per halo exchange (temporal fusion, T*r-deep
+    #                halos once instead of r-deep every iteration)
 
     def __post_init__(self) -> None:
         if isinstance(self.filt, str):
@@ -55,7 +57,7 @@ class ConvolutionModel:
         return step_lib.sharded_iterate(
             x, self.filt, iters, mesh=self.mesh,
             quantize=self.quantize, backend=self.backend,
-            storage=self.storage,
+            storage=self.storage, fuse=self.fuse,
         )
 
     def run_image(self, img: np.ndarray, iters: int) -> np.ndarray:

@@ -43,20 +43,38 @@ from parallel_convolution_tpu.parallel.mesh import (
 )
 
 
-def _valid_mask(valid_hw, block_hw):
-    """Per-block (1, bh, bw) mask of globally-valid pixels (pad region = 0)."""
+def _valid_mask(valid_hw, block_hw, margin: int = 0):
+    """Per-block validity mask of globally-in-image pixels, as (1, h, w) f32.
+
+    ``margin`` extends the block by m on every side (the temporal-fusion
+    intermediate levels live on such extended blocks); positions outside
+    the valid global image — beyond the image edge *or* in the
+    pad-to-multiple rim — are 0.
+    """
     H, W = valid_hw
     bh, bw = block_hw
-    row0 = lax.axis_index("x") * bh
-    col0 = lax.axis_index("y") * bw
-    rows = row0 + lax.broadcasted_iota(jnp.int32, (bh, bw), 0)
-    cols = col0 + lax.broadcasted_iota(jnp.int32, (bh, bw), 1)
-    return ((rows < H) & (cols < W))[None].astype(jnp.float32)
+    m = margin
+    row0 = lax.axis_index("x") * bh - m
+    col0 = lax.axis_index("y") * bw - m
+    shape = (bh + 2 * m, bw + 2 * m)
+    rows = row0 + lax.broadcasted_iota(jnp.int32, shape, 0)
+    cols = col0 + lax.broadcasted_iota(jnp.int32, shape, 1)
+    ok = (rows >= 0) & (rows < H) & (cols >= 0) & (cols < W)
+    return ok[None].astype(jnp.float32)
 
 
 def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
-                     backend: str):
-    """One iteration on a local block: halo pad → stencil → [quantize] → mask.
+                     backend: str, fuse: int = 1):
+    """``fuse`` iterations on a local block per halo exchange.
+
+    fuse=1 is the reference's loop shape: exchange 1-deep halos, stencil,
+    [quantize], re-mask.  fuse=T>1 is temporal fusion: exchange a T*r-deep
+    halo ONCE, then run T stencil levels locally, each shrinking the
+    extended block by r — T× fewer collective rounds (the latency bound of
+    small blocks, SURVEY.md §3.2) at the cost of recomputing the
+    overlapping rim.  Bit-exactness is preserved because each level
+    re-zeroes out-of-image positions via the margin mask, exactly
+    reproducing the oracle's ghost ring at every intermediate level.
 
     The block dtype is the *storage* dtype (f32, or bf16 — exact for
     quantized u8 values, half the HBM/ICI traffic); accumulation is always
@@ -64,22 +82,29 @@ def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
     """
     needs_mask = (valid_hw[0] != block_hw[0] * grid[0]
                   or valid_hw[1] != block_hw[1] * grid[1])
+    r = filt.radius
 
-    def step(v):
-        padded = halo.halo_exchange(v, filt.radius, grid)
+    def correlate_level(p, out_dtype):
         if backend == "pallas":
             from parallel_convolution_tpu.ops import pallas_stencil
 
-            out = pallas_stencil.correlate_padded_pallas(
-                padded, filt, quantize=quantize, out_dtype=v.dtype
+            return pallas_stencil.correlate_padded_pallas(
+                p, filt, quantize=quantize, out_dtype=out_dtype
             )
-        else:
-            out = _correlate_for_backend(backend)(padded, filt)
-            if quantize:
-                out = conv.quantize_f32(out)
-        if needs_mask:
-            out = out * _valid_mask(valid_hw, block_hw).astype(out.dtype)
-        return out.astype(v.dtype)
+        out = _correlate_for_backend(backend)(p, filt)
+        if quantize:
+            out = conv.quantize_f32(out)
+        return out
+
+    def step(v):
+        depth = r * fuse
+        p = halo.halo_exchange(v, depth, grid)
+        for t in range(fuse):
+            margin = depth - r * (t + 1)
+            p = correlate_level(p, v.dtype)
+            if needs_mask or margin > 0:
+                p = p * _valid_mask(valid_hw, block_hw, margin).astype(p.dtype)
+        return p.astype(v.dtype)
 
     return step
 
@@ -94,14 +119,26 @@ def _check_block_size(filt: Filter, block_hw) -> None:
 
 @lru_cache(maxsize=64)
 def _build_iterate(mesh: Mesh, filt: Filter, iters: int, quantize: bool,
-                   valid_hw, block_hw, backend: str):
+                   valid_hw, block_hw, backend: str, fuse: int = 1):
     """Compile the fixed-count iteration runner for one (mesh, config)."""
     grid = grid_shape(mesh)
     _check_block_size(filt, block_hw)
-    step = _make_block_step(filt, grid, valid_hw, block_hw, quantize, backend)
+    fuse = max(1, min(fuse, iters or 1))
+    if min(block_hw) < filt.radius * fuse:
+        raise ValueError(
+            f"fuse={fuse} needs blocks >= {filt.radius * fuse}, got {block_hw}"
+        )
+    chunk = _make_block_step(filt, grid, valid_hw, block_hw, quantize,
+                             backend, fuse)
+    n_chunks, rem = divmod(iters, fuse)
+    tail = (_make_block_step(filt, grid, valid_hw, block_hw, quantize,
+                             backend, rem) if rem else None)
 
     def body(block):
-        return lax.fori_loop(0, iters, lambda _, v: step(v), block)
+        block = lax.fori_loop(0, n_chunks, lambda _, v: chunk(v), block)
+        if tail is not None:
+            block = tail(block)
+        return block
 
     sharded = jax.shard_map(
         body, mesh=mesh, in_specs=P(None, *AXES), out_specs=P(None, *AXES)
@@ -186,7 +223,7 @@ def _prepare(x, mesh: Mesh, r: int, storage: str = "f32"):
 
 def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
                      valid_hw, quantize: bool = True,
-                     backend: str = "shifted"):
+                     backend: str = "shifted", fuse: int = 1):
     """Iterate an already-sharded padded (C, Hp, Wp) array in place(-ish).
 
     The zero-copy entry for huge images loaded via utils.sharded_io: input
@@ -196,13 +233,13 @@ def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
     R, Cc = grid_shape(mesh)
     block_hw = (xs.shape[1] // R, xs.shape[2] // Cc)
     fn = _build_iterate(mesh, filt, iters, quantize, tuple(valid_hw),
-                        block_hw, backend)
+                        block_hw, backend, fuse)
     return fn(xs)
 
 
 def sharded_iterate(x, filt: Filter, iters: int, mesh: Mesh | None = None,
                     quantize: bool = True, backend: str = "shifted",
-                    storage: str = "f32"):
+                    storage: str = "f32", fuse: int = 1):
     """Run ``iters`` stencil iterations of a global (C, H, W) f32 image
     sharded over the 2D mesh.  Returns the global (C, H, W) f32 result
     (bit-identical to the serial oracle for any mesh shape).
@@ -216,7 +253,7 @@ def sharded_iterate(x, filt: Filter, iters: int, mesh: Mesh | None = None,
         mesh = make_grid_mesh()
     xs, valid_hw, block_hw = _prepare(x, mesh, filt.radius, storage)
     out = iterate_prepared(xs, filt, iters, mesh, valid_hw,
-                           quantize=quantize, backend=backend)
+                           quantize=quantize, backend=backend, fuse=fuse)
     return out[:, : valid_hw[0], : valid_hw[1]].astype(jnp.float32)
 
 

@@ -51,6 +51,7 @@ def bench_iterate(
     backend: str = "shifted",
     quantize: bool = True,
     storage: str = "f32",
+    fuse: int = 1,
     reps: int = 3,
 ) -> dict:
     """Gpixels/sec/chip for the standard fixed-iteration workload."""
@@ -63,7 +64,7 @@ def bench_iterate(
     def run(v):
         return step_lib.sharded_iterate(
             v, filt, iters, mesh=mesh, quantize=quantize, backend=backend,
-            storage=storage,
+            storage=storage, fuse=fuse,
         )
 
     secs = wall(run, x, reps=reps)
@@ -73,6 +74,7 @@ def bench_iterate(
         "workload": f"{filt.name} {H}x{W}x{channels} {iters} iters",
         "backend": backend,
         "storage": storage,
+        "fuse": fuse,
         "mesh": "x".join(str(s) for s in grid_shape(mesh)),
         "devices": n_dev,
         "wall_s": round(secs, 4),

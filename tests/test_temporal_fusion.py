@@ -1,0 +1,89 @@
+"""Temporal fusion (fuse=T): T iterations per halo exchange, bit-exact."""
+
+import jax
+import numpy as np
+import pytest
+
+from parallel_convolution_tpu.ops import filters, oracle
+from parallel_convolution_tpu.parallel import mesh as mesh_lib, step
+from parallel_convolution_tpu.utils import imageio
+
+
+def _mesh(shape):
+    return mesh_lib.make_grid_mesh(jax.devices()[: shape[0] * shape[1]], shape)
+
+
+def _run(img, filt, iters, mshape, **kw):
+    x = imageio.interleaved_to_planar(img).astype(np.float32)
+    out = step.sharded_iterate(x, filt, iters, mesh=_mesh(mshape),
+                               quantize=True, **kw)
+    return imageio.planar_to_interleaved(np.asarray(out).astype(np.uint8))
+
+
+@pytest.mark.parametrize("fuse", [2, 3, 5])
+def test_fused_bitexact_vs_oracle(grey_odd, fuse):
+    filt = filters.get_filter("blur3")
+    want = oracle.run_serial_u8(grey_odd, filt, 10)
+    got = _run(grey_odd, filt, 10, (2, 4), fuse=fuse)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_fused_remainder_path(grey_odd):
+    # 7 iters, fuse 3 -> chunks 3+3 then tail of 1
+    filt = filters.get_filter("blur3")
+    want = oracle.run_serial_u8(grey_odd, filt, 7)
+    got = _run(grey_odd, filt, 7, (2, 2), fuse=3)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_fused_radius2_rgb(rgb_odd):
+    filt = filters.get_filter("gaussian5")
+    want = oracle.run_serial_u8(rgb_odd, filt, 4)
+    got = _run(rgb_odd, filt, 4, (2, 2), fuse=2)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_fused_bf16(grey_odd):
+    filt = filters.get_filter("blur3")
+    want = oracle.run_serial_u8(grey_odd, filt, 8)
+    got = _run(grey_odd, filt, 8, (2, 4), fuse=4, storage="bf16")
+    np.testing.assert_array_equal(got, want)
+
+
+def test_fused_pallas_backend(grey_odd):
+    filt = filters.get_filter("blur3")
+    want = oracle.run_serial_u8(grey_odd, filt, 6)
+    got = _run(grey_odd, filt, 6, (2, 2), fuse=3, backend="pallas")
+    np.testing.assert_array_equal(got, want)
+
+
+def test_fuse_too_deep_raises(grey_small):
+    filt = filters.get_filter("blur3")
+    x = imageio.interleaved_to_planar(grey_small).astype(np.float32)
+    with pytest.raises(ValueError, match="fuse"):
+        # 24-row image on 8-row grid -> 3-row blocks; fuse=20 needs 20-deep
+        step.sharded_iterate(x, filt, 40, mesh=_mesh((8, 1)), fuse=20)
+
+
+def test_fused_halo_exchanges_deep_slabs(grey_small):
+    # fuse=5 must exchange 5-deep halo slabs once per chunk (1/5 the
+    # collective rounds of fuse=1, whose slabs are 1-deep).
+    import re
+
+    filt = filters.get_filter("blur3")
+    m = _mesh((2, 2))
+    x = imageio.interleaved_to_planar(grey_small).astype(np.float32)
+    xs, valid_hw, block_hw = step._prepare(x, m, 1)
+
+    def slab_depths(fuse):
+        fn = step._build_iterate(m, filt, 10, True, valid_hw, block_hw,
+                                 "shifted", fuse)
+        hlo = fn.lower(xs).compile().as_text()
+        shapes = re.findall(
+            r"f32\[1,(\d+),(\d+)\][^\n]*collective-permute", hlo
+        )
+        assert shapes, "no collective-permute in HLO"
+        return {min(int(a), int(b)) for a, b in shapes}
+
+    assert slab_depths(1) == {1}
+    assert slab_depths(5) == {5}
