@@ -87,3 +87,50 @@ def test_fused_halo_exchanges_deep_slabs(grey_small):
 
     assert slab_depths(1) == {1}
     assert slab_depths(5) == {5}
+
+
+@pytest.mark.parametrize("fuse", [2, 4])
+@pytest.mark.parametrize("storage", ["f32", "bf16"])
+def test_fused_pallas_kernel_bitexact(grey_odd, fuse, storage):
+    # The in-VMEM multi-level kernel path (backend=pallas, fuse>1).
+    filt = filters.get_filter("blur3")
+    want = oracle.run_serial_u8(grey_odd, filt, 8)
+    got = _run(grey_odd, filt, 8, (2, 4), fuse=fuse, backend="pallas",
+               storage=storage)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_fused_pallas_kernel_rgb_gaussian5(rgb_odd):
+    filt = filters.get_filter("gaussian5")
+    want = oracle.run_serial_u8(rgb_odd, filt, 4)
+    got = _run(rgb_odd, filt, 4, (2, 2), fuse=2, backend="pallas")
+    np.testing.assert_array_equal(got, want)
+
+
+def test_fused_pallas_kernel_float_mode():
+    filt = filters.get_filter("jacobi3")
+    img = imageio.generate_test_image(32, 40, "grey", seed=41)
+    want = oracle.run_serial_f32(img.astype(np.float32), filt, 6)
+    x = img[None].astype(np.float32)
+    out = step.sharded_iterate(x, filt, 6, mesh=_mesh((2, 2)),
+                               quantize=False, backend="pallas", fuse=3)
+    np.testing.assert_array_equal(np.asarray(out)[0], want)
+
+
+def test_fused_pallas_multi_tile():
+    # Block large enough to need a multi-tile pallas grid inside shard_map.
+    filt = filters.get_filter("blur3")
+    img = imageio.generate_test_image(48, 300, "grey", seed=42)
+    want = oracle.run_serial_u8(img, filt, 4)
+    x = imageio.interleaved_to_planar(img).astype(np.float32)
+    from parallel_convolution_tpu.ops import pallas_stencil
+    old = pallas_stencil.DEFAULT_TILE
+    pallas_stencil.DEFAULT_TILE = (16, 128)
+    try:
+        out = step._build_iterate.__wrapped__(
+            _mesh((2, 2)), filt, 4, True, (48, 300), (24, 150), "pallas", 2
+        )(step._prepare(x, _mesh((2, 2)), 1)[0])
+    finally:
+        pallas_stencil.DEFAULT_TILE = old
+    got = np.asarray(out)[:, :48, :300].astype(np.uint8)
+    np.testing.assert_array_equal(got[0], want)
