@@ -82,6 +82,16 @@ def main(argv: list[str] | None = None) -> int:
     cmp_.add_argument("a")
     cmp_.add_argument("b")
 
+    conv_ = sub.add_parser(
+        "convert", help="raw -> PGM/PPM for visual inspection (no deps)"
+    )
+    conv_.add_argument("image")
+    conv_.add_argument("rows", type=int)
+    conv_.add_argument("cols", type=int)
+    conv_.add_argument("mode", choices=["grey", "rgb"])
+    conv_.add_argument("-o", "--output", required=True,
+                       help=".pgm (grey) or .ppm (rgb) path")
+
     sub.add_parser("info", help="devices, default mesh, filters")
 
     args = ap.parse_args(argv)
@@ -108,6 +118,15 @@ def main(argv: list[str] | None = None) -> int:
             print(f"differ: {n} bytes ({100.0 * n / a.size:.4f}%), "
                   f"max delta {int(np.abs(a.astype(int) - b.astype(int)).max())}")
         return 1
+
+    if args.cmd == "convert":
+        img = imageio.read_raw(args.image, args.rows, args.cols, args.mode)
+        magic = b"P5" if args.mode == "grey" else b"P6"
+        with open(args.output, "wb") as f:
+            f.write(magic + b"\n%d %d\n255\n" % (args.cols, args.rows))
+            f.write(np.ascontiguousarray(img).tobytes())
+        print(f"wrote {args.output} ({'PGM' if args.mode == 'grey' else 'PPM'})")
+        return 0
 
     if args.cmd == "info":
         import jax

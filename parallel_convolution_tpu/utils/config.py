@@ -1,0 +1,68 @@
+"""Run configuration (SURVEY.md §5 'config / flag system').
+
+The reference's configuration is argv/stdin plus compile-time constants.
+Here a single dataclass captures a full run — image geometry, filter,
+mesh, backend knobs — serializable to/from JSON so runs are reproducible
+artifacts (the sidecar `utils.checkpoint` writes is a subset of this).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+
+@dataclasses.dataclass
+class RunConfig:
+    """Everything needed to reproduce one filtering run."""
+
+    rows: int
+    cols: int
+    mode: str = "grey"            # grey | rgb
+    filter_name: str = "blur3"
+    iters: int = 100
+    mesh_shape: tuple[int, int] | None = None   # None = all devices
+    backend: str = "shifted"       # shifted | pallas | xla_conv
+    storage: str = "f32"           # f32 | bf16
+    fuse: int = 1
+    quantize: bool = True
+    converge_tol: float | None = None
+    check_every: int = 10
+    sharded_io: bool = False
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 100
+
+    def __post_init__(self) -> None:
+        if self.mode not in ("grey", "rgb"):
+            raise ValueError(f"mode must be grey|rgb, got {self.mode!r}")
+        if self.storage not in ("f32", "bf16"):
+            raise ValueError(f"storage must be f32|bf16, got {self.storage!r}")
+        if self.backend not in ("shifted", "pallas", "xla_conv"):
+            raise ValueError(f"unknown backend {self.backend!r}")
+        if self.rows <= 0 or self.cols <= 0 or self.iters < 0 or self.fuse < 1:
+            raise ValueError("rows/cols must be positive, iters >= 0, fuse >= 1")
+        if self.mesh_shape is not None:
+            self.mesh_shape = tuple(self.mesh_shape)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "RunConfig":
+        return cls(**json.loads(text))
+
+    def build_model(self):
+        """Instantiate the ConvolutionModel this config describes."""
+        from parallel_convolution_tpu.models import ConvolutionModel
+        from parallel_convolution_tpu.parallel.mesh import make_grid_mesh
+
+        mesh = None
+        if self.mesh_shape is not None:
+            import jax
+
+            r, c = self.mesh_shape
+            mesh = make_grid_mesh(jax.devices()[: r * c], (r, c))
+        return ConvolutionModel(
+            filt=self.filter_name, mesh=mesh, backend=self.backend,
+            quantize=self.quantize, storage=self.storage, fuse=self.fuse,
+        )

@@ -1,0 +1,63 @@
+"""Numeric-safety tooling (SURVEY.md §5 'race detection / sanitizers').
+
+Races are impossible by construction in this framework (pure functional
+JAX; the reference's OpenMP loop needed its no-shared-writes discipline),
+so the sanitizer tier here guards the remaining failure class: numeric
+corruption — NaN/Inf escaping a kernel, or u8-mode values leaving
+[0, 255].
+
+* :func:`checked_correlate` — ``checkify``-wrapped stencil step that turns
+  NaN/Inf into a Python-level error instead of silent propagation.
+* :func:`assert_u8_range` / :func:`find_nonfinite` — host-side validators
+  used by tests and debugging sessions.
+* For Pallas-kernel debugging, run with ``interpret=True`` (exact same
+  kernel code on CPU) — see ops/pallas_stencil.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import checkify
+
+from parallel_convolution_tpu.ops import conv
+from parallel_convolution_tpu.ops.filters import Filter
+
+
+def checked_correlate(x: jnp.ndarray, filt: Filter):
+    """One stencil step with NaN/Inf checking compiled in.
+
+    Returns the output; raises ``checkify.JaxRuntimeError`` describing the
+    first non-finite value if the input (or filter) produced one.
+    """
+
+    def f(v):
+        out = conv.correlate_shifted(v, filt)
+        checkify.check(
+            jnp.isfinite(out).all(), "non-finite value in stencil output"
+        )
+        return out
+
+    err, out = checkify.checkify(jax.jit(f))(x)
+    err.throw()
+    return out
+
+
+def assert_u8_range(arr) -> None:
+    """Validate the u8-mode invariant: exact integers in [0, 255]."""
+    a = np.asarray(arr)
+    if a.size == 0:
+        return
+    bad = ~((a >= 0) & (a <= 255) & (a == np.rint(a)))
+    if bad.any():
+        idx = tuple(int(i) for i in np.argwhere(bad)[0])
+        raise AssertionError(
+            f"u8-mode invariant violated at {idx}: value {a[bad][0]!r}"
+        )
+
+
+def find_nonfinite(arr) -> list[tuple]:
+    """Indices (up to 10) of NaN/Inf values, for post-mortem debugging."""
+    a = np.asarray(arr)
+    return [tuple(int(i) for i in ix) for ix in np.argwhere(~np.isfinite(a))[:10]]

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Multi-host TPU pod launch (the reference's mpiexec/PBS tier, C12).
+#
+# The reference launched `mpiexec -np N ./mpi_conv ...` via qsub; on a TPU
+# pod slice each host runs the SAME command and JAX's multi-controller
+# runtime plays the role of MPI_Init (see parallel/multihost.py):
+#
+#   gcloud compute tpus tpu-vm ssh $TPU_NAME --worker=all --command "
+#     cd parallel-convolution-tpu &&
+#     python -c '
+# from parallel_convolution_tpu.parallel import multihost
+# multihost.initialize()                      # MPI_Init analog
+# import sys
+# from parallel_convolution_tpu import cli
+# sys.exit(cli.main(sys.argv[1:]))
+# ' run big.raw 65536 65536 100 rgb -o out.raw --sharded-io --backend pallas --fuse 8
+#   "
+#
+# Every host reads/writes only its own devices' blocks (utils/sharded_io
+# touches addressable_shards only), so the raw file can live on a shared
+# filesystem (GCS fuse, NFS) exactly like the reference's cluster scratch.
+#
+# Single-host smoke version of the same flow:
+set -euo pipefail
+IMG=${1:-/tmp/pconv_demo.raw}
+python -m parallel_convolution_tpu.cli generate "$IMG" 1920 2520 grey
+python -m parallel_convolution_tpu.cli run "$IMG" 1920 2520 100 grey \
+  -o "${IMG%.raw}_out.raw" --backend pallas --fuse 8 --storage bf16
+python -m parallel_convolution_tpu.cli serial "$IMG" 1920 2520 100 grey \
+  -o "${IMG%.raw}_serial.raw"
+python -m parallel_convolution_tpu.cli compare \
+  "${IMG%.raw}_out.raw" "${IMG%.raw}_serial.raw"

@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Benchmark sweep (the reference's PBS/qsub process-count sweeps, C12).
+
+The reference's cluster scripts launched ``mpiexec -np {1,4,9,16,...}``
+and its README tables were filled by hand; this sweep walks mesh shapes ×
+backends × fusion depths on whatever devices are attached and emits
+machine-readable rows (JSONL) plus a markdown table for BASELINE.md.
+
+Usage:
+  python scripts/sweep.py                       # quick sweep, current devices
+  python scripts/sweep.py --size 4096 --iters 50 --out sweep.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=2048)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--reps", type=int, default=2)
+    ap.add_argument("--out", default=None, help="JSONL output path")
+    ap.add_argument("--platform", default=None,
+                    help="force jax platform (e.g. cpu)")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        try:
+            jax.config.update("jax_platforms", args.platform)
+        except Exception:
+            pass
+
+    from parallel_convolution_tpu.ops.filters import get_filter
+    from parallel_convolution_tpu.parallel.mesh import dims_create, make_grid_mesh
+    from parallel_convolution_tpu.utils import bench
+
+    n = len(jax.devices())
+    mesh_shapes = sorted(
+        {(1, 1), dims_create(n), (1, n), (n, 1)} if n > 1 else {(1, 1)}
+    )
+    filt = get_filter("blur3")
+    rows = []
+    for shape in mesh_shapes:
+        ndev = shape[0] * shape[1]
+        mesh = make_grid_mesh(jax.devices()[:ndev], shape)
+        for backend in ("shifted", "pallas", "xla_conv"):
+            for storage in ("f32", "bf16"):
+                for fuse in (1, 4):
+                    try:
+                        row = bench.bench_iterate(
+                            (args.size, args.size), filt, args.iters,
+                            mesh=mesh, backend=backend, storage=storage,
+                            fuse=fuse, reps=args.reps,
+                        )
+                    except Exception as e:
+                        row = {"mesh": f"{shape[0]}x{shape[1]}",
+                               "backend": backend, "storage": storage,
+                               "fuse": fuse, "error": repr(e)[:120]}
+                    rows.append(row)
+                    print(json.dumps(row), flush=True)
+
+    if args.out:
+        with open(args.out, "w") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+
+    ok = [r for r in rows if "error" not in r]
+    if ok:
+        print("\n| mesh | backend | storage | fuse | Gpx/s | Gpx/s/chip |",
+              file=sys.stderr)
+        print("|---|---|---|---|---|---|", file=sys.stderr)
+        for r in sorted(ok, key=lambda r: -r["gpixels_per_s"]):
+            print(f"| {r['mesh']} | {r['backend']} | {r['storage']} | "
+                  f"{r['fuse']} | {r['gpixels_per_s']} | "
+                  f"{r['gpixels_per_s_per_chip']} |", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,77 @@
+import numpy as np
+import pytest
+
+from parallel_convolution_tpu import cli
+from parallel_convolution_tpu.ops import filters
+from parallel_convolution_tpu.utils import debug
+from parallel_convolution_tpu.utils.config import RunConfig
+
+
+def test_config_roundtrip():
+    c = RunConfig(rows=100, cols=200, mode="rgb", backend="pallas",
+                  mesh_shape=(2, 4), fuse=4, storage="bf16")
+    c2 = RunConfig.from_json(c.to_json())
+    assert c2 == c
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="grey"):
+        RunConfig(rows=1, cols=1, mode="cmyk")
+    with pytest.raises(ValueError, match="backend"):
+        RunConfig(rows=1, cols=1, backend="cuda")
+    with pytest.raises(ValueError, match="positive"):
+        RunConfig(rows=0, cols=1)
+
+
+def test_config_build_model(grey_small):
+    from parallel_convolution_tpu.ops import oracle
+
+    c = RunConfig(rows=24, cols=36, filter_name="blur3", mesh_shape=(2, 2))
+    model = c.build_model()
+    got = model.run_image(grey_small, 3)
+    want = oracle.run_serial_u8(grey_small, filters.get_filter("blur3"), 3)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_checked_correlate_clean(grey_small):
+    x = grey_small[None].astype(np.float32)
+    out = debug.checked_correlate(x, filters.get_filter("blur3"))
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_checked_correlate_catches_nan():
+    from jax.experimental import checkify
+
+    x = np.ones((1, 8, 8), np.float32)
+    x[0, 3, 3] = np.nan
+    with pytest.raises(checkify.JaxRuntimeError, match="non-finite"):
+        debug.checked_correlate(x, filters.get_filter("blur3"))
+
+
+def test_assert_u8_range():
+    debug.assert_u8_range(np.array([0.0, 255.0, 17.0]))
+    with pytest.raises(AssertionError, match="invariant"):
+        debug.assert_u8_range(np.array([0.0, 256.0]))
+    with pytest.raises(AssertionError):
+        debug.assert_u8_range(np.array([1.5]))
+
+
+def test_find_nonfinite():
+    a = np.zeros((4, 4))
+    a[1, 2] = np.inf
+    assert debug.find_nonfinite(a) == [(1, 2)]
+
+
+def test_cli_convert_pgm_ppm(tmp_path):
+    src = str(tmp_path / "in.raw")
+    cli.main(["generate", src, "10", "12", "grey"])
+    out = str(tmp_path / "img.pgm")
+    assert cli.main(["convert", src, "10", "12", "grey", "-o", out]) == 0
+    data = open(out, "rb").read()
+    assert data.startswith(b"P5\n12 10\n255\n") and len(data) == 13 + 120
+
+    src2 = str(tmp_path / "in2.raw")
+    cli.main(["generate", src2, "10", "12", "rgb"])
+    out2 = str(tmp_path / "img.ppm")
+    assert cli.main(["convert", src2, "10", "12", "rgb", "-o", out2]) == 0
+    assert open(out2, "rb").read().startswith(b"P6\n12 10\n255\n")
