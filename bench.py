@@ -50,16 +50,25 @@ def main() -> int:
     else:
         shape, iters, reps = (8192, 8192), 100, 3
 
+    configs = [
+        ("shifted", "f32", 1),
+        ("xla_conv", "f32", 1),
+        ("pallas", "f32", 1),
+        ("shifted", "bf16", 4),
+        ("pallas", "bf16", 8),
+    ]
     candidates = {}
-    for backend in ("shifted", "pallas", "xla_conv"):
+    for backend, storage, fuse in configs:
+        name = f"{backend}/{storage}/fuse{fuse}"
         try:
             row = bench.bench_iterate(
-                shape, filt, iters, mesh=mesh, backend=backend, reps=reps
+                shape, filt, iters, mesh=mesh, backend=backend,
+                storage=storage, fuse=fuse, reps=reps,
             )
-            candidates[backend] = row
-            print(f"# {backend}: {row}", file=sys.stderr)
+            candidates[name] = row
+            print(f"# {name}: {row}", file=sys.stderr)
         except Exception as e:  # keep the bench robust: one line, always
-            print(f"# {backend} failed: {e!r}", file=sys.stderr)
+            print(f"# {name} failed: {e!r}", file=sys.stderr)
     if not candidates:
         print(json.dumps({"metric": "Gpixels/sec/chip (3x3 conv, 100 iters)",
                           "value": 0.0, "unit": "Gpixels/s/chip",
