@@ -68,6 +68,23 @@ class ConvolutionModel:
             np.asarray(out).astype(np.uint8)
         )
 
+    def run_images(self, imgs, iters: int) -> list[np.ndarray]:
+        """Batch of same-sized images in one device program.
+
+        Channels are independent in the stencil, so a batch is just more
+        planes on the leading axis — the framework's data-parallel tier
+        (SURVEY.md §2 parallelism inventory: DP 'falls out free').
+        """
+        planar = [imageio.interleaved_to_planar(im) for im in imgs]
+        counts = [p.shape[0] for p in planar]
+        x = np.concatenate(planar, axis=0).astype(np.float32)
+        out = np.asarray(self.run_planar(x, iters)).astype(np.uint8)
+        res, i0 = [], 0
+        for c in counts:
+            res.append(imageio.planar_to_interleaved(out[i0 : i0 + c]))
+            i0 += c
+        return res
+
     # -- file-level API (the reference CLI contract) ------------------------
     def run_raw_file(
         self, src: str, dst: str, rows: int, cols: int, mode: str, iters: int

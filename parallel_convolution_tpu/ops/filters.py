@@ -63,6 +63,44 @@ class Filter:
         """Halo width this filter needs on each side (k // 2)."""
         return self.size // 2
 
+    def separable(self) -> tuple[np.ndarray, np.ndarray] | None:
+        """(col_taps, row_taps) 1D factors with ``outer(col, row) == taps``
+        EXACTLY in float32, or None.
+
+        Blur/Gaussian kernels are rank-1: ``taps = c ⊗ r`` lets the stencil
+        run as two 1D passes (2k MACs/px instead of k²).  Exactness of the
+        factorization (checked bit-for-bit) is what keeps the separable
+        path inside the bit-exact regime for dyadic filters.
+        """
+        t = self.taps
+        i0, j0 = np.unravel_index(np.argmax(np.abs(t)), t.shape)
+        piv = float(t[i0, j0])
+        if piv == 0.0:
+            return None
+        cands = []
+        if piv > 0:
+            # Symmetric sqrt normalization first: for kernels like
+            # gaussian5 it yields dyadic factors ([1,4,6,4,1]/16) where the
+            # pivot normalization would give inexact 1/6-style taps.
+            s = np.float32(np.sqrt(piv))
+            cands.append(((t[:, j0] / s).astype(np.float32),
+                          (t[i0, :] / s).astype(np.float32)))
+        cands.append((t[:, j0].astype(np.float32),
+                      (t[i0, :] / np.float32(piv)).astype(np.float32)))
+
+        def dyadic_1d(a):
+            scaled = a * 256.0
+            return bool(np.all(scaled == np.rint(scaled)))
+
+        exact = [
+            (col, row) for col, row in cands
+            if np.array_equal(np.outer(col, row).astype(np.float32), t)
+        ]
+        if not exact:
+            return None
+        exact.sort(key=lambda cr: not (dyadic_1d(cr[0]) and dyadic_1d(cr[1])))
+        return exact[0]
+
 
 def _f(name: str, taps, divisor: float | None = None, dyadic: bool = False) -> Filter:
     t = np.asarray(taps, dtype=np.float32)

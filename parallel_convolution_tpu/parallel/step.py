@@ -200,7 +200,7 @@ def _build_converge(mesh: Mesh, filt: Filter, tol: float, max_iters: int,
     return jax.jit(sharded, donate_argnums=0)
 
 
-BACKENDS = ("shifted", "xla_conv", "pallas")
+BACKENDS = ("shifted", "xla_conv", "pallas", "separable")
 STORAGE_DTYPES = {"f32": jnp.float32, "bf16": jnp.bfloat16}
 
 
@@ -209,6 +209,8 @@ def _correlate_for_backend(backend: str):
         return conv.correlate_padded
     if backend == "xla_conv":
         return _correlate_padded_xla
+    if backend == "separable":
+        return conv.correlate_padded_separable
     raise ValueError(f"unknown backend {backend!r}; choose from {BACKENDS}")
 
 

@@ -37,7 +37,7 @@ class RunConfig:
             raise ValueError(f"mode must be grey|rgb, got {self.mode!r}")
         if self.storage not in ("f32", "bf16"):
             raise ValueError(f"storage must be f32|bf16, got {self.storage!r}")
-        if self.backend not in ("shifted", "pallas", "xla_conv"):
+        if self.backend not in ("shifted", "pallas", "xla_conv", "separable"):
             raise ValueError(f"unknown backend {self.backend!r}")
         if self.rows <= 0 or self.cols <= 0 or self.iters < 0 or self.fuse < 1:
             raise ValueError("rows/cols must be positive, iters >= 0, fuse >= 1")
