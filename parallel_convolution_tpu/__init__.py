@@ -25,7 +25,7 @@ Layout
                vocabulary (C12).
 """
 
-from parallel_convolution_tpu.ops.filters import Filter, get_filter, FILTERS
+from parallel_convolution_tpu.ops.filters import FILTERS, Filter, get_filter
 from parallel_convolution_tpu.ops import oracle
 
 __version__ = "0.1.0"

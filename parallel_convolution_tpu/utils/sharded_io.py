@@ -15,8 +15,6 @@ deployment every host does this for its own shards only.
 
 from __future__ import annotations
 
-import math
-
 import jax
 import numpy as np
 from jax.sharding import Mesh

@@ -87,3 +87,19 @@ def test_multihost_single_process_noops():
     assert info["process_count"] == 1 and info["global_devices"] == 8
     multihost.barrier()
     assert multihost.broadcast_scalar(3.5) == 3.5
+
+
+def test_medium_golden_canonical_aspect():
+    # Scaled-down canonical geometry (1920x2520 -> 192x252) with the
+    # reference's standard workload shape: blur3, grey, many iterations.
+    from parallel_convolution_tpu.parallel import mesh as mesh_lib
+    import jax
+
+    img = imageio.generate_test_image(192, 252, "grey", seed=77)
+    filt = filters.get_filter("blur3")
+    want = oracle.run_serial_u8(img, filt, 25)
+    m = mesh_lib.make_grid_mesh(jax.devices()[:8], (2, 4))
+    model = ConvolutionModel(filt=filt, mesh=m, backend="separable",
+                             storage="bf16", fuse=5)
+    got = model.run_image(img, 25)
+    np.testing.assert_array_equal(got, want)
