@@ -55,6 +55,10 @@ def main(argv: list[str] | None = None) -> int:
                           "traffic and stays bit-exact for u8 images")
     run.add_argument("--fuse", type=int, default=1, metavar="T",
                      help="iterations per halo exchange (temporal fusion)")
+    run.add_argument("--boundary", default="zero",
+                     choices=["zero", "periodic"],
+                     help="edge handling: zero ghost ring (the reference) "
+                          "or periodic torus wrap")
     run.add_argument("--converge", type=float, default=None, metavar="TOL",
                      help="run to convergence (loops becomes max iters)")
     run.add_argument("--check-every", type=int, default=10)
@@ -174,7 +178,7 @@ def main(argv: list[str] | None = None) -> int:
 
     model = ConvolutionModel(filt=args.filter_name, mesh=mesh,
                              backend=args.backend, storage=args.storage,
-                             fuse=args.fuse)
+                             fuse=args.fuse, boundary=args.boundary)
     if args.checkpoint:
         from parallel_convolution_tpu.parallel import step as step_lib
         from parallel_convolution_tpu.utils import checkpoint, sharded_io

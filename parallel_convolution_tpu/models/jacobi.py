@@ -34,6 +34,7 @@ class JacobiSolver:
     mesh: Mesh | None = None
     backend: str = "shifted"
     quantize: bool = False
+    boundary: str = "zero"
 
     def __post_init__(self) -> None:
         if isinstance(self.filt, str):
@@ -47,5 +48,6 @@ class JacobiSolver:
             x, self.filt, tol=self.tol, max_iters=self.max_iters,
             check_every=self.check_every, mesh=self.mesh,
             quantize=self.quantize, backend=self.backend,
+            boundary=self.boundary,
         )
         return np.asarray(out), iters

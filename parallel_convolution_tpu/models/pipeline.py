@@ -44,6 +44,7 @@ class ConvolutionModel:
     #                        in quantize mode (u8 values are exact in bf16)
     fuse: int = 1  # iterations per halo exchange (temporal fusion, T*r-deep
     #                halos once instead of r-deep every iteration)
+    boundary: str = "zero"  # 'periodic' = torus wrap (ring topology)
 
     def __post_init__(self) -> None:
         if isinstance(self.filt, str):
@@ -57,7 +58,7 @@ class ConvolutionModel:
         return step_lib.sharded_iterate(
             x, self.filt, iters, mesh=self.mesh,
             quantize=self.quantize, backend=self.backend,
-            storage=self.storage, fuse=self.fuse,
+            storage=self.storage, fuse=self.fuse, boundary=self.boundary,
         )
 
     def run_image(self, img: np.ndarray, iters: int) -> np.ndarray:

@@ -44,18 +44,22 @@ def _shifted_windows(padded: np.ndarray, k: int, H: int, W: int):
             yield padded[dy : dy + H, dx : dx + W]
 
 
-def correlate_once(img_f32: np.ndarray, filt: Filter) -> np.ndarray:
-    """One zero-padded cross-correlation step in float32 (no quantization).
+def correlate_once(img_f32: np.ndarray, filt: Filter,
+                   boundary: str = "zero") -> np.ndarray:
+    """One padded cross-correlation step in float32 (no quantization).
 
     ``img_f32``: (H, W) or (H, W, C) float32.  Returns same shape float32.
     The accumulation is the normative fixed-order shifted multiply-add.
+    ``boundary``: 'zero' (the reference's ghost ring) or 'periodic' (torus
+    wrap, the simulation-style ring topology).
     """
     img_f32 = np.ascontiguousarray(img_f32, dtype=np.float32)
     H, W = img_f32.shape[:2]
     k = filt.size
     r = filt.radius
     pad = [(r, r), (r, r)] + [(0, 0)] * (img_f32.ndim - 2)
-    padded = np.pad(img_f32, pad, mode="constant")
+    mode = {"zero": "constant", "periodic": "wrap"}[boundary]
+    padded = np.pad(img_f32, pad, mode=mode)
     taps = filt.taps.reshape(k * k)
     acc = np.zeros_like(img_f32)
     for tap, win in zip(taps, _shifted_windows(padded, k, H, W)):
@@ -69,12 +73,16 @@ def quantize_u8(acc_f32: np.ndarray) -> np.ndarray:
     return np.clip(np.rint(acc_f32), 0.0, 255.0).astype(np.uint8)
 
 
-def convolve_once_u8(img_u8: np.ndarray, filt: Filter) -> np.ndarray:
+def convolve_once_u8(img_u8: np.ndarray, filt: Filter,
+                     boundary: str = "zero") -> np.ndarray:
     """One full uint8 iteration: u8 → f32 → correlate → quantize → u8."""
-    return quantize_u8(correlate_once(img_u8.astype(np.float32), filt))
+    return quantize_u8(
+        correlate_once(img_u8.astype(np.float32), filt, boundary)
+    )
 
 
-def run_serial_u8(img_u8: np.ndarray, filt: Filter, iters: int) -> np.ndarray:
+def run_serial_u8(img_u8: np.ndarray, filt: Filter, iters: int,
+                  boundary: str = "zero") -> np.ndarray:
     """The serial reference run (C1): ``iters`` iterations with u8 store-back.
 
     Mirrors the reference's hot loop (SURVEY.md §3.1): convolute + buffer
@@ -82,15 +90,16 @@ def run_serial_u8(img_u8: np.ndarray, filt: Filter, iters: int) -> np.ndarray:
     """
     out = np.asarray(img_u8, dtype=np.uint8)
     for _ in range(iters):
-        out = convolve_once_u8(out, filt)
+        out = convolve_once_u8(out, filt, boundary)
     return out
 
 
-def run_serial_f32(img_f32: np.ndarray, filt: Filter, iters: int) -> np.ndarray:
+def run_serial_f32(img_f32: np.ndarray, filt: Filter, iters: int,
+                   boundary: str = "zero") -> np.ndarray:
     """Float-mode serial run (Jacobi smoothing; no per-iteration quantization)."""
     out = np.asarray(img_f32, dtype=np.float32)
     for _ in range(iters):
-        out = correlate_once(out, filt)
+        out = correlate_once(out, filt, boundary)
     return out
 
 
@@ -100,6 +109,7 @@ def run_to_convergence_f32(
     tol: float,
     max_iters: int,
     check_every: int = 1,
+    boundary: str = "zero",
 ) -> tuple[np.ndarray, int]:
     """Serial run-to-convergence oracle (C6 semantics, BASELINE config 5).
 
@@ -116,7 +126,7 @@ def run_to_convergence_f32(
         prev = cur
         for _ in range(step):
             prev = cur
-            cur = correlate_once(cur, filt)
+            cur = correlate_once(cur, filt, boundary)
         done += step
         diff = float(np.max(np.abs(cur - prev))) if cur.size else 0.0
         if diff < tol:

@@ -209,7 +209,6 @@ def _fused_kernel(off_ref, hbm_ref, out_ref, scratch, sems, *,
 
     window_copy(c, i, j, slot).wait()
 
-    H, W = valid_hw
     # Global coords of the window's top-left at level 0.
     row0 = off_ref[0] - r * T + i * th
     col0 = off_ref[1] - r * T + j * tw
@@ -225,10 +224,15 @@ def _fused_kernel(off_ref, hbm_ref, out_ref, scratch, sems, *,
                 idx += 1
         if quantize:
             acc = jnp.clip(jnp.rint(acc), 0.0, 255.0)
-        rows = row0 + r * s + jax.lax.broadcasted_iota(jnp.int32, (ch, cw), 0)
-        cols = col0 + r * s + jax.lax.broadcasted_iota(jnp.int32, (ch, cw), 1)
-        ok = (rows >= 0) & (rows < H) & (cols >= 0) & (cols < W)
-        cur = jnp.where(ok, acc, 0.0)
+        if valid_hw is not None:  # None = periodic torus: no ghost ring
+            H, W = valid_hw
+            rows = row0 + r * s + jax.lax.broadcasted_iota(
+                jnp.int32, (ch, cw), 0)
+            cols = col0 + r * s + jax.lax.broadcasted_iota(
+                jnp.int32, (ch, cw), 1)
+            ok = (rows >= 0) & (rows < H) & (cols >= 0) & (cols < W)
+            acc = jnp.where(ok, acc, 0.0)
+        cur = acc
     out_ref[0] = cur.astype(out_ref.dtype)
 
 
@@ -274,7 +278,8 @@ def fused_iterate_pallas(
     taps = tuple(float(t) for t in filt.taps.reshape(-1))
     kernel = functools.partial(
         _fused_kernel, taps=taps, k=k, r=r, T=T, th=th, tw=tw,
-        valid_hw=tuple(valid_hw), quantize=quantize,
+        valid_hw=None if valid_hw is None else tuple(valid_hw),
+        quantize=quantize,
     )
     vma = getattr(jax.typeof(padded), "vma", frozenset())
     out = pl.pallas_call(

@@ -31,15 +31,27 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _shift(x: jnp.ndarray, axis_name: str, n: int, down: bool) -> jnp.ndarray:
+def _shift(x: jnp.ndarray, axis_name: str, n: int, down: bool,
+           periodic: bool = False) -> jnp.ndarray:
     """ppermute ``x`` one step along ``axis_name`` (n devices on that axis).
 
     ``down=True`` sends toward higher indices (each device receives its
-    lower-index neighbor's slab); boundary devices receive zeros.
+    lower-index neighbor's slab).  Non-periodic boundaries fall out of
+    ppermute semantics — devices with no inbound edge receive zeros (the
+    zero ghost ring).  ``periodic=True`` closes the ring (the wrap-around
+    rotation of ring attention's KV pass, SURVEY.md §5 long-context row):
+    every device has an inbound edge, modulo n.
     """
     if n == 1:
+        if periodic:
+            return x  # my own opposite edge wraps to me
         return jnp.zeros_like(x)
-    if down:
+    if periodic:
+        if down:
+            perm = [(i, (i + 1) % n) for i in range(n)]
+        else:
+            perm = [(i, (i - 1) % n) for i in range(n)]
+    elif down:
         perm = [(i, i + 1) for i in range(n - 1)]
     else:
         perm = [(i + 1, i) for i in range(n - 1)]
@@ -47,7 +59,8 @@ def _shift(x: jnp.ndarray, axis_name: str, n: int, down: bool) -> jnp.ndarray:
 
 
 def halo_pad_axis(
-    block: jnp.ndarray, r: int, axis_name: str, n: int, dim: int
+    block: jnp.ndarray, r: int, axis_name: str, n: int, dim: int,
+    periodic: bool = False,
 ) -> jnp.ndarray:
     """Pad one spatial dim of ``block`` with r-wide halos from mesh neighbors."""
     lo_slice = [slice(None)] * block.ndim
@@ -56,19 +69,28 @@ def halo_pad_axis(
     hi_slice[dim] = slice(block.shape[dim] - r, block.shape[dim])
     # Ghosts I receive: lower neighbor's last r (becomes my leading ghost),
     # higher neighbor's first r (trailing ghost).
-    lead_ghost = _shift(block[tuple(hi_slice)], axis_name, n, down=True)
-    trail_ghost = _shift(block[tuple(lo_slice)], axis_name, n, down=False)
+    lead_ghost = _shift(block[tuple(hi_slice)], axis_name, n, down=True,
+                        periodic=periodic)
+    trail_ghost = _shift(block[tuple(lo_slice)], axis_name, n, down=False,
+                         periodic=periodic)
     return jnp.concatenate([lead_ghost, block, trail_ghost], axis=dim)
 
 
-def halo_exchange(block: jnp.ndarray, r: int, grid: tuple[int, int]) -> jnp.ndarray:
+def halo_exchange(block: jnp.ndarray, r: int, grid: tuple[int, int],
+                  boundary: str = "zero") -> jnp.ndarray:
     """Full two-phase halo pad of a planar (C, h, w) block → (C, h+2r, w+2r).
 
     Phase order (rows then columns of the row-padded slab) propagates corner
     ghosts correctly — SURVEY.md §8 item 5: outputs must match the
     reference's explicit 8-neighbor exchange bit-for-bit, and do, because
     corner values take the same two-hop path the diagonal message shortcuts.
+
+    ``boundary``: 'zero' (the reference's ghost ring) or 'periodic' (torus
+    wrap — ring-collective topology for simulation workloads).
     """
+    if boundary not in ("zero", "periodic"):
+        raise ValueError(f"boundary must be zero|periodic, got {boundary!r}")
+    periodic = boundary == "periodic"
     R, C = grid
-    padded = halo_pad_axis(block, r, "x", R, dim=1)
-    return halo_pad_axis(padded, r, "y", C, dim=2)
+    padded = halo_pad_axis(block, r, "x", R, dim=1, periodic=periodic)
+    return halo_pad_axis(padded, r, "y", C, dim=2, periodic=periodic)

@@ -63,7 +63,7 @@ def _valid_mask(valid_hw, block_hw, margin: int = 0):
 
 
 def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
-                     backend: str, fuse: int = 1):
+                     backend: str, fuse: int = 1, boundary: str = "zero"):
     """``fuse`` iterations on a local block per halo exchange.
 
     fuse=1 is the reference's loop shape: exchange 1-deep halos, stencil,
@@ -79,8 +79,15 @@ def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
     quantized u8 values, half the HBM/ICI traffic); accumulation is always
     f32 inside the correlate implementations.
     """
-    needs_mask = (valid_hw[0] != block_hw[0] * grid[0]
-                  or valid_hw[1] != block_hw[1] * grid[1])
+    periodic = boundary == "periodic"
+    if periodic and (valid_hw[0] != block_hw[0] * grid[0]
+                     or valid_hw[1] != block_hw[1] * grid[1]):
+        raise ValueError(
+            "periodic boundary requires dimensions divisible by the mesh "
+            f"grid: image {valid_hw} on grid {grid}"
+        )
+    needs_mask = not periodic and (valid_hw[0] != block_hw[0] * grid[0]
+                                   or valid_hw[1] != block_hw[1] * grid[1])
     r = filt.radius
 
     def correlate_level(p, out_dtype):
@@ -97,7 +104,7 @@ def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
 
     def step(v):
         depth = r * fuse
-        p = halo.halo_exchange(v, depth, grid)
+        p = halo.halo_exchange(v, depth, grid, boundary)
         if backend == "pallas" and fuse > 1:
             # All T levels inside one kernel: one HBM round trip per chunk.
             from parallel_convolution_tpu.ops import pallas_stencil
@@ -107,13 +114,13 @@ def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
                 lax.axis_index("y") * block_hw[1],
             ]).astype(jnp.int32)
             return pallas_stencil.fused_iterate_pallas(
-                p, off, filt, fuse, tuple(valid_hw),
+                p, off, filt, fuse, None if periodic else tuple(valid_hw),
                 quantize=quantize, out_dtype=v.dtype,
             )
         for t in range(fuse):
             margin = depth - r * (t + 1)
             p = correlate_level(p, v.dtype)
-            if needs_mask or margin > 0:
+            if not periodic and (needs_mask or margin > 0):
                 p = p * _valid_mask(valid_hw, block_hw, margin).astype(p.dtype)
         return p.astype(v.dtype)
 
@@ -130,7 +137,8 @@ def _check_block_size(filt: Filter, block_hw) -> None:
 
 @lru_cache(maxsize=64)
 def _build_iterate(mesh: Mesh, filt: Filter, iters: int, quantize: bool,
-                   valid_hw, block_hw, backend: str, fuse: int = 1):
+                   valid_hw, block_hw, backend: str, fuse: int = 1,
+                   boundary: str = "zero"):
     """Compile the fixed-count iteration runner for one (mesh, config)."""
     grid = grid_shape(mesh)
     _check_block_size(filt, block_hw)
@@ -140,10 +148,10 @@ def _build_iterate(mesh: Mesh, filt: Filter, iters: int, quantize: bool,
             f"fuse={fuse} needs blocks >= {filt.radius * fuse}, got {block_hw}"
         )
     chunk = _make_block_step(filt, grid, valid_hw, block_hw, quantize,
-                             backend, fuse)
+                             backend, fuse, boundary)
     n_chunks, rem = divmod(iters, fuse)
     tail = (_make_block_step(filt, grid, valid_hw, block_hw, quantize,
-                             backend, rem) if rem else None)
+                             backend, rem, boundary) if rem else None)
 
     def body(block):
         block = lax.fori_loop(0, n_chunks, lambda _, v: chunk(v), block)
@@ -161,11 +169,12 @@ def _build_iterate(mesh: Mesh, filt: Filter, iters: int, quantize: bool,
 @lru_cache(maxsize=64)
 def _build_converge(mesh: Mesh, filt: Filter, tol: float, max_iters: int,
                     check_every: int, quantize: bool, valid_hw, block_hw,
-                    backend: str):
+                    backend: str, boundary: str = "zero"):
     """Compile the run-to-convergence runner (C6: every-N diff + allreduce)."""
     grid = grid_shape(mesh)
     _check_block_size(filt, block_hw)
-    step = _make_block_step(filt, grid, valid_hw, block_hw, quantize, backend)
+    step = _make_block_step(filt, grid, valid_hw, block_hw, quantize, backend,
+                            boundary=boundary)
 
     def body(block):
         def chunk(carry):
@@ -238,7 +247,8 @@ def _prepare(x, mesh: Mesh, r: int, storage: str = "f32"):
 
 def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
                      valid_hw, quantize: bool = True,
-                     backend: str = "shifted", fuse: int = 1):
+                     backend: str = "shifted", fuse: int = 1,
+                     boundary: str = "zero"):
     """Iterate an already-sharded padded (C, Hp, Wp) array in place(-ish).
 
     The zero-copy entry for huge images loaded via utils.sharded_io: input
@@ -248,13 +258,14 @@ def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
     R, Cc = grid_shape(mesh)
     block_hw = (xs.shape[1] // R, xs.shape[2] // Cc)
     fn = _build_iterate(mesh, filt, iters, quantize, tuple(valid_hw),
-                        block_hw, backend, fuse)
+                        block_hw, backend, fuse, boundary)
     return fn(xs)
 
 
 def sharded_iterate(x, filt: Filter, iters: int, mesh: Mesh | None = None,
                     quantize: bool = True, backend: str = "shifted",
-                    storage: str = "f32", fuse: int = 1):
+                    storage: str = "f32", fuse: int = 1,
+                    boundary: str = "zero"):
     """Run ``iters`` stencil iterations of a global (C, H, W) f32 image
     sharded over the 2D mesh.  Returns the global (C, H, W) f32 result
     (bit-identical to the serial oracle for any mesh shape).
@@ -268,19 +279,21 @@ def sharded_iterate(x, filt: Filter, iters: int, mesh: Mesh | None = None,
         mesh = make_grid_mesh()
     xs, valid_hw, block_hw = _prepare(x, mesh, filt.radius, storage)
     out = iterate_prepared(xs, filt, iters, mesh, valid_hw,
-                           quantize=quantize, backend=backend, fuse=fuse)
+                           quantize=quantize, backend=backend, fuse=fuse,
+                           boundary=boundary)
     return out[:, : valid_hw[0], : valid_hw[1]].astype(jnp.float32)
 
 
 def sharded_converge(x, filt: Filter, tol: float, max_iters: int,
                      check_every: int = 1, mesh: Mesh | None = None,
                      quantize: bool = False, backend: str = "shifted",
-                     storage: str = "f32"):
+                     storage: str = "f32", boundary: str = "zero"):
     """Run-to-convergence (BASELINE config 5).  Returns (result, iters_run)."""
     if mesh is None:
         mesh = make_grid_mesh()
     xs, valid_hw, block_hw = _prepare(x, mesh, filt.radius, storage)
     fn = _build_converge(mesh, filt, float(tol), int(max_iters),
-                         int(check_every), quantize, valid_hw, block_hw, backend)
+                         int(check_every), quantize, valid_hw, block_hw,
+                         backend, boundary)
     out, done = fn(xs)
     return out[:, : valid_hw[0], : valid_hw[1]].astype(jnp.float32), int(done)

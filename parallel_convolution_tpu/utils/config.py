@@ -25,6 +25,7 @@ class RunConfig:
     backend: str = "shifted"       # shifted | pallas | xla_conv
     storage: str = "f32"           # f32 | bf16
     fuse: int = 1
+    boundary: str = "zero"
     quantize: bool = True
     converge_tol: float | None = None
     check_every: int = 10
@@ -39,6 +40,8 @@ class RunConfig:
             raise ValueError(f"storage must be f32|bf16, got {self.storage!r}")
         if self.backend not in ("shifted", "pallas", "xla_conv", "separable"):
             raise ValueError(f"unknown backend {self.backend!r}")
+        if self.boundary not in ("zero", "periodic"):
+            raise ValueError(f"boundary must be zero|periodic, got {self.boundary!r}")
         if self.rows <= 0 or self.cols <= 0 or self.iters < 0 or self.fuse < 1:
             raise ValueError("rows/cols must be positive, iters >= 0, fuse >= 1")
         if self.mesh_shape is not None:
@@ -65,4 +68,5 @@ class RunConfig:
         return ConvolutionModel(
             filt=self.filter_name, mesh=mesh, backend=self.backend,
             quantize=self.quantize, storage=self.storage, fuse=self.fuse,
+            boundary=self.boundary,
         )
