@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Run the five BASELINE.json configs end-to-end (SURVEY.md §7 stage 5).
+
+Sizes adapt to the attached hardware: ``--scale 1`` is the literal config
+(needs a pod + disk for config 4); the default ``--scale auto`` shrinks
+spatial dims on small hosts while keeping every config's *shape* (filter,
+mode, mesh aspect, convergence semantics) intact.  Emits one JSON row per
+config (stdout) and a markdown table (stderr) for BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", default="auto",
+                    help="'auto', or a divisor (1 = literal BASELINE sizes)")
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        try:
+            jax.config.update("jax_platforms", args.platform)
+        except Exception:
+            pass
+
+    import numpy as np
+
+    from parallel_convolution_tpu.ops.filters import get_filter
+    from parallel_convolution_tpu.parallel import step
+    from parallel_convolution_tpu.parallel.mesh import make_grid_mesh
+    from parallel_convolution_tpu.utils import bench
+
+    n_dev = len(jax.devices())
+    platform = jax.default_backend()
+    if args.scale == "auto":
+        scale = 1 if platform == "tpu" and n_dev >= 16 else (
+            4 if platform == "tpu" else 16)
+    else:
+        scale = int(args.scale)
+
+    def mesh_for(shape):
+        r, c = shape
+        if r * c > n_dev:
+            # keep the aspect, shrink to available devices
+            from parallel_convolution_tpu.parallel.mesh import dims_create
+
+            r, c = dims_create(n_dev)
+        return make_grid_mesh(jax.devices()[: r * c], (r, c))
+
+    rows = []
+
+    def emit(name, row):
+        row = {"config": name, **row}
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    # 1. serial CPU reference, 1920x2520 grey (never scaled: host-sized)
+    emit("1: serial 3x3 blur 1920x2520 grey",
+         bench.bench_oracle_proxy((1920, 2520), iters=2))
+
+    # 2. 3x3 blur, 1920x2520 RGB, 2x2 mesh
+    emit("2: 3x3 blur 1920x2520 rgb 2x2 mesh", bench.bench_iterate(
+        (1920 // max(1, scale // 4), 2520 // max(1, scale // 4)),
+        get_filter("blur3"), 100 if scale == 1 else 10,
+        mesh=mesh_for((2, 2)), channels=3, storage="bf16", fuse=4, reps=2))
+
+    # 3. 5x5 edge-detect, 8192^2 grey, 100 iters, 4x4 mesh
+    emit("3: 5x5 edge 8192^2 grey 4x4 mesh", bench.bench_iterate(
+        (8192 // scale, 8192 // scale), get_filter("edge5"),
+        100 if scale == 1 else 10, mesh=mesh_for((4, 4)),
+        storage="bf16", fuse=2, reps=2))
+
+    # 4. 3x3 blur, 65536^2 RGB, v5e-16, pallas kernel (the north star)
+    emit("4: 3x3 blur 65536^2 rgb pallas", bench.bench_iterate(
+        (65536 // scale, 65536 // scale), get_filter("blur3"),
+        100 if scale == 1 else 5, mesh=mesh_for((4, 4)), channels=3,
+        backend="pallas" if platform == "tpu" else "shifted",
+        storage="bf16", fuse=8 if platform == "tpu" else 2, reps=1))
+
+    # 5. iterated 3x3 jacobi to convergence (psum), 32768^2
+    size5 = 32768 // scale
+    x = np.random.default_rng(0).random((1, size5, size5)).astype(np.float32)
+    m5 = mesh_for((8, 8))
+    t0 = time.perf_counter()
+    out, iters = step.sharded_converge(
+        x, get_filter("jacobi3"), tol=1e-3, max_iters=200, check_every=10,
+        mesh=m5)
+    jax.block_until_ready(out)
+    secs = time.perf_counter() - t0
+    emit("5: jacobi convergence 32768^2", {
+        "workload": f"jacobi3 {size5}x{size5} tol=1e-3",
+        "iters_run": iters, "wall_s": round(secs, 3),
+        "iters_per_s": round(iters / secs, 2) if secs else None,
+    })
+
+    print("\n| config | result |", file=sys.stderr)
+    print("|---|---|", file=sys.stderr)
+    for r in rows:
+        body = {k: v for k, v in r.items() if k != "config"}
+        print(f"| {r['config']} | `{json.dumps(body)}` |", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
