@@ -188,7 +188,7 @@ def main(argv: list[str] | None = None) -> int:
         out = checkpoint.run_checkpointed(
             xs, model.filt, args.loops, mesh, (args.rows, args.cols),
             ckpt_dir=args.checkpoint, every=args.checkpoint_every,
-            backend=args.backend,
+            backend=args.backend, fuse=args.fuse, boundary=args.boundary,
         )
         sharded_io.save_sharded(args.output, out, args.rows, args.cols,
                                 args.mode)

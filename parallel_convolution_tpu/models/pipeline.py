@@ -100,11 +100,18 @@ class ConvolutionModel:
         """Huge-image path: block-reads from disk straight into the device
         sharding, iterates, block-writes back — the full image never exists
         in one host buffer (the MPI-IO workflow, SURVEY.md §7)."""
+        import numpy as np
+
+        from parallel_convolution_tpu.parallel.step import STORAGE_DTYPES
         from parallel_convolution_tpu.utils import sharded_io
 
-        xs = sharded_io.load_sharded(src, rows, cols, mode, self.mesh)
+        xs = sharded_io.load_sharded(
+            src, rows, cols, mode, self.mesh,
+            dtype=np.dtype(STORAGE_DTYPES[self.storage]),
+        )
         out = step_lib.iterate_prepared(
             xs, self.filt, iters, self.mesh, (rows, cols),
             quantize=self.quantize, backend=self.backend,
+            fuse=self.fuse, boundary=self.boundary,
         )
         sharded_io.save_sharded(dst, out, rows, cols, mode)

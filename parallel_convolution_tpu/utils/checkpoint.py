@@ -87,6 +87,8 @@ def run_checkpointed(
     every: int,
     quantize: bool = True,
     backend: str = "shifted",
+    fuse: int = 1,
+    boundary: str = "zero",
 ) -> jax.Array:
     """Iterate with a snapshot every ``every`` iterations; auto-resume.
 
@@ -101,13 +103,15 @@ def run_checkpointed(
         "filter": filt.name,
         "quantize": quantize,
         "backend": backend,
+        "fuse": fuse,
+        "boundary": boundary,
         "valid_hw": list(valid_hw),
         "grid": list(grid),
     }
     meta = load_meta(ckpt_dir)
     done = 0
     if meta is not None:
-        saved_cfg = {k: meta[k] for k in config}
+        saved_cfg = {k: meta.get(k) for k in config}
         if saved_cfg != config:
             raise ValueError(
                 f"checkpoint config mismatch: {saved_cfg} != {config}"
@@ -121,7 +125,8 @@ def run_checkpointed(
         chunk = min(every, total_iters - done)
         xs = step_lib.iterate_prepared(
             xs, filt, chunk, mesh, valid_hw,
-            quantize=quantize, backend=backend,
+            quantize=quantize, backend=backend, fuse=min(fuse, chunk),
+            boundary=boundary,
         )
         done += chunk
         if done < total_iters:  # final state is the caller's to persist

@@ -43,6 +43,7 @@ def test_checkpoint_resume_continues(tmp_path, grey_odd):
     mid = step.iterate_prepared(xs, filt, 4, m, valid_hw)
     checkpoint.save_state(ck, mid, {
         "filter": filt.name, "quantize": True, "backend": "shifted",
+        "fuse": 1, "boundary": "zero",
         "valid_hw": list(valid_hw), "grid": [2, 2],
         "iters_done": 4, "shape": list(mid.shape),
     })
