@@ -1,0 +1,42 @@
+"""Smoke tests for the benchmark module (C10) on the CPU mesh."""
+
+import jax
+
+from parallel_convolution_tpu.ops.filters import get_filter
+from parallel_convolution_tpu.parallel import mesh as mesh_lib
+from parallel_convolution_tpu.utils import bench
+
+
+def _mesh(shape):
+    return mesh_lib.make_grid_mesh(jax.devices()[: shape[0] * shape[1]], shape)
+
+
+def test_bench_iterate_reports():
+    row = bench.bench_iterate((64, 128), get_filter("blur3"), 3,
+                              mesh=_mesh((2, 2)), reps=1)
+    assert row["devices"] == 4 and row["mesh"] == "2x2"
+    assert row["gpixels_per_s"] > 0
+    assert abs(row["gpixels_per_s"] / 4 - row["gpixels_per_s_per_chip"]) < 0.01
+
+
+def test_bench_halo_p50():
+    row = bench.bench_halo_p50((32, 128), r=1, mesh=_mesh((2, 2)), trials=5)
+    assert row["p50_us"] > 0 and row["p90_us"] >= row["p50_us"]
+    assert row["block"] == "32x128"
+
+
+def test_bench_oracle_proxy_small():
+    row = bench.bench_oracle_proxy((64, 64), iters=1)
+    assert row["gpixels_per_s"] > 0
+    assert row["impl"] in ("cpp-serial", "numpy-oracle")
+
+
+def test_wall_returns_median():
+    calls = []
+
+    def fn(x):
+        calls.append(1)
+        return x
+
+    t = bench.wall(fn, jax.numpy.ones((4,)), warmup=1, reps=3)
+    assert t >= 0 and len(calls) == 4
