@@ -1,0 +1,52 @@
+"""Semantics pin: hard-coded digests of the normative oracle outputs.
+
+The oracle IS the spec (SURVEY.md §7 — the reference mount was empty, so
+the oracle's behavior was declared normative and every backend is tested
+bit-exact against it).  These digests freeze that spec: any change to
+padding, tap order, accumulation dtype, rounding, or the fixture generator
+fails here loudly instead of silently re-baselining the whole suite.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from parallel_convolution_tpu.ops import filters, oracle
+from parallel_convolution_tpu.utils import imageio
+
+
+def _digest(arr) -> str:
+    return hashlib.sha256(np.ascontiguousarray(arr).tobytes()).hexdigest()[:16]
+
+
+GREY = imageio.generate_test_image(32, 48, "grey", seed=99)
+RGB = imageio.generate_test_image(24, 40, "rgb", seed=98)
+
+
+def test_fixture_generator_pinned():
+    assert _digest(GREY) == "314e09a88576d412"
+    assert _digest(RGB) == "46ff0356c038d06f"
+
+
+@pytest.mark.parametrize("name,img,iters,want", [
+    ("blur3", GREY, 5, "5e7c3ae9bcdb329e"),
+    ("gaussian5", GREY, 3, "2548f5f829eb07c2"),
+    ("edge3", GREY, 2, "e2badfcff3a1cfa4"),
+    ("blur3", RGB, 4, "d45d8074522ee0b7"),
+])
+def test_oracle_u8_pinned(name, img, iters, want):
+    out = oracle.run_serial_u8(img, filters.get_filter(name), iters)
+    assert _digest(out) == want
+
+
+def test_oracle_periodic_pinned():
+    out = oracle.run_serial_u8(GREY, filters.get_filter("blur3"), 5,
+                               boundary="periodic")
+    assert _digest(out) == "a455b7076e6502cb"
+
+
+def test_oracle_f32_pinned():
+    out = oracle.run_serial_f32(GREY.astype(np.float32),
+                                filters.get_filter("jacobi3"), 6)
+    assert _digest(out) == "223143e6491f0418"
