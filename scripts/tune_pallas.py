@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Tile-size tuner for the Pallas stencil kernels (run on a real TPU).
+
+Sweeps (tile_h, tile_w) for the one-step kernel and fusion depth T for the
+fused kernel on a fixed workload, printing a JSON row per point and the
+winner. Use the winner to update ``ops/pallas_stencil.DEFAULT_TILE`` /
+bench fuse depth.
+
+  python scripts/tune_pallas.py --size 8192 --iters 20
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=8192)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--storage", default="bf16")
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    from parallel_convolution_tpu.ops import pallas_stencil
+    from parallel_convolution_tpu.ops.filters import get_filter
+    from parallel_convolution_tpu.parallel import step
+    from parallel_convolution_tpu.parallel.mesh import make_grid_mesh
+    from parallel_convolution_tpu.utils import bench
+
+    mesh = make_grid_mesh(jax.devices()[:1], (1, 1))
+    filt = get_filter("blur3")
+    H = W = args.size
+    results = []
+
+    for tile in [(128, 512), (256, 256), (256, 512), (256, 1024),
+                 (512, 512), (512, 1024), (1024, 512)]:
+        for fuse in (1, 2, 4, 8, 16):
+            old = pallas_stencil.DEFAULT_TILE
+            pallas_stencil.DEFAULT_TILE = tile
+            # new compile per tile: drop the runner cache
+            step._build_iterate.cache_clear()
+            try:
+                row = bench.bench_iterate(
+                    (H, W), filt, args.iters, mesh=mesh, backend="pallas",
+                    storage=args.storage, fuse=fuse, reps=2,
+                )
+                row.update(tile=f"{tile[0]}x{tile[1]}")
+                results.append(row)
+                print(json.dumps(row), flush=True)
+            except Exception as e:
+                print(json.dumps({"tile": f"{tile[0]}x{tile[1]}",
+                                  "fuse": fuse, "error": repr(e)[:150]}),
+                      flush=True)
+            finally:
+                pallas_stencil.DEFAULT_TILE = old
+
+    if results:
+        best = max(results, key=lambda r: r["gpixels_per_s_per_chip"])
+        print(f"# BEST: {json.dumps(best)}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
