@@ -27,6 +27,8 @@ from jax.sharding import Mesh
 from parallel_convolution_tpu.parallel.mesh import block_sharding, grid_shape
 
 META_NAME = "meta.json"
+LATEST_NAME = "LATEST"
+KEEP_SNAPSHOTS = 2
 
 
 def _coords(index, block_hw) -> tuple[int, int]:
@@ -34,33 +36,71 @@ def _coords(index, block_hw) -> tuple[int, int]:
     return (rs.start or 0) // block_hw[0], (cs.start or 0) // block_hw[1]
 
 
+def _snap_dir(ckpt_dir, iters_done: int) -> Path:
+    return Path(ckpt_dir) / f"it_{int(iters_done):08d}"
+
+
+def _latest_snap(ckpt_dir) -> Path | None:
+    p = Path(ckpt_dir) / LATEST_NAME
+    if not p.exists():
+        return None
+    snap = Path(ckpt_dir) / p.read_text().strip()
+    return snap if (snap / META_NAME).exists() else None
+
+
 def save_state(ckpt_dir, arr: jax.Array, meta: dict) -> None:
-    """Snapshot a sharded padded (C, Hp, Wp) array + metadata."""
+    """Snapshot a sharded padded (C, Hp, Wp) array + metadata.
+
+    Crash-safe by construction: each snapshot is its own
+    ``it_<NNNNNNNN>/`` directory, meta is written last inside it, and the
+    ``LATEST`` pointer flips atomically only after the snapshot is
+    complete — a crash at any point leaves the previous snapshot intact.
+    Older snapshots beyond KEEP_SNAPSHOTS are pruned.
+    """
     d = Path(ckpt_dir)
     d.mkdir(parents=True, exist_ok=True)
+    snap = _snap_dir(d, meta["iters_done"])
+    snap.mkdir(exist_ok=True)
     R_blocks = meta["grid"]
     block_hw = (arr.shape[1] // R_blocks[0], arr.shape[2] // R_blocks[1])
     for shard in arr.addressable_shards:
         r, c = _coords(shard.index, block_hw)
-        np.save(d / f"shard_{r}_{c}.npy", np.asarray(shard.data))
-    tmp = d / (META_NAME + ".tmp")
+        np.save(snap / f"shard_{r}_{c}.npy", np.asarray(shard.data))
+    tmp = snap / (META_NAME + ".tmp")
     tmp.write_text(json.dumps(meta))
-    os.replace(tmp, d / META_NAME)  # atomic: meta only names complete shards
+    os.replace(tmp, snap / META_NAME)
+    ptr_tmp = d / (LATEST_NAME + ".tmp")
+    ptr_tmp.write_text(snap.name)
+    os.replace(ptr_tmp, d / LATEST_NAME)
+    # prune old snapshots (multi-host: every host holds its own shards, so
+    # each prunes the same dirs; missing-file races are ignored)
+    snaps = sorted(p for p in d.iterdir()
+                   if p.is_dir() and p.name.startswith("it_"))
+    for old in snaps[:-KEEP_SNAPSHOTS]:
+        for f in old.iterdir():
+            try:
+                f.unlink()
+            except OSError:
+                pass
+        try:
+            old.rmdir()
+        except OSError:
+            pass
 
 
 def load_meta(ckpt_dir) -> dict | None:
-    p = Path(ckpt_dir) / META_NAME
-    if not p.exists():
+    snap = _latest_snap(ckpt_dir)
+    if snap is None:
         return None
-    return json.loads(p.read_text())
+    return json.loads((snap / META_NAME).read_text())
 
 
 def load_state(ckpt_dir, mesh: Mesh) -> tuple[jax.Array, dict]:
     """Restore the sharded array (each device reads only its own shard)."""
-    d = Path(ckpt_dir)
-    meta = load_meta(d)
-    if meta is None:
-        raise FileNotFoundError(f"no checkpoint at {d}")
+    snap = _latest_snap(ckpt_dir)
+    if snap is None:
+        raise FileNotFoundError(f"no checkpoint at {ckpt_dir}")
+    meta = json.loads((snap / META_NAME).read_text())
     shape = tuple(meta["shape"])
     grid = grid_shape(mesh)
     if tuple(meta["grid"]) != grid:
@@ -71,7 +111,7 @@ def load_state(ckpt_dir, mesh: Mesh) -> tuple[jax.Array, dict]:
 
     def cb(index):
         r, c = _coords(index, block_hw)
-        return np.load(d / f"shard_{r}_{c}.npy")
+        return np.load(snap / f"shard_{r}_{c}.npy")
 
     arr = jax.make_array_from_callback(shape, block_sharding(mesh), cb)
     return arr, meta

@@ -95,3 +95,23 @@ def test_phase_timer(tmp_path):
     assert set(rep["phases"]) == {"a", "b"}
     t.dump(tmp_path / "t.json")
     assert json.loads((tmp_path / "t.json").read_text())["total_s"] >= 0
+
+
+def test_checkpoint_snapshots_pruned_and_crash_safe(tmp_path, grey_odd):
+    filt = filters.get_filter("blur3")
+    m = _mesh((2, 2))
+    xs, valid_hw, _ = _prepare(grey_odd, m, filt)
+    ck = tmp_path / "ck"
+    checkpoint.run_checkpointed(xs, filt, total_iters=10, mesh=m,
+                                valid_hw=valid_hw, ckpt_dir=ck, every=2)
+    snaps = sorted(p.name for p in ck.iterdir()
+                   if p.is_dir() and p.name.startswith("it_"))
+    # snapshots at 2,4,6,8 -> pruned to the last KEEP_SNAPSHOTS
+    assert snaps == ["it_00000006", "it_00000008"]
+    assert (ck / "LATEST").read_text().strip() == "it_00000008"
+    # a torn newer snapshot (no meta yet) must not be picked up
+    torn = ck / "it_00000010"
+    torn.mkdir()
+    (torn / "shard_0_0.npy").write_bytes(b"garbage")
+    meta = checkpoint.load_meta(ck)
+    assert meta["iters_done"] == 8
