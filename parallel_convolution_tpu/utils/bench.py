@@ -61,13 +61,21 @@ def bench_iterate(
     rng = np.random.default_rng(0)
     x = rng.integers(0, 256, size=(channels, H, W)).astype(np.float32)
 
-    def run(v):
-        return step_lib.sharded_iterate(
-            v, filt, iters, mesh=mesh, quantize=quantize, backend=backend,
-            storage=storage, fuse=fuse,
-        )
-
-    secs = wall(run, x, reps=reps)
+    # Time ONLY the on-device iteration: host->device transfer happens once
+    # (over a tunnel it would otherwise dominate), and because the runner
+    # donates its input, repetitions chain output->input — padded shape,
+    # dtype and sharding are invariant, exactly the double-buffer reuse the
+    # real pipeline gets.
+    xs, valid_hw, block_hw = step_lib._prepare(x, mesh, filt.radius, storage)
+    fn = step_lib._build_iterate(mesh, filt, iters, quantize, valid_hw,
+                                 block_hw, backend, fuse)
+    out = jax.block_until_ready(fn(xs))  # compile + warmup
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(fn(out))
+        times.append(time.perf_counter() - t0)
+    secs = statistics.median(times)
     n_dev = mesh.size
     gpx = H * W * channels * iters / secs / 1e9
     return {
