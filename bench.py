@@ -38,17 +38,20 @@ def main() -> int:
     from parallel_convolution_tpu.parallel.mesh import make_grid_mesh
     from parallel_convolution_tpu.utils import bench
 
+    from parallel_convolution_tpu.ops.pallas_stencil import on_tpu
+
     platform = jax.default_backend()
     n_dev = len(jax.devices())
     mesh = make_grid_mesh()
     filt = get_filter("blur3")
 
-    # Size the workload to the backend: big enough to saturate a TPU chip,
-    # small enough that a CPU fallback still finishes.
-    if platform == "cpu":
-        shape, iters, reps = (1024, 1024), 20, 2
-    else:
+    # Size the workload to the hardware: big enough to saturate a TPU chip
+    # (detected via device_kind — experimental proxy platforms report a
+    # non-'tpu' platform name), small enough that a CPU fallback finishes.
+    if on_tpu():
         shape, iters, reps = (8192, 8192), 100, 3
+    else:
+        shape, iters, reps = (1024, 1024), 20, 2
 
     configs = [
         ("shifted", "f32", 1),

@@ -40,6 +40,21 @@ def _round_up(n: int, m: int) -> int:
     return -(-n // m) * m
 
 
+def on_tpu() -> bool:
+    """True when the default backend drives real TPU silicon.
+
+    Checks device_kind too: experimental PJRT proxies (e.g. platform
+    'axon') report a platform name != 'tpu' while still being TPUs — the
+    Mosaic path must be used there, not the interpreter.
+    """
+    try:
+        d = jax.devices()[0]
+    except Exception:
+        return False
+    kind = (getattr(d, "device_kind", "") or "").lower()
+    return "tpu" in d.platform.lower() or "tpu" in kind
+
+
 def _stencil_kernel(hbm_ref, out_ref, scratch, sems, *, taps, k, r, th, tw,
                     quantize):
     """One grid program: DMA window c,i,j → VMEM, stencil it, emit tile.
@@ -118,7 +133,7 @@ def correlate_padded_pallas(
     semantic change.
     """
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = not on_tpu()
     if out_dtype is None:
         out_dtype = padded.dtype if quantize else jnp.float32
     r = filt.radius
@@ -261,7 +276,7 @@ def fused_iterate_pallas(
     intermediates at full f32 in VMEM).
     """
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = not on_tpu()
     if out_dtype is None:
         out_dtype = padded.dtype
     r, k = filt.radius, filt.size

@@ -38,8 +38,10 @@ def main() -> int:
     from parallel_convolution_tpu.parallel.mesh import make_grid_mesh
     from parallel_convolution_tpu.utils import bench
 
+    from parallel_convolution_tpu.ops.pallas_stencil import on_tpu
+
     n_dev = len(jax.devices())
-    platform = jax.default_backend()
+    platform = "tpu" if on_tpu() else jax.default_backend()
     if args.scale == "auto":
         scale = 1 if platform == "tpu" and n_dev >= 16 else (
             4 if platform == "tpu" else 16)
