@@ -30,4 +30,19 @@ from parallel_convolution_tpu.ops import oracle
 
 __version__ = "0.1.0"
 
-__all__ = ["Filter", "get_filter", "FILTERS", "oracle", "__version__"]
+__all__ = ["Filter", "get_filter", "FILTERS", "oracle", "ConvolutionModel",
+           "JacobiSolver", "RunConfig", "__version__"]
+
+
+def __getattr__(name: str):
+    # Lazy: models pull in the full jax/parallel stack; keep bare imports
+    # of the package cheap.
+    if name in ("ConvolutionModel", "JacobiSolver"):
+        from parallel_convolution_tpu import models
+
+        return getattr(models, name)
+    if name == "RunConfig":
+        from parallel_convolution_tpu.utils.config import RunConfig
+
+        return RunConfig
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

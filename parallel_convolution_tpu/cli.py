@@ -70,10 +70,14 @@ def main(argv: list[str] | None = None) -> int:
                           "and auto-resume from DIR")
     run.add_argument("--checkpoint-every", type=int, default=100)
 
-    ser = sub.add_parser("serial", help="NumPy oracle (golden reference)")
+    ser = sub.add_parser("serial", help="serial baseline (golden reference)")
     _add_image_args(ser)
     ser.add_argument("-o", "--output", required=True)
     ser.add_argument("--filter", default="blur3", dest="filter_name")
+    ser.add_argument("--impl", default="auto",
+                     choices=["auto", "oracle", "native"],
+                     help="auto = native C++ when built (bit-identical), "
+                          "else the NumPy oracle")
 
     gen = sub.add_parser("generate", help="write a deterministic test image")
     gen.add_argument("output")
@@ -150,9 +154,23 @@ def main(argv: list[str] | None = None) -> int:
         from parallel_convolution_tpu.ops.filters import get_filter
 
         img = imageio.read_raw(args.image, args.rows, args.cols, args.mode)
-        out = oracle.run_serial_u8(img, get_filter(args.filter_name), args.loops)
+        filt = get_filter(args.filter_name)
+        impl = args.impl
+        if impl in ("auto", "native"):
+            try:
+                from parallel_convolution_tpu.native import serial_native
+
+                out = serial_native.run_serial_u8(img, filt, args.loops)
+                impl = "native"
+            except Exception:
+                if impl == "native":
+                    raise
+                impl = "oracle"
+        if impl == "oracle":
+            out = oracle.run_serial_u8(img, filt, args.loops)
         imageio.write_raw(args.output, out)
-        print(f"serial: {args.loops} x {args.filter_name} -> {args.output}")
+        print(f"serial[{impl}]: {args.loops} x {args.filter_name} "
+              f"-> {args.output}")
         return 0
 
     # run
