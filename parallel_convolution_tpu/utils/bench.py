@@ -147,19 +147,26 @@ def bench_oracle_proxy(shape=(1920, 2520), iters: int = 2) -> dict:
     H, W = shape
     img = np.random.default_rng(0).integers(0, 256, size=(H, W)).astype(np.uint8)
     filt = get_filter("blur3")
+    run = oracle.run_serial_u8
     impl = "numpy-oracle"
-    t0 = time.perf_counter()
     try:
         from parallel_convolution_tpu.native import serial_native
 
-        serial_native.run_serial_u8(img, filt, iters)
+        # Warm-up call outside the timed span so a first-use C++ build (or
+        # page-in) doesn't pollute the measurement.
+        serial_native.run_serial_u8(img[:8, :8], filt, 1)
+        # threads=1: this row is the strict serial C1 baseline, not the
+        # OpenMP hybrid tier (threads=0 default).
+        run = lambda *a: serial_native.run_serial_u8(*a, threads=1)
         impl = "cpp-serial"
     except Exception:
-        oracle.run_serial_u8(img, filt, iters)
-    secs = time.perf_counter() - t0
+        pass
+    t0 = time.perf_counter()
+    run(img, filt, iters)
+    secs = max(time.perf_counter() - t0, 1e-9)
     return {
         "workload": f"serial blur3 {H}x{W} {iters} iters",
         "impl": impl,
         "wall_s": round(secs, 4),
-        "gpixels_per_s": round(H * W * iters / secs / 1e9, 5),
+        "gpixels_per_s": float(f"{H * W * iters / secs / 1e9:.5g}"),
     }
