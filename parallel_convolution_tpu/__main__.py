@@ -1,0 +1,8 @@
+"""``python -m parallel_convolution_tpu`` → the pconv-tpu CLI (cli.main)."""
+
+import sys
+
+from parallel_convolution_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,7 +38,29 @@ def _mesh_from_flag(spec: str | None):
     return make_grid_mesh(jax.devices()[: r * c], (r, c))
 
 
+def _apply_platform_env() -> None:
+    """Honor JAX_PLATFORMS even when a site hook pre-imported jax.
+
+    Site hooks may import jax with the launch-time environment snapshotted,
+    so an env var set by the caller never reaches the backend selection —
+    re-apply it through the config (no-op when it already matches).
+    """
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", want)
+        except Exception as e:
+            print(f"pconv-tpu: warning: JAX_PLATFORMS={want} could not be "
+                  f"applied (backend already initialized?): {e}",
+                  file=sys.stderr)
+
+
 def main(argv: list[str] | None = None) -> int:
+    _apply_platform_env()
     ap = argparse.ArgumentParser(prog="pconv-tpu", description=__doc__)
     sub = ap.add_subparsers(dest="cmd", required=True)
 

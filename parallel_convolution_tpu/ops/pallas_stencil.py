@@ -40,6 +40,18 @@ def _round_up(n: int, m: int) -> int:
     return -(-n // m) * m
 
 
+def _sublane(dtype) -> int:
+    """Second-minor HBM/VMEM tile extent for a dtype (f32: 8, bf16: 16, u8: 32).
+
+    Real Mosaic requires HBM DMA slice starts AND shapes aligned to the
+    (sublane, 128) tiling — interpret mode does not enforce this, so every
+    window extent below is rounded to it (first observed as a compile
+    failure on silicon: "Slice shape along dimension 1 must be aligned to
+    tiling (8), but is 258").
+    """
+    return 32 // jnp.dtype(dtype).itemsize
+
+
 def on_tpu() -> bool:
     """True when the default backend drives real TPU silicon.
 
@@ -56,12 +68,14 @@ def on_tpu() -> bool:
 
 
 def _stencil_kernel(hbm_ref, out_ref, scratch, sems, *, taps, k, r, th, tw,
-                    quantize):
+                    ext_h, ext_w, quantize):
     """One grid program: DMA window c,i,j → VMEM, stencil it, emit tile.
 
-    ``scratch`` holds two (th+2r, tw+2r) slots; program n waits on the
-    window it prefetched during program n-1 and starts program n+1's copy
-    before computing (double buffering, slot = parity of linear step).
+    ``scratch`` holds two (ext_h, ext_w) slots — the (th+2r, tw+2r)
+    stencil window rounded up to the HBM tiling (see ``_sublane``); the
+    alignment rim is DMA'd but never read.  Program n waits on the window
+    it prefetched during program n-1 and starts program n+1's copy before
+    computing (double buffering, slot = parity of linear step).
     """
     c, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     ni, nj = pl.num_programs(1), pl.num_programs(2)
@@ -70,7 +84,7 @@ def _stencil_kernel(hbm_ref, out_ref, scratch, sems, *, taps, k, r, th, tw,
 
     def window_copy(cc, ii, jj, slot):
         return pltpu.make_async_copy(
-            hbm_ref.at[cc, pl.ds(ii * th, th + 2 * r), pl.ds(jj * tw, tw + 2 * r)],
+            hbm_ref.at[cc, pl.ds(ii * th, ext_h), pl.ds(jj * tw, ext_w)],
             scratch.at[slot],
             sems.at[slot],
         )
@@ -141,18 +155,24 @@ def correlate_padded_pallas(
     C, Hp, Wp = padded.shape
     H, W = Hp - 2 * r, Wp - 2 * r
 
-    th = min(tile[0], _round_up(H, 8))
-    tw = min(tile[1], _round_up(W, 128))
+    sub = _sublane(padded.dtype)
+    th = min(_round_up(tile[0], sub), _round_up(H, sub))
+    tw = min(_round_up(tile[1], 128), _round_up(W, 128))
     gh, gw = -(-H // th), -(-W // tw)
-    # Round the compute domain up to whole tiles; the rim is garbage-over-
-    # zeros and sliced off below.
-    eh, ew = gh * th + 2 * r - Hp, gw * tw + 2 * r - Wp
-    if eh or ew:
-        padded = jnp.pad(padded, ((0, 0), (0, eh), (0, ew)))
+    # Tile-aligned DMA window: starts i*th / j*tw are aligned because
+    # th % sub == 0 and tw % 128 == 0; extents rounded up from th+2r.
+    ext_h, ext_w = th + _round_up(2 * r, sub), tw + _round_up(2 * r, 128)
+    # Round the compute domain up to whole tiles plus the alignment rim;
+    # the rim is garbage-over-zeros, never read, and sliced off below.
+    eh = (gh - 1) * th + ext_h - Hp
+    ew = (gw - 1) * tw + ext_w - Wp
+    if eh > 0 or ew > 0:
+        padded = jnp.pad(padded, ((0, 0), (0, max(eh, 0)), (0, max(ew, 0))))
 
     taps = tuple(float(t) for t in filt.taps.reshape(-1))
     kernel = functools.partial(
-        _stencil_kernel, taps=taps, k=k, r=r, th=th, tw=tw, quantize=quantize
+        _stencil_kernel, taps=taps, k=k, r=r, th=th, tw=tw,
+        ext_h=ext_h, ext_w=ext_w, quantize=quantize
     )
     # Propagate varying-mesh-axes so the kernel composes under shard_map
     # (check_vma needs the out type to declare what it varies over).
@@ -165,7 +185,7 @@ def correlate_padded_pallas(
         out_shape=jax.ShapeDtypeStruct((C, gh * th, gw * tw), out_dtype,
                                        vma=vma),
         scratch_shapes=[
-            pltpu.VMEM((2, th + 2 * r, tw + 2 * r), padded.dtype),
+            pltpu.VMEM((2, ext_h, ext_w), padded.dtype),
             pltpu.SemaphoreType.DMA((2,)),
         ],
         interpret=interpret,
@@ -187,7 +207,7 @@ def correlate_shifted_pallas(x: jnp.ndarray, filt: Filter, **kw) -> jnp.ndarray:
 
 
 def _fused_kernel(off_ref, hbm_ref, out_ref, scratch, sems, *,
-                  taps, k, r, T, th, tw, valid_hw, quantize):
+                  taps, k, r, T, th, tw, ext_h, ext_w, valid_hw, quantize):
     """T in-VMEM stencil levels on one (th + 2rT, tw + 2rT) window.
 
     The window shrinks by r per level; after each level, positions outside
@@ -200,7 +220,6 @@ def _fused_kernel(off_ref, hbm_ref, out_ref, scratch, sems, *,
     ni, nj = pl.num_programs(1), pl.num_programs(2)
     step = (c * ni + i) * nj + j
     slot = jax.lax.rem(step, 2)
-    ext_h, ext_w = th + 2 * r * T, tw + 2 * r * T
 
     def window_copy(cc, ii, jj, slot):
         return pltpu.make_async_copy(
@@ -224,10 +243,12 @@ def _fused_kernel(off_ref, hbm_ref, out_ref, scratch, sems, *,
 
     window_copy(c, i, j, slot).wait()
 
-    # Global coords of the window's top-left at level 0.
+    # Global coords of the window's top-left at level 0.  The scratch slot
+    # is the (th+2rT, tw+2rT) stencil window plus an alignment rim (bottom/
+    # right) that is DMA'd but dropped here.
     row0 = off_ref[0] - r * T + i * th
     col0 = off_ref[1] - r * T + j * tw
-    cur = scratch[slot].astype(jnp.float32)
+    cur = scratch[slot][: th + 2 * r * T, : tw + 2 * r * T].astype(jnp.float32)
     for s in range(1, T + 1):
         ch, cw = th + 2 * r * (T - s), tw + 2 * r * (T - s)
         acc = jnp.zeros((ch, cw), jnp.float32)
@@ -283,16 +304,21 @@ def fused_iterate_pallas(
     C, Hp, Wp = padded.shape
     h, w = Hp - 2 * r * T, Wp - 2 * r * T
 
-    th = min(tile[0], _round_up(h, 8))
-    tw = min(tile[1], _round_up(w, 128))
+    sub = _sublane(padded.dtype)
+    th = min(_round_up(tile[0], sub), _round_up(h, sub))
+    tw = min(_round_up(tile[1], 128), _round_up(w, 128))
     gh, gw = -(-h // th), -(-w // tw)
-    eh, ew = gh * th + 2 * r * T - Hp, gw * tw + 2 * r * T - Wp
-    if eh or ew:
-        padded = jnp.pad(padded, ((0, 0), (0, eh), (0, ew)))
+    ext_h = th + _round_up(2 * r * T, sub)
+    ext_w = tw + _round_up(2 * r * T, 128)
+    eh = (gh - 1) * th + ext_h - Hp
+    ew = (gw - 1) * tw + ext_w - Wp
+    if eh > 0 or ew > 0:
+        padded = jnp.pad(padded, ((0, 0), (0, max(eh, 0)), (0, max(ew, 0))))
 
     taps = tuple(float(t) for t in filt.taps.reshape(-1))
     kernel = functools.partial(
         _fused_kernel, taps=taps, k=k, r=r, T=T, th=th, tw=tw,
+        ext_h=ext_h, ext_w=ext_w,
         valid_hw=None if valid_hw is None else tuple(valid_hw),
         quantize=quantize,
     )
@@ -308,7 +334,7 @@ def fused_iterate_pallas(
         out_shape=jax.ShapeDtypeStruct((C, gh * th, gw * tw), out_dtype,
                                        vma=vma),
         scratch_shapes=[
-            pltpu.VMEM((2, th + 2 * r * T, tw + 2 * r * T), padded.dtype),
+            pltpu.VMEM((2, ext_h, ext_w), padded.dtype),
             pltpu.SemaphoreType.DMA((2,)),
         ],
         interpret=interpret,
