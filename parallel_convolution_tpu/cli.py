@@ -71,7 +71,8 @@ def main(argv: list[str] | None = None) -> int:
     run.add_argument("--mesh", default=None,
                      help="RxC grid, e.g. 2x4 (default: all devices)")
     run.add_argument("--backend", default="shifted",
-                     choices=["shifted", "pallas", "xla_conv", "separable"])
+                     choices=["shifted", "pallas", "xla_conv", "separable",
+                              "pallas_sep"])
     run.add_argument("--storage", default="f32", choices=["f32", "bf16"],
                      help="iteration-carry dtype; bf16 halves HBM/ICI "
                           "traffic and stays bit-exact for u8 images")

@@ -67,8 +67,50 @@ def on_tpu() -> bool:
     return "tpu" in d.platform.lower() or "tpu" in kind
 
 
-def _stencil_kernel(hbm_ref, out_ref, scratch, sems, *, taps, k, r, th, tw,
-                    ext_h, ext_w, quantize):
+def _sep_taps(filt: Filter, separable: bool):
+    """Static (col_taps, row_taps) float tuples, or None if not requested
+    or the filter has no exact rank-1 factorization."""
+    if not separable:
+        return None
+    sep = filt.separable()
+    if sep is None:
+        return None
+    col, row = sep
+    return (tuple(float(t) for t in col), tuple(float(t) for t in row))
+
+
+def _correlate_window(win, taps, sep, k, th, tw):
+    """Stencil a (th+2r, tw+2r)+ f32-castable window down to (th, tw) f32.
+
+    ``sep = (col_taps, row_taps)`` switches to the rank-1 two-pass form —
+    2k MACs/px instead of k² (ops/conv.correlate_padded_separable's op
+    order: full-height row pass, then column pass), bit-identical to the
+    2D order for dyadic factors over u8-range values.  ``sep=None`` is the
+    normative row-major 2D multiply-add.
+    """
+    if sep is not None:
+        colt, rowt = sep
+        acc1 = jnp.zeros((th + k - 1, tw), jnp.float32)
+        for dx in range(k):
+            acc1 = acc1 + jnp.float32(rowt[dx]) * win[
+                : th + k - 1, dx : dx + tw].astype(jnp.float32)
+        acc = jnp.zeros((th, tw), jnp.float32)
+        for dy in range(k):
+            acc = acc + jnp.float32(colt[dy]) * acc1[dy : dy + th, :]
+        return acc
+    acc = jnp.zeros((th, tw), jnp.float32)
+    idx = 0
+    for dy in range(k):
+        for dx in range(k):
+            # f32 accumulation even for bf16 storage (cast is VPU-free-ish).
+            w = win[dy : dy + th, dx : dx + tw].astype(jnp.float32)
+            acc = acc + jnp.float32(taps[idx]) * w
+            idx += 1
+    return acc
+
+
+def _stencil_kernel(hbm_ref, out_ref, scratch, sems, *, taps, sep, k, r, th,
+                    tw, ext_h, ext_w, quantize):
     """One grid program: DMA window c,i,j → VMEM, stencil it, emit tile.
 
     ``scratch`` holds two (ext_h, ext_w) slots — the (th+2r, tw+2r)
@@ -106,15 +148,7 @@ def _stencil_kernel(hbm_ref, out_ref, scratch, sems, *, taps, k, r, th, tw,
 
     window_copy(c, i, j, slot).wait()
 
-    win = scratch[slot]
-    acc = jnp.zeros((th, tw), jnp.float32)
-    idx = 0
-    for dy in range(k):
-        for dx in range(k):
-            # f32 accumulation even for bf16 storage (cast is VPU-free-ish).
-            w = win[dy : dy + th, dx : dx + tw].astype(jnp.float32)
-            acc = acc + jnp.float32(taps[idx]) * w
-            idx += 1
+    acc = _correlate_window(scratch[slot], taps, sep, k, th, tw)
     if quantize:
         # Fused u8 store-back: saves one full HBM round trip per iteration
         # vs quantizing in a separate XLA fusion after the kernel.
@@ -124,7 +158,8 @@ def _stencil_kernel(hbm_ref, out_ref, scratch, sems, *, taps, k, r, th, tw,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("filt", "tile", "interpret", "quantize", "out_dtype"),
+    static_argnames=("filt", "tile", "interpret", "quantize", "out_dtype",
+                     "separable"),
 )
 def correlate_padded_pallas(
     padded: jnp.ndarray,
@@ -133,6 +168,7 @@ def correlate_padded_pallas(
     interpret: bool | None = None,
     quantize: bool = False,
     out_dtype=None,
+    separable: bool = False,
 ) -> jnp.ndarray:
     """Stencil an already-padded (C, H+2r, W+2r) block → (C, H, W).
 
@@ -145,6 +181,12 @@ def correlate_padded_pallas(
     bf16 storage — quantized values are exact integers ≤ 255, which bf16
     represents exactly, so bf16 carries halve HBM/ICI traffic with no
     semantic change.
+
+    ``separable=True`` uses the rank-1 two-pass form when the filter has an
+    exact dyadic factorization (2k MACs/px instead of k² — the VPU-bound
+    fused path's main cost); silently falls back to 2D otherwise.  Same
+    exactness contract as the XLA 'separable' backend: bit-identical in
+    quantize mode, a rounding-order change in float mode.
     """
     if interpret is None:
         interpret = not on_tpu()
@@ -171,8 +213,8 @@ def correlate_padded_pallas(
 
     taps = tuple(float(t) for t in filt.taps.reshape(-1))
     kernel = functools.partial(
-        _stencil_kernel, taps=taps, k=k, r=r, th=th, tw=tw,
-        ext_h=ext_h, ext_w=ext_w, quantize=quantize
+        _stencil_kernel, taps=taps, sep=_sep_taps(filt, separable),
+        k=k, r=r, th=th, tw=tw, ext_h=ext_h, ext_w=ext_w, quantize=quantize
     )
     # Propagate varying-mesh-axes so the kernel composes under shard_map
     # (check_vma needs the out type to declare what it varies over).
@@ -207,7 +249,8 @@ def correlate_shifted_pallas(x: jnp.ndarray, filt: Filter, **kw) -> jnp.ndarray:
 
 
 def _fused_kernel(off_ref, hbm_ref, out_ref, scratch, sems, *,
-                  taps, k, r, T, th, tw, ext_h, ext_w, valid_hw, quantize):
+                  taps, sep, k, r, T, th, tw, ext_h, ext_w, valid_hw,
+                  quantize):
     """T in-VMEM stencil levels on one (th + 2rT, tw + 2rT) window.
 
     The window shrinks by r per level; after each level, positions outside
@@ -251,13 +294,7 @@ def _fused_kernel(off_ref, hbm_ref, out_ref, scratch, sems, *,
     cur = scratch[slot][: th + 2 * r * T, : tw + 2 * r * T].astype(jnp.float32)
     for s in range(1, T + 1):
         ch, cw = th + 2 * r * (T - s), tw + 2 * r * (T - s)
-        acc = jnp.zeros((ch, cw), jnp.float32)
-        idx = 0
-        for dy in range(k):
-            for dx in range(k):
-                acc = acc + jnp.float32(taps[idx]) * cur[dy : dy + ch,
-                                                         dx : dx + cw]
-                idx += 1
+        acc = _correlate_window(cur, taps, sep, k, ch, cw)
         if quantize:
             acc = jnp.clip(jnp.rint(acc), 0.0, 255.0)
         if valid_hw is not None:  # None = periodic torus: no ghost ring
@@ -275,7 +312,7 @@ def _fused_kernel(off_ref, hbm_ref, out_ref, scratch, sems, *,
 @functools.partial(
     jax.jit,
     static_argnames=("filt", "T", "valid_hw", "tile", "interpret",
-                     "quantize", "out_dtype"),
+                     "quantize", "out_dtype", "separable"),
 )
 def fused_iterate_pallas(
     padded: jnp.ndarray,
@@ -287,6 +324,7 @@ def fused_iterate_pallas(
     interpret: bool | None = None,
     quantize: bool = True,
     out_dtype=None,
+    separable: bool = False,
 ) -> jnp.ndarray:
     """T stencil iterations of a deep-padded (C, h+2rT, w+2rT) block.
 
@@ -317,8 +355,8 @@ def fused_iterate_pallas(
 
     taps = tuple(float(t) for t in filt.taps.reshape(-1))
     kernel = functools.partial(
-        _fused_kernel, taps=taps, k=k, r=r, T=T, th=th, tw=tw,
-        ext_h=ext_h, ext_w=ext_w,
+        _fused_kernel, taps=taps, sep=_sep_taps(filt, separable),
+        k=k, r=r, T=T, th=th, tw=tw, ext_h=ext_h, ext_w=ext_w,
         valid_hw=None if valid_hw is None else tuple(valid_hw),
         quantize=quantize,
     )

@@ -90,12 +90,16 @@ def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
                                    or valid_hw[1] != block_hw[1] * grid[1])
     r = filt.radius
 
+    pallas_like = backend in ("pallas", "pallas_sep")
+    sep = backend == "pallas_sep"
+
     def correlate_level(p, out_dtype):
-        if backend == "pallas":
+        if pallas_like:
             from parallel_convolution_tpu.ops import pallas_stencil
 
             return pallas_stencil.correlate_padded_pallas(
-                p, filt, quantize=quantize, out_dtype=out_dtype
+                p, filt, quantize=quantize, out_dtype=out_dtype,
+                separable=sep,
             )
         out = _correlate_for_backend(backend)(p, filt)
         if quantize:
@@ -105,7 +109,7 @@ def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
     def step(v):
         depth = r * fuse
         p = halo.halo_exchange(v, depth, grid, boundary)
-        if backend == "pallas" and fuse > 1:
+        if pallas_like and fuse > 1:
             # All T levels inside one kernel: one HBM round trip per chunk.
             from parallel_convolution_tpu.ops import pallas_stencil
 
@@ -115,7 +119,7 @@ def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
             ]).astype(jnp.int32)
             return pallas_stencil.fused_iterate_pallas(
                 p, off, filt, fuse, None if periodic else tuple(valid_hw),
-                quantize=quantize, out_dtype=v.dtype,
+                quantize=quantize, out_dtype=v.dtype, separable=sep,
             )
         for t in range(fuse):
             margin = depth - r * (t + 1)
@@ -208,7 +212,7 @@ def _build_converge(mesh: Mesh, filt: Filter, tol: float, max_iters: int,
     return jax.jit(sharded, donate_argnums=0)
 
 
-BACKENDS = ("shifted", "xla_conv", "pallas", "separable")
+BACKENDS = ("shifted", "xla_conv", "pallas", "separable", "pallas_sep")
 STORAGE_DTYPES = {"f32": jnp.float32, "bf16": jnp.bfloat16}
 
 

@@ -30,16 +30,64 @@ from parallel_convolution_tpu.parallel.mesh import (
 )
 
 
+_READBACK_FENCE: bool | None = None
+
+
 def _needs_readback_fence() -> bool:
     """True on experimental proxy platforms where block_until_ready lies.
 
-    Standard backends (cpu/tpu/gpu) really block; proxies (e.g. 'axon')
-    dispatch asynchronously and return "ready" while the stream is still
-    executing — there only a device→host read fences.
+    Standard backends (cpu/tpu/gpu) really block; tunnel proxies dispatch
+    asynchronously and return "ready" while the stream is still executing —
+    there only a device→host read fences.  Detection is two-layer because
+    the proxy can report platform == 'tpu' (measured: axon's
+    ``platform_version`` says "axon ..." while ``device.platform`` says
+    "tpu" and block_until_ready returns ~70 ms early on a ~240 ms program):
+
+    1. name check: platform not a standard backend, or "axon" in the
+       client's platform_version;
+    2. empirical calibration (cached): fence a ~100 ms compiled loop with
+       block_until_ready, then read one element — if the readback takes
+       over 30% of the blocked wall again, the "fence" returned early.
     """
+    global _READBACK_FENCE
+    if _READBACK_FENCE is not None:
+        return _READBACK_FENCE
     try:
-        return jax.devices()[0].platform.lower() not in (
-            "cpu", "tpu", "gpu", "cuda", "rocm")
+        d = jax.devices()[0]
+    except Exception:
+        _READBACK_FENCE = False
+        return False
+    version = (getattr(d.client, "platform_version", "") or "").lower()
+    if d.platform.lower() not in ("cpu", "tpu", "gpu", "cuda", "rocm") or (
+            "axon" in version):
+        _READBACK_FENCE = True
+        return True
+    # CPU's block_until_ready is synchronous by construction, and the
+    # calibration spin would take minutes there — only accelerators both
+    # need the check and finish it in ~tens of ms.
+    _READBACK_FENCE = False if d.platform.lower() == "cpu" else _fence_lies()
+    return _READBACK_FENCE
+
+
+def _fence_lies() -> bool:
+    """Calibrate: does block_until_ready actually wait for completion?"""
+    try:
+        @jax.jit
+        def spin(v):
+            return jax.lax.fori_loop(0, 64, lambda _, a: a @ a, v)
+
+        x = jnp.eye(2048, dtype=jnp.float32) * 0.999
+        r = spin(x)
+        jax.block_until_ready(r)
+        np.asarray(r[0, 0])  # warm compile + transfer path
+        t0 = time.perf_counter()
+        r = spin(x)
+        jax.block_until_ready(r)
+        t_block = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        np.asarray(r[0, 0])
+        t_read = time.perf_counter() - t0
+        return t_read > 0.3 * t_block + 5e-3
     except Exception:
         return False
 
@@ -110,12 +158,40 @@ def bench_iterate(
     fn = step_lib._build_iterate(mesh, filt, iters, quantize, valid_hw,
                                  block_hw, backend, fuse)
     out = fence(fn(xs))  # compile + warmup
-    times = []
-    for _ in range(reps):
+
+    # The fence itself can cost a large constant on tunnel platforms
+    # (~134 ms device→host round trip measured on axon) — time spans of 1
+    # and of ``chain`` chained calls, each ending in ONE fence, and take
+    # the slope: the constant cancels, leaving pure per-call device time.
+    chain = 4 if _needs_readback_fence() else 1
+
+    def span(n):
+        nonlocal out
         t0 = time.perf_counter()
-        out = fence(fn(out))
-        times.append(time.perf_counter() - t0)
-    secs = statistics.median(times)
+        for _ in range(n):
+            out = fn(out)
+        fence(out)
+        return time.perf_counter() - t0
+
+    first = span(1)
+    # When one call already dwarfs the fence constant (~0.15 s), chaining
+    # only multiplies runtime for <5% accuracy — use plain spans.
+    if chain > 1 and first < 3.0:
+        singles, chains = [first], []
+        for i in range(reps):
+            chains.append(span(chain))
+            if i + 1 < reps:
+                singles.append(span(1))
+        secs = (statistics.median(chains) - statistics.median(singles)) / (
+            chain - 1)
+        # Jitter guard: the slope can only shrink the estimate; a negative
+        # or tiny slope means noise swamped the signal — fall back to the
+        # single-span wall (upper bound, honestly conservative).
+        if secs <= 0:
+            secs = statistics.median(singles)
+    else:
+        secs = statistics.median(
+            [first] + [span(1) for _ in range(reps - 1)])
     n_dev = mesh.size
     gpx = H * W * channels * iters / secs / 1e9
     return {
@@ -152,18 +228,42 @@ def bench_halo_p50(
         block_sharding(mesh),
     )
 
-    fn = jax.jit(
-        jax.shard_map(
-            lambda v: halo.halo_exchange(v, r, grid),
-            mesh=mesh, in_specs=P(None, *AXES), out_specs=P(None, *AXES),
-        )
-    )
-    fence(fn(x))  # compile
+    def rounds(n):
+        """n chained halo rounds on-device (pad → re-slice keeps shapes)."""
+
+        def body(v):
+            def one(_, b):
+                p = halo.halo_exchange(b, r, grid)
+                return p[:, r : r + b.shape[1], r : r + b.shape[2]]
+
+            return jax.lax.fori_loop(0, n, one, v)
+
+        return jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=P(None, *AXES), out_specs=P(None, *AXES),
+        ))
+
+    # On tunnel platforms a single fenced call is dominated by the ~140 ms
+    # (±40 ms jitter) device→host fence; a ~20 µs halo round is invisible
+    # unless thousands are chained on-device so the aggregate signal beats
+    # the jitter — then slope out the constant (same trick as
+    # bench_iterate).  Slopes are clamped at 0: a negative slope is pure
+    # jitter, and falling back to the fenced wall would report the tunnel,
+    # not the halo.
+    k = 4096 if _needs_readback_fence() else 1
+    fn1, fnk = rounds(1), rounds(k)
+    fence(fn1(x)), fence(fnk(x))  # compile
     times = []
     for _ in range(trials):
         t0 = time.perf_counter()
-        fence(fn(x))
-        times.append(time.perf_counter() - t0)
+        fence(fn1(x))
+        t1 = time.perf_counter() - t0
+        if k > 1:
+            t0 = time.perf_counter()
+            fence(fnk(x))
+            tk = time.perf_counter() - t0
+            times.append(max((tk - t1) / (k - 1), 0.0))
+        else:
+            times.append(t1)
     times.sort()
     return {
         "block": f"{bh}x{bw}", "radius": r,

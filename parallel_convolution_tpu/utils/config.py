@@ -22,7 +22,7 @@ class RunConfig:
     filter_name: str = "blur3"
     iters: int = 100
     mesh_shape: tuple[int, int] | None = None   # None = all devices
-    backend: str = "shifted"       # shifted | pallas | xla_conv
+    backend: str = "shifted"       # any of parallel.step.BACKENDS
     storage: str = "f32"           # f32 | bf16
     fuse: int = 1
     boundary: str = "zero"
@@ -38,7 +38,11 @@ class RunConfig:
             raise ValueError(f"mode must be grey|rgb, got {self.mode!r}")
         if self.storage not in ("f32", "bf16"):
             raise ValueError(f"storage must be f32|bf16, got {self.storage!r}")
-        if self.backend not in ("shifted", "pallas", "xla_conv", "separable"):
+        # Lazy import: step (hence jax) only loads when a config is built,
+        # and the backend list stays single-source.
+        from parallel_convolution_tpu.parallel.step import BACKENDS
+
+        if self.backend not in BACKENDS:
             raise ValueError(f"unknown backend {self.backend!r}")
         if self.boundary not in ("zero", "periodic"):
             raise ValueError(f"boundary must be zero|periodic, got {self.boundary!r}")

@@ -54,6 +54,34 @@ def test_separable_with_fusion_bf16(grey_odd):
     np.testing.assert_array_equal(np.asarray(out)[0].astype(np.uint8), want)
 
 
+@pytest.mark.parametrize("name", ["blur3", "gaussian5"])
+def test_pallas_sep_backend_bitexact(grey_odd, name):
+    filt = filters.get_filter(name)
+    want = oracle.run_serial_u8(grey_odd, filt, 5)
+    x = imageio.interleaved_to_planar(grey_odd).astype(np.float32)
+    out = step.sharded_iterate(x, filt, 5, mesh=_mesh((2, 2)),
+                               backend="pallas_sep")
+    np.testing.assert_array_equal(np.asarray(out)[0].astype(np.uint8), want)
+
+
+def test_pallas_sep_fallback_nonseparable(grey_small):
+    filt = filters.get_filter("edge3")
+    want = oracle.run_serial_u8(grey_small, filt, 3)
+    x = imageio.interleaved_to_planar(grey_small).astype(np.float32)
+    out = step.sharded_iterate(x, filt, 3, mesh=_mesh((2, 2)),
+                               backend="pallas_sep")
+    np.testing.assert_array_equal(np.asarray(out)[0].astype(np.uint8), want)
+
+
+def test_pallas_sep_fused_bf16(grey_odd):
+    filt = filters.get_filter("blur3")
+    want = oracle.run_serial_u8(grey_odd, filt, 8)
+    x = imageio.interleaved_to_planar(grey_odd).astype(np.float32)
+    out = step.sharded_iterate(x, filt, 8, mesh=_mesh((2, 2)),
+                               backend="pallas_sep", fuse=4, storage="bf16")
+    np.testing.assert_array_equal(np.asarray(out)[0].astype(np.uint8), want)
+
+
 def test_batch_api_matches_individual():
     model = ConvolutionModel(filt="blur3", mesh=_mesh((2, 2)))
     imgs = [imageio.generate_test_image(21, 33, "grey", seed=s)
