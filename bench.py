@@ -62,8 +62,8 @@ def main() -> int:
         ("pallas", "f32", 1, shape),
         ("shifted", "bf16", 4, shape),
         ("pallas", "bf16", 8, shape),
-        ("pallas_sep", "bf16", 8, shape),
         ("pallas_sep", "bf16", 16, shape),
+        ("pallas_sep", "bf16", 32, shape),
     ]
     candidates = {}
     for backend, storage, fuse, cshape in configs:

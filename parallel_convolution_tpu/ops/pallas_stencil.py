@@ -31,9 +31,21 @@ from jax.experimental.pallas import tpu as pltpu
 
 from parallel_convolution_tpu.ops.filters import Filter
 
-# Default output-tile shape: multiples of the f32 (8, 128) VMEM tile; two
-# ~0.5 MB input windows + accumulator fit comfortably in 16 MB VMEM.
+# Default output-tile shapes: multiples of the f32 (8, 128) VMEM tile.
+# Two defaults because Mosaic's scoped-VMEM stack scales differently per
+# kernel form: the 2D tap loop keeps ~k² live (th, tw) f32 temporaries, so
+# big tiles blow the 16 MB scoped limit (1024×512 f32 → 25.3 MB compile
+# error on v5e); the separable form reuses one acc1/acc pair and takes
+# large tiles fine.  Values chosen by scripts/tune_pallas.py on a real
+# v5e (2026-07-29, tile threaded as an explicit static arg: 1024×512
+# fuse32 123.8 Gpx/s vs 256×512 fuse32 116.8 — tile is a ~6% lever,
+# fusion depth the ~4× one; 512×2048 fails Mosaic compile).
 DEFAULT_TILE = (256, 512)
+SEP_TILE = (1024, 512)
+
+
+def _default_tile(sep) -> tuple[int, int]:
+    return SEP_TILE if sep is not None else DEFAULT_TILE
 
 
 def _round_up(n: int, m: int) -> int:
@@ -164,7 +176,7 @@ def _stencil_kernel(hbm_ref, out_ref, scratch, sems, *, taps, sep, k, r, th,
 def correlate_padded_pallas(
     padded: jnp.ndarray,
     filt: Filter,
-    tile: tuple[int, int] = DEFAULT_TILE,
+    tile: tuple[int, int] | None = None,
     interpret: bool | None = None,
     quantize: bool = False,
     out_dtype=None,
@@ -192,6 +204,9 @@ def correlate_padded_pallas(
         interpret = not on_tpu()
     if out_dtype is None:
         out_dtype = padded.dtype if quantize else jnp.float32
+    sep = _sep_taps(filt, separable)
+    if tile is None:
+        tile = _default_tile(sep)
     r = filt.radius
     k = filt.size
     C, Hp, Wp = padded.shape
@@ -213,7 +228,7 @@ def correlate_padded_pallas(
 
     taps = tuple(float(t) for t in filt.taps.reshape(-1))
     kernel = functools.partial(
-        _stencil_kernel, taps=taps, sep=_sep_taps(filt, separable),
+        _stencil_kernel, taps=taps, sep=sep,
         k=k, r=r, th=th, tw=tw, ext_h=ext_h, ext_w=ext_w, quantize=quantize
     )
     # Propagate varying-mesh-axes so the kernel composes under shard_map
@@ -292,19 +307,30 @@ def _fused_kernel(off_ref, hbm_ref, out_ref, scratch, sems, *,
     row0 = off_ref[0] - r * T + i * th
     col0 = off_ref[1] - r * T + j * tw
     cur = scratch[slot][: th + 2 * r * T, : tw + 2 * r * T].astype(jnp.float32)
+    if valid_hw is not None:
+        # Rank-1 ghost-ring mask, iotas hoisted out of the level loop: the
+        # out-of-image region of any level's window is a row band ⊗ column
+        # band, so re-zeroing is two broadcast multiplies per level (~2
+        # VPU ops/px) instead of 2D iota+compare+select (~7).  Branching
+        # around the mask for interior tiles is NOT worth it: one
+        # lax.cond per program measured 40% slower on Mosaic than just
+        # multiplying (it stalls the DMA/compute pipeline).
+        H, W = valid_hw
+        w0h, w0w = th + 2 * r * T, tw + 2 * r * T
+        rows0 = row0 + jax.lax.broadcasted_iota(jnp.int32, (w0h, 1), 0)
+        cols0 = col0 + jax.lax.broadcasted_iota(jnp.int32, (1, w0w), 1)
     for s in range(1, T + 1):
         ch, cw = th + 2 * r * (T - s), tw + 2 * r * (T - s)
         acc = _correlate_window(cur, taps, sep, k, ch, cw)
         if quantize:
             acc = jnp.clip(jnp.rint(acc), 0.0, 255.0)
         if valid_hw is not None:  # None = periodic torus: no ghost ring
-            H, W = valid_hw
-            rows = row0 + r * s + jax.lax.broadcasted_iota(
-                jnp.int32, (ch, cw), 0)
-            cols = col0 + r * s + jax.lax.broadcasted_iota(
-                jnp.int32, (ch, cw), 1)
-            ok = (rows >= 0) & (rows < H) & (cols >= 0) & (cols < W)
-            acc = jnp.where(ok, acc, 0.0)
+            # Level-s window starts r*s deeper; slice the hoisted iotas.
+            rows = rows0[r * s : r * s + ch, :]
+            cols = cols0[:, r * s : r * s + cw]
+            okr = ((rows >= 0) & (rows < H)).astype(jnp.float32)
+            okc = ((cols >= 0) & (cols < W)).astype(jnp.float32)
+            acc = acc * okr * okc
         cur = acc
     out_ref[0] = cur.astype(out_ref.dtype)
 
@@ -320,7 +346,7 @@ def fused_iterate_pallas(
     filt: Filter,
     T: int,
     valid_hw: tuple[int, int],
-    tile: tuple[int, int] = DEFAULT_TILE,
+    tile: tuple[int, int] | None = None,
     interpret: bool | None = None,
     quantize: bool = True,
     out_dtype=None,
@@ -338,6 +364,9 @@ def fused_iterate_pallas(
         interpret = not on_tpu()
     if out_dtype is None:
         out_dtype = padded.dtype
+    sep = _sep_taps(filt, separable)
+    if tile is None:
+        tile = _default_tile(sep)
     r, k = filt.radius, filt.size
     C, Hp, Wp = padded.shape
     h, w = Hp - 2 * r * T, Wp - 2 * r * T
@@ -355,7 +384,7 @@ def fused_iterate_pallas(
 
     taps = tuple(float(t) for t in filt.taps.reshape(-1))
     kernel = functools.partial(
-        _fused_kernel, taps=taps, sep=_sep_taps(filt, separable),
+        _fused_kernel, taps=taps, sep=sep,
         k=k, r=r, T=T, th=th, tw=tw, ext_h=ext_h, ext_w=ext_w,
         valid_hw=None if valid_hw is None else tuple(valid_hw),
         quantize=quantize,

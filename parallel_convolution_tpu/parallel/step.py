@@ -40,6 +40,10 @@ from parallel_convolution_tpu.parallel.mesh import (
     make_grid_mesh,
     padded_extent,
 )
+from parallel_convolution_tpu.utils.config import BACKENDS  # canonical list
+
+__all__ = ["BACKENDS", "STORAGE_DTYPES", "sharded_iterate", "sharded_converge",
+           "iterate_prepared"]
 
 
 def _valid_mask(valid_hw, block_hw, margin: int = 0):
@@ -63,7 +67,8 @@ def _valid_mask(valid_hw, block_hw, margin: int = 0):
 
 
 def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
-                     backend: str, fuse: int = 1, boundary: str = "zero"):
+                     backend: str, fuse: int = 1, boundary: str = "zero",
+                     tile: tuple[int, int] | None = None):
     """``fuse`` iterations on a local block per halo exchange.
 
     fuse=1 is the reference's loop shape: exchange 1-deep halos, stencil,
@@ -99,7 +104,7 @@ def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
 
             return pallas_stencil.correlate_padded_pallas(
                 p, filt, quantize=quantize, out_dtype=out_dtype,
-                separable=sep,
+                separable=sep, tile=tile,
             )
         out = _correlate_for_backend(backend)(p, filt)
         if quantize:
@@ -120,6 +125,7 @@ def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
             return pallas_stencil.fused_iterate_pallas(
                 p, off, filt, fuse, None if periodic else tuple(valid_hw),
                 quantize=quantize, out_dtype=v.dtype, separable=sep,
+                tile=tile,
             )
         for t in range(fuse):
             margin = depth - r * (t + 1)
@@ -142,7 +148,8 @@ def _check_block_size(filt: Filter, block_hw) -> None:
 @lru_cache(maxsize=64)
 def _build_iterate(mesh: Mesh, filt: Filter, iters: int, quantize: bool,
                    valid_hw, block_hw, backend: str, fuse: int = 1,
-                   boundary: str = "zero"):
+                   boundary: str = "zero",
+                   tile: tuple[int, int] | None = None):
     """Compile the fixed-count iteration runner for one (mesh, config)."""
     grid = grid_shape(mesh)
     _check_block_size(filt, block_hw)
@@ -152,10 +159,10 @@ def _build_iterate(mesh: Mesh, filt: Filter, iters: int, quantize: bool,
             f"fuse={fuse} needs blocks >= {filt.radius * fuse}, got {block_hw}"
         )
     chunk = _make_block_step(filt, grid, valid_hw, block_hw, quantize,
-                             backend, fuse, boundary)
+                             backend, fuse, boundary, tile)
     n_chunks, rem = divmod(iters, fuse)
     tail = (_make_block_step(filt, grid, valid_hw, block_hw, quantize,
-                             backend, rem, boundary) if rem else None)
+                             backend, rem, boundary, tile) if rem else None)
 
     def body(block):
         block = lax.fori_loop(0, n_chunks, lambda _, v: chunk(v), block)
@@ -212,7 +219,6 @@ def _build_converge(mesh: Mesh, filt: Filter, tol: float, max_iters: int,
     return jax.jit(sharded, donate_argnums=0)
 
 
-BACKENDS = ("shifted", "xla_conv", "pallas", "separable", "pallas_sep")
 STORAGE_DTYPES = {"f32": jnp.float32, "bf16": jnp.bfloat16}
 
 

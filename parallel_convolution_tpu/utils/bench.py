@@ -141,8 +141,14 @@ def bench_iterate(
     storage: str = "f32",
     fuse: int = 1,
     reps: int = 3,
+    tile: tuple[int, int] | None = None,
 ) -> dict:
-    """Gpixels/sec/chip for the standard fixed-iteration workload."""
+    """Gpixels/sec/chip for the standard fixed-iteration workload.
+
+    ``tile`` overrides the Pallas output-tile shape (None = per-kernel
+    default) — passed explicitly because it is a static jit argument;
+    monkeypatching the module defaults does NOT reach already-traced
+    kernels."""
     if mesh is None:
         mesh = make_grid_mesh()
     H, W = shape
@@ -156,7 +162,7 @@ def bench_iterate(
     # real pipeline gets.
     xs, valid_hw, block_hw = step_lib._prepare(x, mesh, filt.radius, storage)
     fn = step_lib._build_iterate(mesh, filt, iters, quantize, valid_hw,
-                                 block_hw, backend, fuse)
+                                 block_hw, backend, fuse, tile=tile)
     out = fence(fn(xs))  # compile + warmup
 
     # The fence itself can cost a large constant on tunnel platforms

@@ -11,6 +11,11 @@ from __future__ import annotations
 import dataclasses
 import json
 
+# Canonical backend registry.  Lives here (jax-free module) so config
+# validation stays dependency-light; parallel.step re-exports it and maps
+# names to implementations.
+BACKENDS = ("shifted", "xla_conv", "pallas", "separable", "pallas_sep")
+
 
 @dataclasses.dataclass
 class RunConfig:
@@ -38,10 +43,6 @@ class RunConfig:
             raise ValueError(f"mode must be grey|rgb, got {self.mode!r}")
         if self.storage not in ("f32", "bf16"):
             raise ValueError(f"storage must be f32|bf16, got {self.storage!r}")
-        # Lazy import: step (hence jax) only loads when a config is built,
-        # and the backend list stays single-source.
-        from parallel_convolution_tpu.parallel.step import BACKENDS
-
         if self.backend not in BACKENDS:
             raise ValueError(f"unknown backend {self.backend!r}")
         if self.boundary not in ("zero", "periodic"):

@@ -1,0 +1,7 @@
+"""Make the repo root importable when a script runs as `python scripts/x.py`
+(sys.path[0] is then scripts/, not the repo root)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -15,6 +15,8 @@ import json
 import sys
 import time
 
+import _path  # noqa: F401  (repo root onto sys.path)
+
 
 def main() -> int:
     ap = argparse.ArgumentParser()

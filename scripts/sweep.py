@@ -18,6 +18,8 @@ import json
 import os
 import sys
 
+import _path  # noqa: F401  (repo root onto sys.path)
+
 
 def main() -> int:
     ap = argparse.ArgumentParser()

@@ -1,10 +1,9 @@
 #!/usr/bin/env python
 """Tile-size tuner for the Pallas stencil kernels (run on a real TPU).
 
-Sweeps (tile_h, tile_w) for the one-step kernel and fusion depth T for the
-fused kernel on a fixed workload, printing a JSON row per point and the
-winner. Use the winner to update ``ops/pallas_stencil.DEFAULT_TILE`` /
-bench fuse depth.
+Sweeps (tile_h, tile_w) and fusion depth T on a fixed workload, printing a
+JSON row per point and the winner. Use the winner to update
+``ops/pallas_stencil.DEFAULT_TILE`` / ``SEP_TILE`` and the bench fuse depth.
 
   python scripts/tune_pallas.py --size 8192 --iters 20
 """
@@ -14,7 +13,8 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-import time
+
+import _path  # noqa: F401  (repo root onto sys.path)
 
 
 def main() -> int:
@@ -22,14 +22,18 @@ def main() -> int:
     ap.add_argument("--size", type=int, default=8192)
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--storage", default="bf16")
+    ap.add_argument("--backend", default="pallas",
+                    choices=["pallas", "pallas_sep"])
+    ap.add_argument("--tiles", default=None,
+                    help="comma list of HxW tiles, e.g. 1024x512,128x512")
+    ap.add_argument("--fuses", default=None,
+                    help="comma list of fusion depths, e.g. 16,32,64")
     args = ap.parse_args()
 
     import jax
     import numpy as np
 
-    from parallel_convolution_tpu.ops import pallas_stencil
     from parallel_convolution_tpu.ops.filters import get_filter
-    from parallel_convolution_tpu.parallel import step
     from parallel_convolution_tpu.parallel.mesh import make_grid_mesh
     from parallel_convolution_tpu.utils import bench
 
@@ -38,17 +42,24 @@ def main() -> int:
     H = W = args.size
     results = []
 
-    for tile in [(128, 512), (256, 256), (256, 512), (256, 1024),
-                 (512, 512), (512, 1024), (1024, 512)]:
-        for fuse in (1, 2, 4, 8, 16):
-            old = pallas_stencil.DEFAULT_TILE
-            pallas_stencil.DEFAULT_TILE = tile
-            # new compile per tile: drop the runner cache
-            step._build_iterate.cache_clear()
+    tiles = [(128, 512), (256, 256), (256, 512), (256, 1024),
+             (512, 512), (512, 1024), (1024, 512)]
+    if args.tiles:
+        tiles = [tuple(int(v) for v in t.split("x"))
+                 for t in args.tiles.split(",")]
+    fuses = (1, 2, 4, 8, 16)
+    if args.fuses:
+        fuses = tuple(int(v) for v in args.fuses.split(","))
+    for tile in tiles:
+        for fuse in fuses:
+            # tile is threaded through as an explicit static jit argument —
+            # monkeypatching the module defaults does NOT reach
+            # already-traced kernels (each (tile, fuse) point gets its own
+            # compile this way).
             try:
                 row = bench.bench_iterate(
-                    (H, W), filt, args.iters, mesh=mesh, backend="pallas",
-                    storage=args.storage, fuse=fuse, reps=2,
+                    (H, W), filt, args.iters, mesh=mesh, backend=args.backend,
+                    storage=args.storage, fuse=fuse, reps=2, tile=tile,
                 )
                 row.update(tile=f"{tile[0]}x{tile[1]}")
                 results.append(row)
@@ -57,8 +68,6 @@ def main() -> int:
                 print(json.dumps({"tile": f"{tile[0]}x{tile[1]}",
                                   "fuse": fuse, "error": repr(e)[:150]}),
                       flush=True)
-            finally:
-                pallas_stencil.DEFAULT_TILE = old
 
     if results:
         best = max(results, key=lambda r: r["gpixels_per_s_per_chip"])
