@@ -38,29 +38,10 @@ def _mesh_from_flag(spec: str | None):
     return make_grid_mesh(jax.devices()[: r * c], (r, c))
 
 
-def _apply_platform_env() -> None:
-    """Honor JAX_PLATFORMS even when a site hook pre-imported jax.
-
-    Site hooks may import jax with the launch-time environment snapshotted,
-    so an env var set by the caller never reaches the backend selection —
-    re-apply it through the config (no-op when it already matches).
-    """
-    import os
-
-    want = os.environ.get("JAX_PLATFORMS")
-    if want:
-        try:
-            import jax
-
-            jax.config.update("jax_platforms", want)
-        except Exception as e:
-            print(f"pconv-tpu: warning: JAX_PLATFORMS={want} could not be "
-                  f"applied (backend already initialized?): {e}",
-                  file=sys.stderr)
-
-
 def main(argv: list[str] | None = None) -> int:
-    _apply_platform_env()
+    from parallel_convolution_tpu.utils.platform import apply_platform_env
+
+    apply_platform_env()
     ap = argparse.ArgumentParser(prog="pconv-tpu", description=__doc__)
     sub = ap.add_subparsers(dest="cmd", required=True)
 
@@ -70,12 +51,14 @@ def main(argv: list[str] | None = None) -> int:
     run.add_argument("--filter", default="blur3", dest="filter_name")
     run.add_argument("--mesh", default=None,
                      help="RxC grid, e.g. 2x4 (default: all devices)")
-    run.add_argument("--backend", default="shifted",
-                     choices=["shifted", "pallas", "xla_conv", "separable",
-                              "pallas_sep"])
-    run.add_argument("--storage", default="f32", choices=["f32", "bf16"],
-                     help="iteration-carry dtype; bf16 halves HBM/ICI "
-                          "traffic and stays bit-exact for u8 images")
+    # Choices come from the canonical jax-free registries so a new backend
+    # or storage tier lands in the CLI without a second edit.
+    from parallel_convolution_tpu.utils.config import BACKENDS, STORAGES
+
+    run.add_argument("--backend", default="shifted", choices=list(BACKENDS))
+    run.add_argument("--storage", default="f32", choices=list(STORAGES),
+                     help="iteration-carry dtype; narrower carries shrink "
+                          "HBM/ICI traffic and stay bit-exact for u8 images")
     run.add_argument("--fuse", type=int, default=1, metavar="T",
                      help="iterations per halo exchange (temporal fusion)")
     run.add_argument("--boundary", default="zero",

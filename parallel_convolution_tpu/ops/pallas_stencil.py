@@ -64,19 +64,9 @@ def _sublane(dtype) -> int:
     return 32 // jnp.dtype(dtype).itemsize
 
 
-def on_tpu() -> bool:
-    """True when the default backend drives real TPU silicon.
-
-    Checks device_kind too: experimental PJRT proxies (e.g. platform
-    'axon') report a platform name != 'tpu' while still being TPUs — the
-    Mosaic path must be used there, not the interpreter.
-    """
-    try:
-        d = jax.devices()[0]
-    except Exception:
-        return False
-    kind = (getattr(d, "device_kind", "") or "").lower()
-    return "tpu" in d.platform.lower() or "tpu" in kind
+# Canonical implementation lives in utils.platform; re-exported here because
+# kernel call sites (and the driver bench) historically import it from ops.
+from parallel_convolution_tpu.utils.platform import on_tpu  # noqa: E402
 
 
 def _sep_taps(filt: Filter, separable: bool):
@@ -308,13 +298,15 @@ def _fused_kernel(off_ref, hbm_ref, out_ref, scratch, sems, *,
     col0 = off_ref[1] - r * T + j * tw
     cur = scratch[slot][: th + 2 * r * T, : tw + 2 * r * T].astype(jnp.float32)
     if valid_hw is not None:
-        # Rank-1 ghost-ring mask, iotas hoisted out of the level loop: the
+        # Ghost-ring mask with iotas hoisted out of the level loop: the
         # out-of-image region of any level's window is a row band ⊗ column
-        # band, so re-zeroing is two broadcast multiplies per level (~2
-        # VPU ops/px) instead of 2D iota+compare+select (~7).  Branching
-        # around the mask for interior tiles is NOT worth it: one
-        # lax.cond per program measured 40% slower on Mosaic than just
-        # multiplying (it stalls the DMA/compute pipeline).
+        # band, so per level only two 1D compares + one broadcast select
+        # remain (the 2D iota construction happens once).  A select, not a
+        # multiplicative mask, so non-finite garbage in the ring can never
+        # leak through (0 * NaN = NaN).  Branching around the mask for
+        # interior tiles is NOT worth it: one lax.cond per program
+        # measured 40% slower on Mosaic than unconditional masking (it
+        # stalls the DMA/compute pipeline).
         H, W = valid_hw
         w0h, w0w = th + 2 * r * T, tw + 2 * r * T
         rows0 = row0 + jax.lax.broadcasted_iota(jnp.int32, (w0h, 1), 0)
@@ -328,9 +320,12 @@ def _fused_kernel(off_ref, hbm_ref, out_ref, scratch, sems, *,
             # Level-s window starts r*s deeper; slice the hoisted iotas.
             rows = rows0[r * s : r * s + ch, :]
             cols = cols0[:, r * s : r * s + cw]
-            okr = ((rows >= 0) & (rows < H)).astype(jnp.float32)
-            okc = ((cols >= 0) & (cols < W)).astype(jnp.float32)
-            acc = acc * okr * okc
+            okr = (rows >= 0) & (rows < H)
+            okc = (cols >= 0) & (cols < W)
+            # Select, not multiply-by-mask: 0 * NaN = NaN, so a non-finite
+            # value in the masked region would survive a multiplicative
+            # mask; where() forces the ghost ring to 0 unconditionally.
+            acc = jnp.where(okr & okc, acc, 0.0)
         cur = acc
     out_ref[0] = cur.astype(out_ref.dtype)
 

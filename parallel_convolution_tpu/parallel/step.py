@@ -222,6 +222,13 @@ def _build_converge(mesh: Mesh, filt: Filter, tol: float, max_iters: int,
 
 STORAGE_DTYPES = {"f32": jnp.float32, "bf16": jnp.bfloat16}
 
+# The jax-free registry (which the CLI/RunConfig validate against) and the
+# dtype map here must never drift: a name accepted there but missing here
+# would KeyError deep inside _prepare.
+from parallel_convolution_tpu.utils.config import STORAGES as _STORAGES  # noqa: E402
+
+assert tuple(STORAGE_DTYPES) == _STORAGES, (STORAGE_DTYPES, _STORAGES)
+
 
 def _correlate_for_backend(backend: str):
     if backend == "shifted":

@@ -28,68 +28,10 @@ from parallel_convolution_tpu.parallel import halo, step as step_lib
 from parallel_convolution_tpu.parallel.mesh import (
     AXES, block_sharding, grid_shape, make_grid_mesh,
 )
-
-
-_READBACK_FENCE: bool | None = None
-
-
-def _needs_readback_fence() -> bool:
-    """True on experimental proxy platforms where block_until_ready lies.
-
-    Standard backends (cpu/tpu/gpu) really block; tunnel proxies dispatch
-    asynchronously and return "ready" while the stream is still executing —
-    there only a device→host read fences.  Detection is two-layer because
-    the proxy can report platform == 'tpu' (measured: axon's
-    ``platform_version`` says "axon ..." while ``device.platform`` says
-    "tpu" and block_until_ready returns ~70 ms early on a ~240 ms program):
-
-    1. name check: platform not a standard backend, or "axon" in the
-       client's platform_version;
-    2. empirical calibration (cached): fence a ~100 ms compiled loop with
-       block_until_ready, then read one element — if the readback takes
-       over 30% of the blocked wall again, the "fence" returned early.
-    """
-    global _READBACK_FENCE
-    if _READBACK_FENCE is not None:
-        return _READBACK_FENCE
-    try:
-        d = jax.devices()[0]
-    except Exception:
-        _READBACK_FENCE = False
-        return False
-    version = (getattr(d.client, "platform_version", "") or "").lower()
-    if d.platform.lower() not in ("cpu", "tpu", "gpu", "cuda", "rocm") or (
-            "axon" in version):
-        _READBACK_FENCE = True
-        return True
-    # CPU's block_until_ready is synchronous by construction, and the
-    # calibration spin would take minutes there — only accelerators both
-    # need the check and finish it in ~tens of ms.
-    _READBACK_FENCE = False if d.platform.lower() == "cpu" else _fence_lies()
-    return _READBACK_FENCE
-
-
-def _fence_lies() -> bool:
-    """Calibrate: does block_until_ready actually wait for completion?"""
-    try:
-        @jax.jit
-        def spin(v):
-            return jax.lax.fori_loop(0, 64, lambda _, a: a @ a, v)
-
-        x = jnp.eye(2048, dtype=jnp.float32) * 0.999
-        r = spin(x)
-        jax.block_until_ready(r)
-        np.asarray(r[0, 0])  # warm compile + transfer path
-        t0 = time.perf_counter()
-        r = spin(x)
-        jax.block_until_ready(r)
-        t_block = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        np.asarray(r[0, 0])
-        t_read = time.perf_counter() - t0
-        return t_read > 0.3 * t_block + 5e-3
-    except Exception:
-        return False
+from parallel_convolution_tpu.utils.platform import (
+    needs_readback_fence as _needs_readback_fence,
+    timing_mode,
+)
 
 
 def fence(x):
@@ -187,7 +129,9 @@ def bench_iterate(
     first = span(1)
     # When one call already dwarfs the fence constant (~0.15 s), chaining
     # only multiplies runtime for <5% accuracy — use plain spans.
+    mode = "fence"
     if chain > 1 and first < 3.0:
+        mode = "slope"
         # Size the chain so the chained span carries ~1 s of device work:
         # for millisecond workloads a chain of 4 leaves the slope signal
         # under the ±40 ms fence jitter, and the old single-span fallback
@@ -221,6 +165,11 @@ def bench_iterate(
         "wall_s": round(secs, 4),
         "gpixels_per_s": round(gpx, 3),
         "gpixels_per_s_per_chip": round(gpx / n_dev, 3),
+        # Which wall scheme ACTUALLY produced this row ('slope' = chained
+        # spans with the fence constant cancelled; 'fence' = plain fenced
+        # spans, used on standard backends and for multi-second walls where
+        # the fence constant is <5%) — keeps results auditable.
+        "timing": mode,
     }
 
 
@@ -239,6 +188,16 @@ def bench_halo_p50(
         mesh = make_grid_mesh()
     grid = grid_shape(mesh)
     bh, bw = block_shape
+    if mesh.size == 1:
+        # On a 1×1 mesh halo_exchange._shift short-circuits to zeros_like —
+        # there is NO collective, so any number "measured" here would be
+        # the latency of nothing.  Refuse with an explicit sentinel rather
+        # than record a vacuous 0.0 (round-1 BENCH did exactly that).
+        return {
+            "block": f"{bh}x{bw}", "radius": r,
+            "mesh": "1x1", "p50_us": None, "p90_us": None,
+            "unmeasurable": "1x1 mesh has no collective to time",
+        }
     H, W = bh * grid[0], bw * grid[1]
     x = jax.device_put(
         np.random.default_rng(0).random((1, H, W)).astype(np.float32),
@@ -270,6 +229,7 @@ def bench_halo_p50(
     fn1, fnk = rounds(1), rounds(k)
     fence(fn1(x)), fence(fnk(x))  # compile
     times = []
+    clamped = 0
     for _ in range(trials):
         t0 = time.perf_counter()
         fence(fn1(x))
@@ -278,16 +238,37 @@ def bench_halo_p50(
             t0 = time.perf_counter()
             fence(fnk(x))
             tk = time.perf_counter() - t0
-            times.append(max((tk - t1) / (k - 1), 0.0))
+            slope = (tk - t1) / (k - 1)
+            if slope <= 0:
+                # Negative slope = fence jitter swamped 4096 chained
+                # rounds; count it instead of recording an impossible
+                # 0 µs latency as if it were a measurement.
+                clamped += 1
+                slope = 0.0
+            times.append(slope)
         else:
             times.append(t1)
     times.sort()
-    return {
+    p50 = 1e6 * times[len(times) // 2]
+    p90 = 1e6 * times[int(len(times) * 0.9)]
+    row = {
         "block": f"{bh}x{bw}", "radius": r,
         "mesh": "x".join(str(s) for s in grid),
-        "p50_us": round(1e6 * times[len(times) // 2], 1),
-        "p90_us": round(1e6 * times[int(len(times) * 0.9)], 1),
+        "p50_us": round(p50, 1),
+        "p90_us": round(p90, 1),
+        "timing": timing_mode(),
     }
+    if clamped:
+        row["clamped_trials"] = clamped
+    if p50 <= 0.0 and clamped:
+        # The median itself sits on the clamp: the signal never rose above
+        # the noise floor, so there is no measurement — null, flagged.
+        # Same for a clamped p90: 0.0 µs is impossible, not a tail latency.
+        row["p50_us"] = None
+        row["noise_floor"] = True
+        if p90 <= 0.0:
+            row["p90_us"] = None
+    return row
 
 
 def bench_oracle_proxy(shape=(1920, 2520), iters: int = 2) -> dict:

@@ -11,10 +11,12 @@ from __future__ import annotations
 import dataclasses
 import json
 
-# Canonical backend registry.  Lives here (jax-free module) so config
-# validation stays dependency-light; parallel.step re-exports it and maps
-# names to implementations.
+# Canonical backend/storage registries.  Live here (jax-free module) so
+# config validation stays dependency-light; parallel.step maps the names to
+# implementations (and asserts it covers them), the CLI builds its choices
+# from them.
 BACKENDS = ("shifted", "xla_conv", "pallas", "separable", "pallas_sep")
+STORAGES = ("f32", "bf16")
 
 
 @dataclasses.dataclass
@@ -41,8 +43,9 @@ class RunConfig:
     def __post_init__(self) -> None:
         if self.mode not in ("grey", "rgb"):
             raise ValueError(f"mode must be grey|rgb, got {self.mode!r}")
-        if self.storage not in ("f32", "bf16"):
-            raise ValueError(f"storage must be f32|bf16, got {self.storage!r}")
+        if self.storage not in STORAGES:
+            raise ValueError(
+                f"storage must be one of {STORAGES}, got {self.storage!r}")
         if self.backend not in BACKENDS:
             raise ValueError(f"unknown backend {self.backend!r}")
         if self.boundary not in ("zero", "periodic"):

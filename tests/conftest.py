@@ -15,13 +15,28 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import jax
-
 # Force CPU even when the ambient env pins a TPU platform (JAX_PLATFORMS=axon
 # here).  jax may already be imported by a site hook with the old env
 # snapshot, so go through jax.config (valid until a backend initializes).
 # Override with PCTPU_TEST_PLATFORM=tpu to run the suite on a real chip.
-jax.config.update("jax_platforms", os.environ.get("PCTPU_TEST_PLATFORM", "cpu"))
+from parallel_convolution_tpu.utils.platform import force_platform
+
+_want = os.environ.get("PCTPU_TEST_PLATFORM", "cpu")
+force_platform(_want)
+
+import jax
+
+# Fail LOUDLY at collection if the pin didn't take (e.g. a site hook already
+# initialized a backend): silently running the suite on the TPU proxy would
+# break interpret-mode assumptions and burn real chip time.
+_got = jax.devices()[0].platform
+if _want == "cpu" and _got != "cpu":
+    # Only the hermetic default is enforced: a deliberate tpu/axon override
+    # may legitimately report platform 'tpu' under a proxy name.
+    raise RuntimeError(
+        f"test platform pin failed: wanted 'cpu', backend initialized "
+        f"on {_got!r} (did something import/init jax before conftest?)"
+    )
 
 import numpy as np
 import pytest

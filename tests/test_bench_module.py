@@ -25,6 +25,29 @@ def test_bench_halo_p50():
     assert row["block"] == "32x128"
 
 
+def test_bench_halo_p50_refuses_1x1():
+    # A 1×1 mesh has no collective; the row must be an explicit sentinel,
+    # never a vacuous 0.0 (round-1 regression).
+    row = bench.bench_halo_p50((32, 128), r=1, mesh=_mesh((1, 1)), trials=2)
+    assert row["p50_us"] is None and row["p90_us"] is None
+    assert "no collective" in row["unmeasurable"]
+
+
+def test_bench_rows_carry_timing_mode():
+    row = bench.bench_iterate((32, 128), get_filter("blur3"), 2,
+                              mesh=_mesh((1, 1)), reps=1)
+    assert row["timing"] in ("slope", "fence")
+
+
+def test_halo_proxy_subprocess():
+    from parallel_convolution_tpu.utils import halo_proxy
+
+    row = halo_proxy.run_in_subprocess(n_devices=4, timeout=600)
+    assert row.get("proxy") == "cpu-mesh", row
+    assert row["devices"] == 4
+    assert row["p50_us"] is None or row["p50_us"] >= 0
+
+
 def test_bench_oracle_proxy_small():
     row = bench.bench_oracle_proxy((64, 64), iters=1)
     assert row["gpixels_per_s"] > 0
