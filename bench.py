@@ -23,10 +23,11 @@ def main() -> int:
     import jax
 
     from parallel_convolution_tpu.utils.platform import (
-        apply_platform_env, on_tpu,
+        apply_platform_env, enable_compile_cache, on_tpu,
     )
 
     apply_platform_env()
+    enable_compile_cache()
 
     from parallel_convolution_tpu.ops.filters import get_filter
     from parallel_convolution_tpu.parallel.mesh import make_grid_mesh
@@ -56,6 +57,10 @@ def main() -> int:
         ("pallas", "bf16", 8, shape),
         ("pallas_sep", "bf16", 16, shape),
         ("pallas_sep", "bf16", 32, shape),
+        # u8 carries: the reference's own buffer dtype — quarter the HBM
+        # traffic of f32; exact by construction in quantize mode.
+        ("pallas_sep", "u8", 16, shape),
+        ("pallas_sep", "u8", 32, shape),
     ]
     candidates = {}
     for backend, storage, fuse, cshape in configs:
@@ -97,7 +102,7 @@ def main() -> int:
     except Exception as e:
         print(f"# halo bench failed: {e!r}", file=sys.stderr)
     halo_proxy = {}
-    if not halo_row or halo_row.get("mesh") == "1x1":
+    if halo_row.get("mesh") == "1x1":
         # Only the single-chip case earns the proxy; a null from a REAL
         # multi-device mesh (noise floor, error) must stay an explained
         # null, not be papered over with a CPU number.

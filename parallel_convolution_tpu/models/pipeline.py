@@ -51,6 +51,7 @@ class ConvolutionModel:
             self.filt = get_filter(self.filt)
         if self.mesh is None:
             self.mesh = make_grid_mesh()
+        step_lib._check_storage(self.storage, self.quantize)
 
     # -- array-level API ----------------------------------------------------
     def run_planar(self, x, iters: int) -> jnp.ndarray:

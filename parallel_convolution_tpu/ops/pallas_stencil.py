@@ -69,6 +69,21 @@ def _sublane(dtype) -> int:
 from parallel_convolution_tpu.utils.platform import on_tpu  # noqa: E402
 
 
+def _to_f32(v):
+    """Dtype-to-f32 inside a kernel; Mosaic (this jaxlib) has no direct
+    u8↔f32 cast, so uint8 hops through int32 (exact for 0..255)."""
+    if v.dtype == jnp.uint8:
+        v = v.astype(jnp.int32)
+    return v.astype(jnp.float32)
+
+
+def _from_f32(v, dtype):
+    """f32-to-storage-dtype inside a kernel (same Mosaic u8 hop)."""
+    if jnp.dtype(dtype) == jnp.uint8:
+        return v.astype(jnp.int32).astype(jnp.uint8)
+    return v.astype(dtype)
+
+
 def _sep_taps(filt: Filter, separable: bool):
     """Static (col_taps, row_taps) float tuples, or None if not requested
     or the filter has no exact rank-1 factorization."""
@@ -94,8 +109,8 @@ def _correlate_window(win, taps, sep, k, th, tw):
         colt, rowt = sep
         acc1 = jnp.zeros((th + k - 1, tw), jnp.float32)
         for dx in range(k):
-            acc1 = acc1 + jnp.float32(rowt[dx]) * win[
-                : th + k - 1, dx : dx + tw].astype(jnp.float32)
+            acc1 = acc1 + jnp.float32(rowt[dx]) * _to_f32(
+                win[: th + k - 1, dx : dx + tw])
         acc = jnp.zeros((th, tw), jnp.float32)
         for dy in range(k):
             acc = acc + jnp.float32(colt[dy]) * acc1[dy : dy + th, :]
@@ -104,8 +119,8 @@ def _correlate_window(win, taps, sep, k, th, tw):
     idx = 0
     for dy in range(k):
         for dx in range(k):
-            # f32 accumulation even for bf16 storage (cast is VPU-free-ish).
-            w = win[dy : dy + th, dx : dx + tw].astype(jnp.float32)
+            # f32 accumulation even for narrow storage (cast is VPU-free-ish).
+            w = _to_f32(win[dy : dy + th, dx : dx + tw])
             acc = acc + jnp.float32(taps[idx]) * w
             idx += 1
     return acc
@@ -155,7 +170,7 @@ def _stencil_kernel(hbm_ref, out_ref, scratch, sems, *, taps, sep, k, r, th,
         # Fused u8 store-back: saves one full HBM round trip per iteration
         # vs quantizing in a separate XLA fusion after the kernel.
         acc = jnp.clip(jnp.rint(acc), 0.0, 255.0)
-    out_ref[0] = acc.astype(out_ref.dtype)
+    out_ref[0] = _from_f32(acc, out_ref.dtype)
 
 
 @functools.partial(
@@ -296,38 +311,44 @@ def _fused_kernel(off_ref, hbm_ref, out_ref, scratch, sems, *,
     # right) that is DMA'd but dropped here.
     row0 = off_ref[0] - r * T + i * th
     col0 = off_ref[1] - r * T + j * tw
-    cur = scratch[slot][: th + 2 * r * T, : tw + 2 * r * T].astype(jnp.float32)
+    cur = _to_f32(scratch[slot][: th + 2 * r * T, : tw + 2 * r * T])
     if valid_hw is not None:
-        # Ghost-ring mask with iotas hoisted out of the level loop: the
-        # out-of-image region of any level's window is a row band ⊗ column
-        # band, so per level only two 1D compares + one broadcast select
-        # remain (the 2D iota construction happens once).  A select, not a
-        # multiplicative mask, so non-finite garbage in the ring can never
-        # leak through (0 * NaN = NaN).  Branching around the mask for
-        # interior tiles is NOT worth it: one lax.cond per program
-        # measured 40% slower on Mosaic than unconditional masking (it
-        # stalls the DMA/compute pipeline).
+        # Ghost-ring masking in two tiers (None = periodic torus: no ring):
+        #
+        # 1. ONE select on the level-0 window: out-of-image positions
+        #    (halo beyond the image edge, pad rim) are forced to exactly 0,
+        #    so any non-finite garbage the DMA may have carried dies here
+        #    (a multiplicative mask alone would leak it: 0 * NaN = NaN).
+        # 2. Per level, the cheap rank-1 form: the out-of-image region of
+        #    any level's window is a row band ⊗ column band, so re-zeroing
+        #    is two broadcast multiplies (~2 VPU ops/px).  Exact because
+        #    tier 1 guarantees every intermediate is finite.  Measured on
+        #    v5e: per-level 2D select instead cost ~20% throughput at
+        #    fuse=16 AND ~2× Mosaic compile time per doubling of T.
+        #
+        # Branching around the mask for interior tiles is NOT worth it:
+        # one lax.cond per program measured 40% slower on Mosaic than
+        # unconditional masking (it stalls the DMA/compute pipeline).
         H, W = valid_hw
         w0h, w0w = th + 2 * r * T, tw + 2 * r * T
         rows0 = row0 + jax.lax.broadcasted_iota(jnp.int32, (w0h, 1), 0)
         cols0 = col0 + jax.lax.broadcasted_iota(jnp.int32, (1, w0w), 1)
+        ok0 = ((rows0 >= 0) & (rows0 < H)) & ((cols0 >= 0) & (cols0 < W))
+        cur = jnp.where(ok0, cur, 0.0)
     for s in range(1, T + 1):
         ch, cw = th + 2 * r * (T - s), tw + 2 * r * (T - s)
         acc = _correlate_window(cur, taps, sep, k, ch, cw)
         if quantize:
             acc = jnp.clip(jnp.rint(acc), 0.0, 255.0)
-        if valid_hw is not None:  # None = periodic torus: no ghost ring
+        if valid_hw is not None:
             # Level-s window starts r*s deeper; slice the hoisted iotas.
             rows = rows0[r * s : r * s + ch, :]
             cols = cols0[:, r * s : r * s + cw]
-            okr = (rows >= 0) & (rows < H)
-            okc = (cols >= 0) & (cols < W)
-            # Select, not multiply-by-mask: 0 * NaN = NaN, so a non-finite
-            # value in the masked region would survive a multiplicative
-            # mask; where() forces the ghost ring to 0 unconditionally.
-            acc = jnp.where(okr & okc, acc, 0.0)
+            okr = ((rows >= 0) & (rows < H)).astype(jnp.float32)
+            okc = ((cols >= 0) & (cols < W)).astype(jnp.float32)
+            acc = acc * okr * okc
         cur = acc
-    out_ref[0] = cur.astype(out_ref.dtype)
+    out_ref[0] = _from_f32(cur, out_ref.dtype)
 
 
 @functools.partial(

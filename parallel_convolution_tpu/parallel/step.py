@@ -95,6 +95,10 @@ def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
                                    or valid_hw[1] != block_hw[1] * grid[1])
     r = filt.radius
 
+    rdma = backend == "pallas_rdma"
+    if rdma and fuse != 1:
+        raise ValueError("backend 'pallas_rdma' supports fuse=1 only "
+                         "(the exchange lives inside the kernel)")
     pallas_like = backend in ("pallas", "pallas_sep")
     sep = backend == "pallas_sep"
 
@@ -112,6 +116,18 @@ def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
         return out
 
     def step(v):
+        if rdma:
+            # Exchange + stencil fused in ONE kernel (remote DMA over ICI
+            # instead of collective-permute + concatenate + re-read).
+            from parallel_convolution_tpu.ops import pallas_rdma
+
+            p = pallas_rdma.fused_rdma_step(
+                v, filt, grid, boundary, quantize=quantize,
+                out_dtype=v.dtype,
+            )
+            if needs_mask:
+                p = p * _valid_mask(valid_hw, block_hw).astype(p.dtype)
+            return p
         depth = r * fuse
         p = halo.halo_exchange(v, depth, grid, boundary)
         if pallas_like and fuse > 1:
@@ -220,14 +236,22 @@ def _build_converge(mesh: Mesh, filt: Filter, tol: float, max_iters: int,
     return jax.jit(sharded, donate_argnums=0)
 
 
-STORAGE_DTYPES = {"f32": jnp.float32, "bf16": jnp.bfloat16}
+# Iteration-carry dtypes.  Quantized states are exact small integers, so
+# narrower carries lose nothing: bf16 holds 0..255 exactly at half the
+# HBM/ICI traffic of f32, and u8 — the reference's own ``unsigned char``
+# buffer dtype — at a quarter (accumulation is always f32 inside the
+# correlate implementations; u8 additionally requires quantize=True, checked
+# in the entry points below).
+STORAGE_DTYPES = {"f32": jnp.float32, "bf16": jnp.bfloat16, "u8": jnp.uint8}
 
 # The jax-free registry (which the CLI/RunConfig validate against) and the
 # dtype map here must never drift: a name accepted there but missing here
 # would KeyError deep inside _prepare.
 from parallel_convolution_tpu.utils.config import STORAGES as _STORAGES  # noqa: E402
 
-assert tuple(STORAGE_DTYPES) == _STORAGES, (STORAGE_DTYPES, _STORAGES)
+if tuple(STORAGE_DTYPES) != _STORAGES:  # not assert: must survive python -O
+    raise RuntimeError(
+        f"storage registries drifted: {tuple(STORAGE_DTYPES)} != {_STORAGES}")
 
 
 def _correlate_for_backend(backend: str):
@@ -249,6 +273,15 @@ def _correlate_padded_xla(padded: jnp.ndarray, filt: Filter) -> jnp.ndarray:
         precision=lax.Precision.HIGHEST,
     )
     return out[:, 0]
+
+
+def _check_storage(storage: str, quantize: bool) -> None:
+    if storage == "u8" and not quantize:
+        raise ValueError(
+            "storage='u8' requires quantize=True: u8 carries can only hold "
+            "the quantized integer states; a float iterate would be "
+            "silently truncated every iteration"
+        )
 
 
 def _prepare(x, mesh: Mesh, r: int, storage: str = "f32"):
@@ -273,6 +306,8 @@ def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
     stays in its blocked sharding, output keeps the padded extent (pass it
     straight to ``save_sharded``).  The input array is donated.
     """
+    if jnp.dtype(xs.dtype) == jnp.uint8 and not quantize:
+        _check_storage("u8", quantize)  # public entry: same guard as above
     R, Cc = grid_shape(mesh)
     block_hw = (xs.shape[1] // R, xs.shape[2] // Cc)
     fn = _build_iterate(mesh, filt, iters, quantize, tuple(valid_hw),
@@ -295,6 +330,7 @@ def sharded_iterate(x, filt: Filter, iters: int, mesh: Mesh | None = None,
     """
     if mesh is None:
         mesh = make_grid_mesh()
+    _check_storage(storage, quantize)
     xs, valid_hw, block_hw = _prepare(x, mesh, filt.radius, storage)
     out = iterate_prepared(xs, filt, iters, mesh, valid_hw,
                            quantize=quantize, backend=backend, fuse=fuse,
@@ -309,6 +345,7 @@ def sharded_converge(x, filt: Filter, tol: float, max_iters: int,
     """Run-to-convergence (BASELINE config 5).  Returns (result, iters_run)."""
     if mesh is None:
         mesh = make_grid_mesh()
+    _check_storage(storage, quantize)
     xs, valid_hw, block_hw = _prepare(x, mesh, filt.radius, storage)
     fn = _build_converge(mesh, filt, float(tol), int(max_iters),
                          int(check_every), quantize, valid_hw, block_hw,

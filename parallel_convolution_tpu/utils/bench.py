@@ -72,6 +72,41 @@ def wall(fn, *args, warmup: int = 1, reps: int = 3) -> float:
     return statistics.median(times)
 
 
+def slope_wall(fn, x, reps: int = 3, chain: int = 4) -> float:
+    """Wall seconds of one ``fn`` call with the fence constant cancelled.
+
+    For chainable runners (``fn: Array -> Array``, same shape/dtype): on
+    lying-fence proxy platforms a single fenced wall carries a ~140 ms
+    device→host constant; this times 1-call vs ``chain``-call spans, each
+    ending in one fence, and returns the slope (utils/bench.bench_iterate's
+    scheme, reusable for ad-hoc candidates).  On standard backends it is a
+    plain min-of-reps fenced wall.
+    """
+    out = fence(fn(x))  # compile + warm
+    if not _needs_readback_fence():
+        walls = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = fence(fn(out))
+            walls.append(time.perf_counter() - t0)
+        return min(walls)
+    singles, chains = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fence(fn(out))
+        singles.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        for _ in range(chain):
+            out = fn(out)
+        fence(out)
+        chains.append(time.perf_counter() - t0)
+    secs = (statistics.median(chains) - statistics.median(singles)) / (
+        chain - 1)
+    if secs <= 0:  # jitter swamped the chain: upper-bound fallback
+        secs = max(statistics.median(chains) / chain, 1e-9)
+    return secs
+
+
 def bench_iterate(
     shape: tuple[int, int],
     filt: Filter,

@@ -15,8 +15,9 @@ import json
 # config validation stays dependency-light; parallel.step maps the names to
 # implementations (and asserts it covers them), the CLI builds its choices
 # from them.
-BACKENDS = ("shifted", "xla_conv", "pallas", "separable", "pallas_sep")
-STORAGES = ("f32", "bf16")
+BACKENDS = ("shifted", "xla_conv", "pallas", "separable", "pallas_sep",
+            "pallas_rdma")
+STORAGES = ("f32", "bf16", "u8")
 
 
 @dataclasses.dataclass
@@ -50,6 +51,10 @@ class RunConfig:
             raise ValueError(f"unknown backend {self.backend!r}")
         if self.boundary not in ("zero", "periodic"):
             raise ValueError(f"boundary must be zero|periodic, got {self.boundary!r}")
+        if self.storage == "u8" and not self.quantize:
+            # u8 carries can only hold the quantized integer states; a float
+            # Jacobi iterate would be silently truncated every iteration.
+            raise ValueError("storage='u8' requires quantize=True")
         if self.rows <= 0 or self.cols <= 0 or self.iters < 0 or self.fuse < 1:
             raise ValueError("rows/cols must be positive, iters >= 0, fuse >= 1")
         if self.mesh_shape is not None:

@@ -50,15 +50,9 @@ def run_in_subprocess(n_devices: int = 8, timeout: float = 600.0) -> dict:
     Returns ``{"error": ...}`` instead of raising so benchmark drivers can
     record the failure without dying.
     """
-    import re
+    from parallel_convolution_tpu.utils.platform import child_env_cpu
 
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
-                   env.get("XLA_FLAGS", ""))
-    env["XLA_FLAGS"] = (
-        flags + f" --xla_force_host_platform_device_count={n_devices}"
-    ).strip()
+    env = child_env_cpu(n_devices)
     try:
         proc = subprocess.run(
             [sys.executable, "-m", "parallel_convolution_tpu.utils.halo_proxy"],

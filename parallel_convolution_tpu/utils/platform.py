@@ -25,6 +25,25 @@ import sys
 _READBACK_FENCE: bool | None = None
 
 
+def child_env_cpu(n_devices: int, env: dict | None = None) -> dict:
+    """Environment for a clean child process on an n-device CPU platform.
+
+    The one shared recipe for spawning multi-virtual-device CPU helpers
+    (halo proxy, multi-host workers): pins JAX_PLATFORMS=cpu and REPLACES
+    any inherited --xla_force_host_platform_device_count with ``n_devices``.
+    """
+    import re
+
+    env = dict(os.environ if env is None else env)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    return env
+
+
 def force_platform(name: str, warn: bool = False) -> bool:
     """Point jax at platform ``name`` before its backend initializes.
 
@@ -170,6 +189,25 @@ def _fence_lies(trials: int = 3) -> bool:
         return min(excess) > 0
     except Exception:
         return False
+
+
+def enable_compile_cache(cache_dir: str | None = None) -> None:
+    """Turn on JAX's persistent compilation cache (works over the tunnel).
+
+    Mosaic compiles of the deep-fused kernels take minutes on the proxy
+    platform (measured: 66 s → 8 s process-total for the fuse=16 bench
+    once cached); benchmark drivers call this first so repeat runs pay
+    compile once per config ever, not once per process.
+    """
+    import jax
+
+    path = cache_dir or os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # older jax or read-only fs: compiles still work, just slower
 
 
 def timing_mode() -> str:

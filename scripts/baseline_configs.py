@@ -35,6 +35,10 @@ def main() -> int:
 
     import numpy as np
 
+    from parallel_convolution_tpu.utils.platform import enable_compile_cache
+
+    enable_compile_cache()
+
     from parallel_convolution_tpu.ops.filters import get_filter
     from parallel_convolution_tpu.parallel import step
     from parallel_convolution_tpu.parallel.mesh import make_grid_mesh

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Fair MXU datapoints for the stencil workload (DESIGN.md roofline §).
+
+Round-1's DESIGN.md dismissed the MXU partly on a strawman: 1-channel
+NCHW ``lax.conv`` (0.08 Gpx/s, OOM at 8192²) is XLA's worst lowering, not
+the MXU's best shot.  This script measures the honest alternatives:
+
+1. ``xla_conv_nhwc`` — the TPU-native NHWC/HWIO layout of the same conv.
+2. ``banded_matmul`` — the separable blur as two dense banded matmuls
+   (Y = Bh @ X @ Bw, bf16): the formulation that actually fills the
+   128×128 systolic array.
+
+Both still lose to the VPU stencil by orders of magnitude, for an
+analytic reason the measured rows now back: an r=1 separable pass does
+6 flops/px on the VPU; ANY matmul formulation contracts over ≥128
+elements to fill the MXU, inflating flops ≥20× — more than the MXU's
+~100× peak-flops advantage can repay once its utilization on banded
+structure is accounted.  Emits one JSON row per candidate.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import _path  # noqa: F401
+
+
+def main() -> int:
+    from parallel_convolution_tpu.utils.platform import (
+        apply_platform_env, enable_compile_cache, on_tpu,
+    )
+
+    apply_platform_env()
+    enable_compile_cache()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from parallel_convolution_tpu.ops.filters import get_filter
+    from parallel_convolution_tpu.utils import bench
+
+    N = 4096 if on_tpu() else 512
+    iters = 10 if on_tpu() else 2
+    filt = get_filter("blur3")
+    taps = np.asarray(filt.taps, np.float32)
+    sep = filt.separable()
+    col_t, row_t = (np.asarray(v, np.float32) for v in sep)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, 256, (N, N)), jnp.float32)
+
+    rows = []
+
+    def emit(name, fn, arg, flops_per_px):
+        # slope_wall, not wall: the MXU candidates and the VPU reference
+        # must share the fence-constant-cancelling scheme or the ~140 ms
+        # proxy readback charges only the candidates.
+        secs = bench.slope_wall(fn, arg, reps=2)
+        gpx = N * N * iters / secs / 1e9
+        row = {
+            "candidate": f"{name}@{N}",
+            "wall_s": round(secs, 4),
+            "gpixels_per_s": round(gpx, 3),
+            "flops_per_px_per_iter": flops_per_px,
+            "iters": iters,
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    # 1. NHWC conv — XLA's TPU-native layout for the same 3x3 conv.
+    rhs_nhwc = jnp.asarray(taps[:, :, None, None], jnp.float32)  # HWIO
+
+    @jax.jit
+    def conv_nhwc(v):
+        def body(_, a):
+            out = jax.lax.conv_general_dilated(
+                a[None, :, :, None], rhs_nhwc, (1, 1),
+                [(1, 1), (1, 1)],
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                precision=jax.lax.Precision.HIGHEST,
+            )
+            return out[0, :, :, 0]
+        return jax.lax.fori_loop(0, iters, body, v)
+
+    try:
+        emit("xla_conv_nhwc/f32", conv_nhwc, x, 18)
+    except Exception as e:
+        print(json.dumps({"candidate": f"xla_conv_nhwc/f32@{N}",
+                          "error": repr(e)[:200]}), flush=True)
+
+    # 2. Dense banded matmul (bf16): the MXU-native formulation.
+    #    Bh (N,N) carries col taps on its three diagonals, Bw the row taps;
+    #    one iteration is Y = (Bh @ X) @ Bw — 2 * 2*N^3 flops vs the
+    #    stencil's 12*N^2: a x(N/3) flop inflation the MXU must repay.
+    def banded(tvec):
+        b = np.zeros((N, N), np.float32)
+        i = np.arange(N)
+        b[i, i] = tvec[1]
+        b[i[:-1], i[:-1] + 1] = tvec[2]
+        b[i[1:], i[1:] - 1] = tvec[0]
+        return jnp.asarray(b, jnp.bfloat16)
+
+    bh, bw = banded(col_t), banded(row_t)
+
+    @jax.jit
+    def banded_mm(v):
+        def body(_, a):
+            return ((bh @ a.astype(jnp.bfloat16)) @ bw).astype(jnp.float32)
+        return jax.lax.fori_loop(0, iters, body, v)
+
+    try:
+        emit("banded_matmul/bf16", banded_mm, x, 4 * N)
+    except Exception as e:
+        print(json.dumps({"candidate": f"banded_matmul/bf16@{N}",
+                          "error": repr(e)[:200]}), flush=True)
+
+    # Reference row: the VPU Pallas separable path at the same size.
+    if on_tpu():
+        from parallel_convolution_tpu.parallel.mesh import make_grid_mesh
+
+        r = bench.bench_iterate((N, N), filt, iters,
+                                mesh=make_grid_mesh(), backend="pallas_sep",
+                                storage="bf16", fuse=min(8, iters), reps=2)
+        print(json.dumps({"candidate": f"pallas_sep/bf16@{N}",
+                          "wall_s": r["wall_s"],
+                          "gpixels_per_s": r["gpixels_per_s"],
+                          "flops_per_px_per_iter": 12,
+                          "iters": iters}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

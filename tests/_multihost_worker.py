@@ -1,0 +1,105 @@
+"""Worker process for the two-process multi-host test (run via subprocess).
+
+One OS process per "host", exactly the reference's ``mpiexec -np 2`` tier
+(SURVEY.md §3.2 process boundary): ``jax.distributed.initialize`` is the
+``MPI_Init``, each process owns 4 virtual CPU devices (set via XLA_FLAGS by
+the launching test), and the 8-device mesh spans both processes — so the
+halo ``ppermute`` and convergence ``psum`` really cross a process boundary,
+and sharded I/O + checkpointing really run with only-my-shards
+addressability.
+
+argv: process_id num_processes coordinator_port workdir
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    pid, n = int(sys.argv[1]), int(sys.argv[2])
+    port, work = sys.argv[3], sys.argv[4]
+
+    from parallel_convolution_tpu.utils.platform import force_platform
+
+    force_platform("cpu")
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=n,
+        process_id=pid,
+    )
+
+    import numpy as np
+
+    from parallel_convolution_tpu.ops import filters, oracle
+    from parallel_convolution_tpu.parallel import mesh as mesh_lib, multihost
+    from parallel_convolution_tpu.utils import checkpoint, imageio, sharded_io
+
+    info = multihost.process_info()
+    assert info["process_count"] == n, info
+    assert info["local_devices"] * n == info["global_devices"], info
+
+    mesh = mesh_lib.make_grid_mesh(jax.devices())
+    rows, cols = 37, 53  # non-divisible odd shape
+    filt = filters.get_filter("blur3")
+    img = imageio.generate_test_image(rows, cols, "grey", seed=3)
+    src = os.path.join(work, "in.raw")
+    dst = os.path.join(work, "out.raw")
+    ckpt = os.path.join(work, "ckpt")
+
+    if pid == 0:
+        imageio.write_raw(src, img)
+    multihost.barrier("input-written")
+
+    # Sharded load → checkpointed sharded iterate → sharded save, all with
+    # per-process addressability (each process touches only its shards).
+    xs = sharded_io.load_sharded(src, rows, cols, "grey", mesh)
+    out = checkpoint.run_checkpointed(
+        xs, filt, 4, mesh, (rows, cols), ckpt_dir=ckpt, every=2)
+
+    if pid == 0:
+        imageio.allocate_raw(dst, rows, cols, "grey")
+    multihost.barrier("output-allocated")
+    sharded_io.save_sharded(dst, out, rows, cols, "grey", allocate=False)
+    multihost.barrier("output-saved")
+
+    # Resume leg: LATEST points at iteration 2 (the final state is the
+    # caller's to persist), so a fresh run with xs=None must reload the
+    # cross-process per-shard snapshot and land bit-identical.
+    out2 = checkpoint.run_checkpointed(
+        None, filt, 4, mesh, (rows, cols), ckpt_dir=ckpt, every=2)
+    local_same = all(
+        np.array_equal(np.asarray(a.data), np.asarray(b.data))
+        for a, b in zip(out.addressable_shards, out2.addressable_shards)
+    )
+
+    # Cross-host agreement on a host-side scalar (rank-0 wins).
+    bcast = multihost.broadcast_scalar(float(pid + 7))
+
+    if pid == 0:
+        got = imageio.read_raw(dst, rows, cols, "grey")
+        want = oracle.run_serial_u8(img, filt, 4)
+        result = {
+            "ok": bool(np.array_equal(got, want)) and local_same
+            and bcast == 7.0,
+            "bitexact_output": bool(np.array_equal(got, want)),
+            "resume_bitexact_local": local_same,
+            "broadcast": bcast,
+            **info,
+        }
+        with open(os.path.join(work, "result.json"), "w") as f:
+            json.dump(result, f)
+    else:
+        # Non-zero ranks report their legs through their exit code path.
+        assert local_same and bcast == 7.0, (local_same, bcast)
+    multihost.barrier("done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

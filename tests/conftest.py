@@ -30,11 +30,12 @@ import jax
 # initialized a backend): silently running the suite on the TPU proxy would
 # break interpret-mode assumptions and burn real chip time.
 _got = jax.devices()[0].platform
-if _want == "cpu" and _got != "cpu":
-    # Only the hermetic default is enforced: a deliberate tpu/axon override
-    # may legitimately report platform 'tpu' under a proxy name.
+if (_want == "cpu") != (_got == "cpu"):
+    # A deliberate tpu/axon override may report platform 'tpu' under a proxy
+    # name, so exact equality can't be enforced — but cpu-wanted-got-else
+    # and else-wanted-got-cpu are both always pin failures.
     raise RuntimeError(
-        f"test platform pin failed: wanted 'cpu', backend initialized "
+        f"test platform pin failed: wanted {_want!r}, backend initialized "
         f"on {_got!r} (did something import/init jax before conftest?)"
     )
 

@@ -1,0 +1,65 @@
+"""Two-OS-process multi-host execution (the reference's multi-node tier).
+
+The reference needs a real cluster for >1 rank; here two actual OS
+processes run ``jax.distributed.initialize`` on CPU (4 virtual devices
+each), share one 8-device mesh, and execute the sharded step + sharded I/O
++ checkpoint/resume across the process boundary — turning multihost.py's
+docstring claims into executed evidence (SURVEY.md §3.2 process boundary,
+§5 comm backend).
+"""
+
+import json
+import os
+
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+_WORKER = Path(__file__).with_name("_multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed(tmp_path):
+    from parallel_convolution_tpu.utils.platform import child_env_cpu
+
+    n, port = 2, _free_port()
+    repo_root = str(_WORKER.parent.parent)
+    env = child_env_cpu(n_devices=4)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo_root] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(_WORKER), str(pid), str(n), str(port),
+             str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(n)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=540)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
+
+    result = json.loads((tmp_path / "result.json").read_text())
+    assert result["ok"], result
+    assert result["process_count"] == 2
+    assert result["global_devices"] == 8
+    assert result["local_devices"] == 4
+    assert result["bitexact_output"] and result["resume_bitexact_local"]
