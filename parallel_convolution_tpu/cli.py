@@ -61,6 +61,10 @@ def main(argv: list[str] | None = None) -> int:
                           "HBM/ICI traffic and stay bit-exact for u8 images")
     run.add_argument("--fuse", type=int, default=1, metavar="T",
                      help="iterations per halo exchange (temporal fusion)")
+    run.add_argument("--tile", default=None, metavar="TH,TW",
+                     help="Pallas kernel output-tile override, e.g. "
+                          "1024,512 (default: per-kernel tuned value; "
+                          "results are bit-identical for any tile)")
     run.add_argument("--boundary", default="zero",
                      choices=["zero", "periodic"],
                      help="edge handling: zero ghost ring (the reference) "
@@ -183,11 +187,20 @@ def main(argv: list[str] | None = None) -> int:
     from parallel_convolution_tpu.models import ConvolutionModel, JacobiSolver
 
     mesh = _mesh_from_flag(args.mesh)
+    tile = None
+    if args.tile:
+        try:
+            tile = tuple(int(v) for v in args.tile.split(","))
+            if len(tile) != 2 or min(tile) <= 0:
+                raise ValueError
+        except ValueError:
+            ap.error(f"--tile must be TH,TW positive ints, got {args.tile!r}")
     if args.converge is not None:
         solver = JacobiSolver(
             filt=args.filter_name, tol=args.converge, max_iters=args.loops,
             check_every=args.check_every, mesh=mesh, backend=args.backend,
-            quantize=True,
+            quantize=True, fuse=args.fuse, tile=tile,
+            boundary=args.boundary, storage=args.storage,
         )
         img = imageio.read_raw(args.image, args.rows, args.cols, args.mode)
         x = imageio.interleaved_to_planar(img).astype(np.float32)
@@ -202,7 +215,8 @@ def main(argv: list[str] | None = None) -> int:
 
     model = ConvolutionModel(filt=args.filter_name, mesh=mesh,
                              backend=args.backend, storage=args.storage,
-                             fuse=args.fuse, boundary=args.boundary)
+                             fuse=args.fuse, boundary=args.boundary,
+                             tile=tile)
     if args.checkpoint:
         from parallel_convolution_tpu.parallel import step as step_lib
         from parallel_convolution_tpu.utils import checkpoint, sharded_io
@@ -213,6 +227,7 @@ def main(argv: list[str] | None = None) -> int:
             xs, model.filt, args.loops, mesh, (args.rows, args.cols),
             ckpt_dir=args.checkpoint, every=args.checkpoint_every,
             backend=args.backend, fuse=args.fuse, boundary=args.boundary,
+            tile=tile,
         )
         sharded_io.save_sharded(args.output, out, args.rows, args.cols,
                                 args.mode)

@@ -35,6 +35,9 @@ class JacobiSolver:
     backend: str = "shifted"
     quantize: bool = False
     boundary: str = "zero"
+    storage: str = "f32"  # iteration-carry dtype (see sharded_converge)
+    fuse: int = 1  # fused iterations between convergence checks
+    tile: tuple[int, int] | None = None  # Pallas kernel tile override
 
     def __post_init__(self) -> None:
         if isinstance(self.filt, str):
@@ -48,6 +51,7 @@ class JacobiSolver:
             x, self.filt, tol=self.tol, max_iters=self.max_iters,
             check_every=self.check_every, mesh=self.mesh,
             quantize=self.quantize, backend=self.backend,
-            boundary=self.boundary,
+            boundary=self.boundary, storage=self.storage,
+            fuse=self.fuse, tile=self.tile,
         )
         return np.asarray(out), iters

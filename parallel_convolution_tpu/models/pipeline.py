@@ -45,6 +45,8 @@ class ConvolutionModel:
     fuse: int = 1  # iterations per halo exchange (temporal fusion, T*r-deep
     #                halos once instead of r-deep every iteration)
     boundary: str = "zero"  # 'periodic' = torus wrap (ring topology)
+    tile: tuple[int, int] | None = None  # Pallas kernel output-tile (TH, TW)
+    #                override; None = per-kernel tuned default
 
     def __post_init__(self) -> None:
         if isinstance(self.filt, str):
@@ -60,6 +62,7 @@ class ConvolutionModel:
             x, self.filt, iters, mesh=self.mesh,
             quantize=self.quantize, backend=self.backend,
             storage=self.storage, fuse=self.fuse, boundary=self.boundary,
+            tile=self.tile,
         )
 
     def run_image(self, img: np.ndarray, iters: int) -> np.ndarray:
@@ -113,6 +116,6 @@ class ConvolutionModel:
         out = step_lib.iterate_prepared(
             xs, self.filt, iters, self.mesh, (rows, cols),
             quantize=self.quantize, backend=self.backend,
-            fuse=self.fuse, boundary=self.boundary,
+            fuse=self.fuse, boundary=self.boundary, tile=self.tile,
         )
         sharded_io.save_sharded(dst, out, rows, cols, mode)

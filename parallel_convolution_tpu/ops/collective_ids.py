@@ -1,0 +1,32 @@
+"""Static registry of Mosaic ``collective_id`` slots.
+
+Every Pallas kernel that performs cross-device communication (remote DMA,
+``get_barrier_semaphore``) must carry a ``collective_id`` in its
+``CompilerParams``; kernels sharing an id share the same global barrier
+semaphore, so two *different* concurrent collective kernels with the same
+id would corrupt each other's barrier counts.  The reference had the same
+class of resource (MPI tags); its analog of this table is the implicit
+"one communicator, distinct tags per direction" convention.
+
+Ids are assigned statically here — not first-come-first-served at import
+time — so that every process in a multi-host program agrees on the
+mapping regardless of import order.
+"""
+
+from __future__ import annotations
+
+_COLLECTIVE_IDS: dict[str, int] = {
+    # The fused remote-DMA halo + stencil kernel (ops/pallas_rdma.py).
+    "rdma_halo_stencil": 1,
+}
+
+
+def collective_id(name: str) -> int:
+    """Look up a kernel's statically assigned collective id."""
+    try:
+        return _COLLECTIVE_IDS[name]
+    except KeyError:
+        raise KeyError(
+            f"no collective_id registered for {name!r}; add it to "
+            f"ops/collective_ids.py (taken: {_COLLECTIVE_IDS})"
+        ) from None

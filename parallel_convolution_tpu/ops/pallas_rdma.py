@@ -21,13 +21,28 @@ locally — writes and inbound RDMA targets are disjoint by construction, so
 there is no initialization race (checked by the interpreter's race
 detector in tests/test_rdma.py).
 
+Cross-invocation safety: within one invocation, waits on both the send and
+receive semaphores retire every DMA before the kernel exits — but back-to-
+back invocations (the fori_loop iteration driver) add a hazard the
+per-invocation race detector cannot see: a fast device entering iteration
+N+1 could push ghost bytes into a slow neighbor's scratch while the
+neighbor still computes iteration N.  ``_neighbor_barrier`` closes it with
+the canonical start-of-kernel rendezvous on the collective barrier
+semaphore: no remote copy is issued until every RDMA partner has entered
+the current invocation (tests/test_rdma.py::test_rdma_back_to_back_race
+runs the multi-invocation protocol under the race detector).
+
 STATUS: functionally validated — bit-exact against the oracle on the
 multi-device CPU mesh under TPU interpret mode (which simulates remote
-DMAs and semaphores).  PERF-UNVALIDATED on real hardware: this environment
-has one chip, where no exchange exists; the kernel still compiles and runs
-there in its degenerate local form.  A production version would also tile
-the compute loop instead of holding the whole padded block in VMEM —
-blocks here must fit VMEM (fine for the prototype's block sizes).
+DMAs, semaphores, and the barrier).  On the one real chip available here
+the kernel compiles via Mosaic and runs in its degenerate 1×1 local form,
+bit-exact vs the oracle (recorded in BASELINE.md "RDMA on silicon");
+multi-chip ICI perf remains unvalidated — no such hardware exists in this
+environment.  VMEM budget: the whole (C, h+2r, w+2r) f32 padded block is
+held in VMEM scratch, so per-device blocks are bounded by ~16 MB/f32 ≈
+2048×2048 grey; larger blocks need the windowed-DMA tiling of
+``_stencil_kernel`` (a fori_loop over window copies between the exchange
+and the store) — left for when real multi-chip hardware can measure it.
 """
 
 from __future__ import annotations
@@ -40,6 +55,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from parallel_convolution_tpu.ops.collective_ids import collective_id
 from parallel_convolution_tpu.ops.filters import Filter
 from parallel_convolution_tpu.ops.pallas_stencil import (
     _correlate_window, _from_f32, _to_f32, on_tpu,
@@ -47,6 +63,44 @@ from parallel_convolution_tpu.ops.pallas_stencil import (
 
 # Semaphore slots: one (send, recv) pair per direction.
 _UP, _DOWN, _LEFT, _RIGHT = 0, 1, 2, 3
+
+
+def _neighbor_barrier(dirs):
+    """Start-of-kernel rendezvous with every RDMA partner.
+
+    ``dirs`` is [(exists, (x, y) device id)] for the four cardinal
+    neighbors.  Each device signals the global barrier semaphore of every
+    existing neighbor, then waits until all of ITS neighbors have signaled
+    it.  This closes the cross-invocation race the per-invocation race
+    detector cannot see: without it, a fast device's iteration-N+1 remote
+    copy could land in a slow neighbor's scratch while that neighbor is
+    still computing iteration N.  After the barrier, every partner has
+    entered the current invocation — and kernel invocations serialize on a
+    core, so all of its previous-invocation reads have retired before any
+    new ghost bytes arrive.
+
+    Skew safety: a neighbor can run at most one invocation ahead, because
+    completing invocation N+1 requires its own ``wait_recv`` on ghosts we
+    only send after passing this barrier — so the wait below can never be
+    satisfied by two signals from one fast neighbor standing in for a slow
+    one.  Leftover signals (a neighbor already in N+2's barrier) simply
+    pre-credit the next wait; counts stay balanced.
+    """
+    bsem = pltpu.get_barrier_semaphore()
+    n_wait = jnp.int32(0)
+    for exists, dev in dirs:
+        if isinstance(exists, bool):
+            if not exists:
+                continue
+            pltpu.semaphore_signal(bsem, inc=1, device_id=dev)
+            n_wait = n_wait + 1
+        else:
+            @pl.when(exists)
+            def _(dev=dev):
+                pltpu.semaphore_signal(bsem, inc=1, device_id=dev)
+
+            n_wait = n_wait + exists.astype(jnp.int32)
+    pltpu.semaphore_wait(bsem, n_wait)
 
 
 def _rdma_kernel(in_ref, out_ref, pad, send_sem, recv_sem, *,
@@ -90,6 +144,15 @@ def _rdma_kernel(in_ref, out_ref, pad, send_sem, recv_sem, *,
         if periodic:
             return (lax.rem(x + dx + R, R), lax.rem(y + dy + Cc, Cc))
         return (x + dx, y + dy)
+
+    # Cross-invocation safety: no remote copy may be issued until every
+    # RDMA partner has entered THIS invocation (see _neighbor_barrier).
+    # Self-wrap axes (periodic R==1 / Cc==1) have python-False predicates
+    # and drop out statically.
+    _neighbor_barrier([
+        (up_in, nbr(-1, 0)), (down_in, nbr(+1, 0)),
+        (left_in, nbr(0, -1)), (right_in, nbr(0, +1)),
+    ])
 
     # --- Phase 1: rows.  My top interior rows -> upper neighbor's bottom
     # ghost; my bottom interior rows -> lower neighbor's top ghost.
@@ -203,7 +266,8 @@ def fused_rdma_step(
             pltpu.SemaphoreType.DMA((4,)),
         ],
         compiler_params=pltpu.CompilerParams(
-            collective_id=7, has_side_effects=True,
+            collective_id=collective_id("rdma_halo_stencil"),
+            has_side_effects=True,
         ),
         interpret=interpret,
     )(block)

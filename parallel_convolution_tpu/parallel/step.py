@@ -196,12 +196,33 @@ def _build_iterate(mesh: Mesh, filt: Filter, iters: int, quantize: bool,
 @lru_cache(maxsize=64)
 def _build_converge(mesh: Mesh, filt: Filter, tol: float, max_iters: int,
                     check_every: int, quantize: bool, valid_hw, block_hw,
-                    backend: str, boundary: str = "zero"):
-    """Compile the run-to-convergence runner (C6: every-N diff + allreduce)."""
+                    backend: str, boundary: str = "zero", fuse: int = 1,
+                    tile: tuple[int, int] | None = None):
+    """Compile the run-to-convergence runner (C6: every-N diff + allreduce).
+
+    ``fuse``/``tile`` are the flagship iteration knobs (temporal fusion,
+    kernel tile), valid here too: a check_every-iteration chunk runs as
+    floor((n-1)/fuse) fused steps + the remainder as single steps + ONE
+    final single step that forms the (prev, cur) convergence pair — so any
+    fuse ≥ 1 works for any check_every and the iterate stays bit-identical
+    to fuse=1 (fused steps are exact, tested in test_sharded.py).
+    """
     grid = grid_shape(mesh)
     _check_block_size(filt, block_hw)
+    # A chunk fuses at most the n-1 pre-pair iterations (the final one is
+    # always a single step so the (prev, cur) diff exists), so clamp to
+    # check_every - 1 — otherwise fuse == check_every would silently run
+    # every iteration unfused ((n-1)//fuse == 0).
+    fuse = max(1, min(fuse, check_every - 1))
+    if min(block_hw) < filt.radius * fuse:
+        raise ValueError(
+            f"fuse={fuse} needs blocks >= {filt.radius * fuse}, got {block_hw}"
+        )
     step = _make_block_step(filt, grid, valid_hw, block_hw, quantize, backend,
-                            boundary=boundary)
+                            boundary=boundary, tile=tile)
+    fused = (_make_block_step(filt, grid, valid_hw, block_hw, quantize,
+                              backend, fuse, boundary, tile)
+             if fuse > 1 else None)
 
     def body(block):
         def chunk(carry):
@@ -213,7 +234,13 @@ def _build_converge(mesh: Mesh, filt: Filter, tol: float, max_iters: int,
             # through fori_loop copies a full block every iteration
             # (measured 8x the stencil cost at 8192² on v5e — 45 ms/iter
             # vs 5.7 for the fixed-count path).
-            prev = lax.fori_loop(0, n - 1, lambda _, v: step(v), cur)
+            if fused is None:
+                prev = lax.fori_loop(0, n - 1, lambda _, v: step(v), cur)
+            else:
+                prev = lax.fori_loop(0, (n - 1) // fuse,
+                                     lambda _, v: fused(v), cur)
+                prev = lax.fori_loop(0, (n - 1) % fuse,
+                                     lambda _, v: step(v), prev)
             cur = step(prev)
             # The MPI_Allreduce: global max of one iteration's change.
             delta = jnp.abs(cur.astype(jnp.float32) - prev.astype(jnp.float32))
@@ -296,10 +323,21 @@ def _prepare(x, mesh: Mesh, r: int, storage: str = "f32"):
     return x, (H, W), (Hp // R, Wp // Cc)
 
 
+def _norm_tile(tile) -> tuple[int, int] | None:
+    """Normalize a (TH, TW) kernel-tile override to a hashable tuple."""
+    if tile is None:
+        return None
+    th, tw = (int(v) for v in tile)
+    if th <= 0 or tw <= 0:
+        raise ValueError(f"tile extents must be positive, got {(th, tw)}")
+    return (th, tw)
+
+
 def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
                      valid_hw, quantize: bool = True,
                      backend: str = "shifted", fuse: int = 1,
-                     boundary: str = "zero"):
+                     boundary: str = "zero",
+                     tile: tuple[int, int] | None = None):
     """Iterate an already-sharded padded (C, Hp, Wp) array in place(-ish).
 
     The zero-copy entry for huge images loaded via utils.sharded_io: input
@@ -311,14 +349,15 @@ def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
     R, Cc = grid_shape(mesh)
     block_hw = (xs.shape[1] // R, xs.shape[2] // Cc)
     fn = _build_iterate(mesh, filt, iters, quantize, tuple(valid_hw),
-                        block_hw, backend, fuse, boundary)
+                        block_hw, backend, fuse, boundary, _norm_tile(tile))
     return fn(xs)
 
 
 def sharded_iterate(x, filt: Filter, iters: int, mesh: Mesh | None = None,
                     quantize: bool = True, backend: str = "shifted",
                     storage: str = "f32", fuse: int = 1,
-                    boundary: str = "zero"):
+                    boundary: str = "zero",
+                    tile: tuple[int, int] | None = None):
     """Run ``iters`` stencil iterations of a global (C, H, W) f32 image
     sharded over the 2D mesh.  Returns the global (C, H, W) f32 result
     (bit-identical to the serial oracle for any mesh shape).
@@ -326,7 +365,9 @@ def sharded_iterate(x, filt: Filter, iters: int, mesh: Mesh | None = None,
     ``storage='bf16'`` halves HBM/ICI traffic by carrying the state in
     bfloat16 between iterations — still bit-exact in quantize mode (u8
     values are exact in bf16); in float mode it is a documented
-    precision/bandwidth trade.
+    precision/bandwidth trade.  ``tile=(TH, TW)`` overrides the Pallas
+    kernels' VMEM output-tile shape (the scripts/tune_pallas.py knob);
+    None = the per-kernel tuned default.
     """
     if mesh is None:
         mesh = make_grid_mesh()
@@ -334,21 +375,27 @@ def sharded_iterate(x, filt: Filter, iters: int, mesh: Mesh | None = None,
     xs, valid_hw, block_hw = _prepare(x, mesh, filt.radius, storage)
     out = iterate_prepared(xs, filt, iters, mesh, valid_hw,
                            quantize=quantize, backend=backend, fuse=fuse,
-                           boundary=boundary)
+                           boundary=boundary, tile=tile)
     return out[:, : valid_hw[0], : valid_hw[1]].astype(jnp.float32)
 
 
 def sharded_converge(x, filt: Filter, tol: float, max_iters: int,
                      check_every: int = 1, mesh: Mesh | None = None,
                      quantize: bool = False, backend: str = "shifted",
-                     storage: str = "f32", boundary: str = "zero"):
-    """Run-to-convergence (BASELINE config 5).  Returns (result, iters_run)."""
+                     storage: str = "f32", boundary: str = "zero",
+                     fuse: int = 1, tile: tuple[int, int] | None = None):
+    """Run-to-convergence (BASELINE config 5).  Returns (result, iters_run).
+
+    ``fuse``/``tile`` mirror :func:`sharded_iterate`: fused chunks run
+    between convergence checks (any fuse ≥ 1, any check_every), so config
+    5 rides the same optimized kernels as the fixed-count path.
+    """
     if mesh is None:
         mesh = make_grid_mesh()
     _check_storage(storage, quantize)
     xs, valid_hw, block_hw = _prepare(x, mesh, filt.radius, storage)
     fn = _build_converge(mesh, filt, float(tol), int(max_iters),
                          int(check_every), quantize, valid_hw, block_hw,
-                         backend, boundary)
+                         backend, boundary, int(fuse), _norm_tile(tile))
     out, done = fn(xs)
     return out[:, : valid_hw[0], : valid_hw[1]].astype(jnp.float32), int(done)

@@ -129,6 +129,7 @@ def run_checkpointed(
     backend: str = "shifted",
     fuse: int = 1,
     boundary: str = "zero",
+    tile: tuple[int, int] | None = None,
 ) -> jax.Array:
     """Iterate with a snapshot every ``every`` iterations; auto-resume.
 
@@ -163,10 +164,16 @@ def run_checkpointed(
 
     while done < total_iters:
         chunk = min(every, total_iters - done)
+        # tile is a pure perf knob (bit-identical for any value in every
+        # mode), so it is deliberately NOT part of the resume-compatibility
+        # config above.  fuse IS kept there: it is only bit-identical under
+        # quantize=True — in float mode with a narrow storage dtype the
+        # fused kernel keeps f32 intermediates the unfused path would have
+        # rounded through storage every iteration.
         xs = step_lib.iterate_prepared(
             xs, filt, chunk, mesh, valid_hw,
             quantize=quantize, backend=backend, fuse=min(fuse, chunk),
-            boundary=boundary,
+            boundary=boundary, tile=tile,
         )
         done += chunk
         if done < total_iters:  # final state is the caller's to persist

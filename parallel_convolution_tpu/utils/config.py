@@ -33,6 +33,7 @@ class RunConfig:
     backend: str = "shifted"       # any of parallel.step.BACKENDS
     storage: str = "f32"           # f32 | bf16
     fuse: int = 1
+    tile: tuple[int, int] | None = None   # Pallas kernel tile (TH, TW)
     boundary: str = "zero"
     quantize: bool = True
     converge_tol: float | None = None
@@ -59,6 +60,11 @@ class RunConfig:
             raise ValueError("rows/cols must be positive, iters >= 0, fuse >= 1")
         if self.mesh_shape is not None:
             self.mesh_shape = tuple(self.mesh_shape)
+        if self.tile is not None:
+            self.tile = tuple(int(v) for v in self.tile)
+            if len(self.tile) != 2 or min(self.tile) <= 0:
+                raise ValueError(
+                    f"tile must be two positive ints (TH, TW), got {self.tile}")
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2)
@@ -81,5 +87,5 @@ class RunConfig:
         return ConvolutionModel(
             filt=self.filter_name, mesh=mesh, backend=self.backend,
             quantize=self.quantize, storage=self.storage, fuse=self.fuse,
-            boundary=self.boundary,
+            boundary=self.boundary, tile=self.tile,
         )

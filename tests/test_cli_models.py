@@ -59,6 +59,21 @@ def test_cli_converge(tmp_path, capsys):
     assert "converged after" in capsys.readouterr().out
 
 
+def test_cli_tile_flag(tmp_path, capsys):
+    """--tile TH,TW reaches the Pallas kernels; output stays golden."""
+    src = str(tmp_path / "in.raw")
+    a, b = str(tmp_path / "a.raw"), str(tmp_path / "b.raw")
+    cli.main(["generate", src, "26", "38", "grey", "--seed", "9"])
+    assert cli.main(["serial", src, "26", "38", "6", "grey", "-o", a]) == 0
+    assert cli.main(["run", src, "26", "38", "6", "grey", "-o", b,
+                     "--mesh", "2x2", "--backend", "pallas_sep",
+                     "--fuse", "3", "--tile", "16,128"]) == 0
+    assert cli.main(["compare", a, b]) == 0
+    with pytest.raises(SystemExit):
+        cli.main(["run", src, "26", "38", "6", "grey", "-o", b,
+                  "--tile", "16x128"])
+
+
 def test_cli_info(capsys):
     assert cli.main(["info"]) == 0
     out = capsys.readouterr().out

@@ -96,6 +96,57 @@ def test_rdma_race_detector(grey_small):
     np.testing.assert_array_equal(np.asarray(out)[0].astype(np.uint8), want)
 
 
+def test_rdma_back_to_back_race(grey_small):
+    """≥2 chained invocations under the race detector (cross-invocation fix).
+
+    The iteration driver runs the kernel back-to-back inside a fori_loop;
+    the start-of-kernel neighbor barrier must keep a fast device's
+    iteration-N+1 remote copies out of a slow neighbor's still-live
+    iteration-N scratch.  detect_races=True checks every (device, phase)
+    pair across all three chained invocations, and the result must stay
+    bit-exact vs three serial oracle steps.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental.pallas import tpu as pltpu
+    from jax.sharding import PartitionSpec as P
+
+    from parallel_convolution_tpu.ops import pallas_rdma
+    from parallel_convolution_tpu.parallel.mesh import AXES
+
+    filt = filters.get_filter("blur3")
+    mesh = _mesh((2, 2))
+    x = imageio.interleaved_to_planar(grey_small).astype(np.float32)[
+        :, :24, :36]
+    params = pltpu.InterpretParams(dma_execution_mode="on_wait",
+                                   detect_races=True)
+
+    def body(v):
+        def one(_, cur):
+            return pallas_rdma.fused_rdma_step(
+                cur, filt, (2, 2), "zero", quantize=True, interpret=params)
+        return lax.fori_loop(0, 3, one, v)
+
+    out = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=P(None, *AXES), out_specs=P(None, *AXES),
+        check_vma=False,
+    ))(x)
+    want = oracle.run_serial_u8(x[0].astype(np.uint8), filt, 3)
+    np.testing.assert_array_equal(np.asarray(out)[0].astype(np.uint8), want)
+    assert jnp.issubdtype(out.dtype, jnp.floating)
+
+
+def test_collective_id_registry():
+    from parallel_convolution_tpu.ops import collective_ids
+
+    assert collective_ids.collective_id("rdma_halo_stencil") == 1
+    with pytest.raises(KeyError, match="no collective_id"):
+        collective_ids.collective_id("nope")
+    # ids must be unique — a collision in the static table is a code bug
+    ids = list(collective_ids._COLLECTIVE_IDS.values())
+    assert len(ids) == len(set(ids))
+
+
 def test_rdma_rejects_fuse():
     with pytest.raises(ValueError, match="fuse=1"):
         step._make_block_step(filters.get_filter("blur3"), (2, 2), (8, 8),

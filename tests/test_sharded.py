@@ -121,6 +121,53 @@ def test_convergence_matches_oracle_jacobi():
     np.testing.assert_array_equal(np.asarray(got)[0], want)
 
 
+@pytest.mark.parametrize("fuse,check_every", [(4, 10), (3, 10), (10, 10),
+                                              (4, 3)])
+def test_convergence_fused_matches_unfused(fuse, check_every):
+    """fuse>1 in the convergence path: identical iters + bit-identical
+    result for any (fuse, check_every) combination, including fuse >
+    check_every (clamped) and non-divisible remainders."""
+    filt = filters.get_filter("jacobi3")
+    img = imageio.generate_test_image(32, 48, "grey", seed=3).astype(np.float32)
+    x = img[None]
+    want, want_iters = step.sharded_converge(
+        x, filt, tol=0.05, max_iters=200, check_every=check_every,
+        mesh=_mesh((2, 2)))
+    got, got_iters = step.sharded_converge(
+        x, filt, tol=0.05, max_iters=200, check_every=check_every,
+        mesh=_mesh((2, 2)), fuse=fuse)
+    assert got_iters == want_iters
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_convergence_fused_pallas_tile(grey_small):
+    """Pallas backend + explicit tile through the convergence path."""
+    filt = filters.get_filter("blur3")
+    x = imageio.interleaved_to_planar(grey_small).astype(np.float32)
+    want, want_iters = step.sharded_converge(
+        x, filt, tol=0.5, max_iters=60, check_every=5, mesh=_mesh((2, 2)),
+        quantize=True)
+    got, got_iters = step.sharded_converge(
+        x, filt, tol=0.5, max_iters=60, check_every=5, mesh=_mesh((2, 2)),
+        quantize=True, backend="pallas_sep", fuse=4, tile=(16, 128))
+    assert got_iters == want_iters
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_iterate_tile_override_bit_identical(grey_odd):
+    """sharded_iterate's public tile knob: any tile is bit-identical."""
+    filt = filters.get_filter("blur3")
+    x = imageio.interleaved_to_planar(grey_odd).astype(np.float32)
+    want = step.sharded_iterate(x, filt, 3, mesh=_mesh((2, 2)),
+                                backend="pallas")
+    got = step.sharded_iterate(x, filt, 3, mesh=_mesh((2, 2)),
+                               backend="pallas", tile=[8, 128])
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    with pytest.raises(ValueError, match="positive"):
+        step.sharded_iterate(x, filt, 1, mesh=_mesh((2, 2)),
+                             backend="pallas", tile=(0, 128))
+
+
 def test_convergence_hits_max_iters(grey_small):
     # float-mode jacobi on noise shrinks diffs slowly: far from 1e-9 in 7
     # iterations, so the loop must run the full 3+3+1 chunk schedule.
