@@ -125,6 +125,11 @@ def main() -> int:
         "halo_p50_us": halo_row.get("p50_us"),
         "serial_proxy_gpixels_per_s": proxy["gpixels_per_s"],
         "serial_proxy_impl": proxy["impl"],
+        # Denominator provenance: median-of-N with spread, so vs_baseline
+        # swings can be attributed (the single-trial proxy moved ±20%
+        # between identical-code rounds r01-r03).
+        "serial_proxy_reps": proxy.get("reps"),
+        "serial_proxy_spread_pct": proxy.get("spread_pct"),
     }
     if halo_row.get("unmeasurable"):
         result["halo_p50_note"] = halo_row["unmeasurable"]

@@ -213,10 +213,16 @@ def _build_converge(mesh: Mesh, filt: Filter, tol: float, max_iters: int,
     # always a single step so the (prev, cur) diff exists), so clamp to
     # check_every - 1 — otherwise fuse == check_every would silently run
     # every iteration unfused ((n-1)//fuse == 0).
+    requested_fuse = fuse
     fuse = max(1, min(fuse, check_every - 1))
     if min(block_hw) < filt.radius * fuse:
+        clamp_note = (f" (fuse={requested_fuse} clamped to {fuse}: a "
+                      f"check_every={check_every} chunk fuses at most its "
+                      "n-1 pre-pair iterations)"
+                      if fuse != requested_fuse else "")
         raise ValueError(
-            f"fuse={fuse} needs blocks >= {filt.radius * fuse}, got {block_hw}"
+            f"fuse={fuse} needs blocks >= {filt.radius * fuse}, got "
+            f"{block_hw}{clamp_note}"
         )
     step = _make_block_step(filt, grid, valid_hw, block_hw, quantize, backend,
                             boundary=boundary, tile=tile)

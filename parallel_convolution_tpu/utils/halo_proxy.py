@@ -37,7 +37,10 @@ def main() -> int:
                           f"{len(devs)} {devs[0].platform if devs else '-'}"}))
         return 1
     mesh = make_grid_mesh(devs)
-    row = bench.bench_halo_p50((512, 512), r=1, mesh=mesh)
+    # 60 trials (vs the 20 default): this CPU proxy rides host scheduling
+    # noise — its p50 swung 16.0 → 10.7 ms between identical-code rounds
+    # at 20 trials (BENCH_r02 vs r03); a deeper median pins the medians.
+    row = bench.bench_halo_p50((512, 512), r=1, mesh=mesh, trials=60)
     row["proxy"] = "cpu-mesh"
     row["devices"] = len(devs)
     print(json.dumps(row))

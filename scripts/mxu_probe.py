@@ -10,12 +10,14 @@ the MXU's best shot.  This script measures the honest alternatives:
    (Y = Bh @ X @ Bw, bf16): the formulation that actually fills the
    128×128 systolic array.
 
-Both still lose to the VPU stencil by orders of magnitude, for an
-analytic reason the measured rows now back: an r=1 separable pass does
-6 flops/px on the VPU; ANY matmul formulation contracts over ≥128
-elements to fill the MXU, inflating flops ≥20× — more than the MXU's
-~100× peak-flops advantage can repay once its utilization on banded
-structure is accounted.  Emits one JSON row per candidate.
+Measured on the attached v5e (2026-07-29, recorded in DESIGN.md):
+``pallas_sep`` 119.2 Gpx/s, ``banded_matmul`` 11.2 Gpx/s (~11× slower),
+``xla_conv_nhwc`` 0.23 Gpx/s (~500× slower).  So the honest MXU
+formulation is within one order of magnitude — not the "orders of
+magnitude" earlier prose claimed — but still clearly loses: the banded
+matmul spends 16384 MXU flops/px where the separable VPU pass spends 12,
+a ~1400× flop inflation that the MXU's peak-flops advantage repays only
+down to that measured ~11× gap.  Emits one JSON row per candidate.
 """
 
 from __future__ import annotations

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Execute the fused RDMA halo kernel on the real attached TPU chip.
+
+VERDICT r03 item 4: the module docstring's claim that the kernel
+"compiles and runs there in its degenerate local form" had never been
+executed for the record.  This script is that record: on a 1×1 mesh the
+kernel's exchange degenerates to local ghost zeroing (no remote partner,
+the neighbor barrier waits on zero signals), but Mosaic still compiles
+the full program — remote-copy primitives, semaphores, barrier — for
+real silicon, which interpret mode cannot prove (see the _sublane
+history in ops/pallas_stencil.py for a Mosaic-only rejection).
+
+Runs the kernel for several iterations on the attached device, checks
+bit-exactness vs the NumPy oracle, and prints one JSON row for
+BASELINE.md.  Exits 1 (with the row saying so) off-TPU.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import _path  # noqa: F401
+
+
+def main() -> int:
+    from parallel_convolution_tpu.utils.platform import (
+        apply_platform_env, enable_compile_cache, on_tpu,
+    )
+
+    apply_platform_env()
+    enable_compile_cache()
+
+    import jax
+    import numpy as np
+
+    row: dict = {"probe": "pallas_rdma on silicon"}
+    if not on_tpu():
+        row["skipped"] = "no TPU attached"
+        print(json.dumps(row))
+        return 1
+
+    from parallel_convolution_tpu.ops import filters, oracle
+    from parallel_convolution_tpu.parallel import mesh as mesh_lib, step
+    from parallel_convolution_tpu.utils import bench, imageio
+
+    d = jax.devices()[0]
+    row["device"] = f"{d.device_kind} ({d.platform})"
+    mesh = mesh_lib.make_grid_mesh(jax.devices()[:1], (1, 1))
+
+    img = imageio.generate_test_image(512, 768, "grey", seed=13)
+    filt = filters.get_filter("blur3")
+    iters = 8
+    x = imageio.interleaved_to_planar(img).astype(np.float32)
+
+    t0 = time.perf_counter()
+    out = step.sharded_iterate(x, filt, iters, mesh=mesh, quantize=True,
+                               backend="pallas_rdma")
+    bench.fence(out)
+    compile_and_run_s = time.perf_counter() - t0
+
+    got = imageio.planar_to_interleaved(np.asarray(out).astype(np.uint8))
+    want = oracle.run_serial_u8(img, filt, iters)
+    bitexact = bool(np.array_equal(got, want))
+
+    # Timed re-run (compile cached): honest wall via the platform's
+    # trusted scheme would need the slope machinery; a plain fenced wall
+    # is enough for a correctness record and labeled as such.
+    t0 = time.perf_counter()
+    out2 = step.sharded_iterate(x, filt, iters, mesh=mesh, quantize=True,
+                                backend="pallas_rdma")
+    bench.fence(out2)
+    warm_s = time.perf_counter() - t0
+
+    row.update({
+        "workload": f"blur3 512x768 grey {iters} iters, 1x1 mesh "
+                    "(degenerate local form; no remote partner exists "
+                    "on one chip)",
+        "mosaic_compiled": True,
+        "bitexact_vs_oracle": bitexact,
+        "first_call_s": round(compile_and_run_s, 3),
+        "warm_wall_s": round(warm_s, 4),
+        "timing": "fence (plain; correctness record, not a benchmark)",
+    })
+    print(json.dumps(row))
+    return 0 if bitexact else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

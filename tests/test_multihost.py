@@ -45,11 +45,16 @@ def test_two_process_distributed(tmp_path):
         )
         for pid in range(n)
     ]
-    outs = []
+    # Drain both pipes CONCURRENTLY: sequential communicate() can deadlock
+    # — worker B blocks on a full stdout pipe while worker A sits in a
+    # collective waiting for B, and we sit in communicate(A).
+    from concurrent.futures import ThreadPoolExecutor
+
     try:
-        for p in procs:
-            out, _ = p.communicate(timeout=540)
-            outs.append(out)
+        with ThreadPoolExecutor(len(procs)) as pool:
+            outs = [f.result() for f in [
+                pool.submit(lambda p=p: p.communicate(timeout=540)[0])
+                for p in procs]]
     finally:
         for p in procs:
             if p.poll() is None:
