@@ -35,14 +35,20 @@ runs the multi-invocation protocol under the race detector).
 STATUS: functionally validated — bit-exact against the oracle on the
 multi-device CPU mesh under TPU interpret mode (which simulates remote
 DMAs, semaphores, and the barrier).  On the one real chip available here
-the kernel compiles via Mosaic and runs in its degenerate 1×1 local form,
-bit-exact vs the oracle (recorded in BASELINE.md "RDMA on silicon");
-multi-chip ICI perf remains unvalidated — no such hardware exists in this
-environment.  VMEM budget: the whole (C, h+2r, w+2r) f32 padded block is
-held in VMEM scratch, so per-device blocks are bounded by ~16 MB/f32 ≈
-2048×2048 grey; larger blocks need the windowed-DMA tiling of
-``_stencil_kernel`` (a fori_loop over window copies between the exchange
-and the store) — left for when real multi-chip hardware can measure it.
+the monolithic kernel compiles via Mosaic and runs in its degenerate 1×1
+local form, bit-exact vs the oracle (recorded in BASELINE.md "RDMA on
+silicon"); multi-chip ICI perf remains unvalidated — no such hardware
+exists in this environment.
+
+VMEM budget: the monolithic kernel holds the whole (C, h+2r, w+2r) f32
+padded block plus the (C, h, w) output in VMEM (~16 MB limit ≈ 1400²
+grey f32 for the pair).  Blocks beyond ``_TILED_VMEM_BYTES`` auto-select
+``_rdma_tiled_kernel``: the padded buffer moves to HBM scratch (storage
+dtype), the exchange uses tiling-aligned band transfers, and compute
+runs the same double-buffered windowed-DMA grid as ``_stencil_kernel``
+— per-program VMEM is two ~1 MB window slots regardless of block size
+(tests: test_rdma_auto_tiles_beyond_vmem_bound and the forced-tiled
+corner/periodic/radius-2 suite).
 """
 
 from __future__ import annotations
@@ -58,7 +64,7 @@ from jax.experimental.pallas import tpu as pltpu
 from parallel_convolution_tpu.ops.collective_ids import collective_id
 from parallel_convolution_tpu.ops.filters import Filter
 from parallel_convolution_tpu.ops.pallas_stencil import (
-    _correlate_window, _from_f32, _to_f32, on_tpu,
+    _correlate_window, _from_f32, _sublane, _to_f32, on_tpu,
 )
 
 # Semaphore slots: one (send, recv) pair per direction.
@@ -103,6 +109,29 @@ def _neighbor_barrier(dirs):
     pltpu.semaphore_wait(bsem, n_wait)
 
 
+def _topology(R, Cc, periodic):
+    """Shared neighbor scaffolding: existence predicates + wrap helper.
+
+    Returns ``(up_in, down_in, left_in, right_in, nbr)`` for the calling
+    device.  Predicates are python bools when static (periodic axes);
+    a periodic self-wrap axis (extent 1) reports False — the kernels
+    handle it with local copies, not remote sends.
+    """
+    x = lax.axis_index("x")
+    y = lax.axis_index("y")
+    up_in = (x > 0) if not periodic else (R > 1)
+    down_in = (x < R - 1) if not periodic else (R > 1)
+    left_in = (y > 0) if not periodic else (Cc > 1)
+    right_in = (y < Cc - 1) if not periodic else (Cc > 1)
+
+    def nbr(dx, dy):
+        if periodic:
+            return (lax.rem(x + dx + R, R), lax.rem(y + dy + Cc, Cc))
+        return (x + dx, y + dy)
+
+    return up_in, down_in, left_in, right_in, nbr
+
+
 def _rdma_kernel(in_ref, out_ref, pad, send_sem, recv_sem, *,
                  taps, sep, k, r, C, h, w, R, Cc, periodic, quantize):
     """One device's program: exchange ghosts in-kernel, then stencil.
@@ -111,18 +140,12 @@ def _rdma_kernel(in_ref, out_ref, pad, send_sem, recv_sem, *,
     ghost ring = RDMA'd from neighbors (or zeros at a non-periodic image
     boundary).  All slab math mirrors halo.halo_exchange exactly.
     """
-    x = lax.axis_index("x")
-    y = lax.axis_index("y")
-
     # Interior + boundary-ghost initialization.  Inbound RDMA targets are
     # exactly the ghost regions owned by an existing neighbor, so local
     # writes below never overlap a remote write (no ordering needed).
     pad[:, r : r + h, r : r + w] = _to_f32(in_ref[...])
 
-    up_in = (x > 0) if not periodic else (R > 1)
-    down_in = (x < R - 1) if not periodic else (R > 1)
-    left_in = (y > 0) if not periodic else (Cc > 1)
-    right_in = (y < Cc - 1) if not periodic else (Cc > 1)
+    up_in, down_in, left_in, right_in, nbr = _topology(R, Cc, periodic)
 
     zero_row = jnp.zeros((C, r, w), jnp.float32)
     zero_col = jnp.zeros((C, h + 2 * r, r), jnp.float32)
@@ -139,11 +162,6 @@ def _rdma_kernel(in_ref, out_ref, pad, send_sem, recv_sem, *,
         # Torus of height 1: my own opposite edge wraps to me (static).
         pad[:, 0:r, r : r + w] = pad[:, h : h + r, r : r + w]
         pad[:, h + r : h + 2 * r, r : r + w] = pad[:, r : 2 * r, r : r + w]
-
-    def nbr(dx, dy):
-        if periodic:
-            return (lax.rem(x + dx + R, R), lax.rem(y + dy + Cc, Cc))
-        return (x + dx, y + dy)
 
     # Cross-invocation safety: no remote copy may be issued until every
     # RDMA partner has entered THIS invocation (see _neighbor_barrier).
@@ -216,10 +234,187 @@ def _rdma_kernel(in_ref, out_ref, pad, send_sem, recv_sem, *,
         out_ref[c] = _from_f32(acc, out_ref.dtype)
 
 
+# ---------------------------------------------------------------------------
+# Tiled variant: HBM-resident padded buffer + windowed-DMA compute grid.
+# ---------------------------------------------------------------------------
+#
+# The monolithic kernel above holds the whole (C, h+2r, w+2r) f32 block in
+# VMEM — a hard ~16 MB bound (≈2048² grey f32).  The tiled variant lifts
+# it: the padded buffer lives in HBM scratch (storage dtype, not f32), the
+# ghost exchange lands there, and the compute phase is the same
+# double-buffered windowed-DMA grid as ``_stencil_kernel``.  Two design
+# points keep HBM DMA *starts* tiling-aligned (Mosaic requires aligned
+# slice starts; interpret mode does not check — see ``_sublane``):
+#
+# 1. **Aligned-band transfers.**  Ghost slabs are r wide, which is never
+#    aligned.  Instead each transfer moves a full (sublane, 128)-aligned
+#    band — ``sub_v`` rows / 128 cols of interior — whose LAST (first) r
+#    rows/cols land exactly on the receiver's ghost positions; the rest of
+#    the band falls on never-read buffer and is masked at compute.
+# 2. **No ghost zeroing.**  Image-boundary ghosts stay uninitialized in
+#    HBM; every compute window applies one select against the block's
+#    valid [row_lo, row_hi) × [col_lo, col_hi) box (which also kills any
+#    non-finite DMA garbage — a multiplicative mask would leak NaN).
+#
+# VMEM per program: 2 window slots of (th + 2·sub_v, tw + 256) storage
+# dtype — ~1.7 MB at the 256×512 f32 default, independent of block size.
+#
+# Honesty note on transfer SHAPES: band extents are aligned (sub_v rows /
+# 128 cols / full padded height), but the orthogonal extent of the
+# interior copy and of each band is the raw block h or w, which is a
+# lane/sublane multiple only when the global image divides the mesh that
+# way (production-size blocks are; odd test blocks are not).  Whether
+# real Mosaic also constrains DMA *shape* alignment for HBM↔HBM copies
+# cannot be validated in this environment — the tiled path's multi-chip
+# form only runs under the interpreter here (same standing caveat as the
+# monolithic kernel's STATUS; single-chip silicon runs the degenerate
+# no-exchange form).  If silicon rejects raw-extent bands, the fix is
+# rounding the band's orthogonal extent up to the next multiple — the
+# pad buffer already has rim to absorb it and the compute mask already
+# ignores it.
+
+_TILED_VMEM_BYTES = 10 * 2**20  # monolithic-kernel budget before auto-tiling
+
+
+def _rdma_tiled_kernel(in_ref, out_ref, pad, win, wsems, xsem, send_sem,
+                       recv_sem, *, taps, sep, k, r, C, h, w, R, Cc,
+                       periodic, quantize, th, tw, sub_v):
+    LANE = 128
+    ext_h, ext_w = th + 2 * sub_v, tw + 2 * LANE
+    c, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    ni, nj = pl.num_programs(1), pl.num_programs(2)
+    step = (c * ni + i) * nj + j
+    slot = lax.rem(step, 2)
+
+    up_in, down_in, left_in, right_in, nbr = _topology(R, Cc, periodic)
+
+    @pl.when(step == 0)
+    def _exchange():
+        # Interior: one aligned HBM->HBM copy (dst starts at (sub_v, 128)).
+        intr = pltpu.make_async_copy(
+            in_ref, pad.at[:, sub_v : sub_v + h, LANE : LANE + w], xsem)
+        intr.start()
+        intr.wait()
+
+        _neighbor_barrier([
+            (up_in, nbr(-1, 0)), (down_in, nbr(+1, 0)),
+            (left_in, nbr(0, -1)), (right_in, nbr(0, +1)),
+        ])
+
+        # Phase 1: row bands (interior cols only; ghost cols not yet live).
+        if periodic and R == 1:
+            # Torus of height 1: own opposite edge, local aligned copies.
+            for s, d, sl in (((sub_v, 2 * sub_v), (h + sub_v, h + 2 * sub_v),
+                              _UP),
+                             ((h, h + sub_v), (0, sub_v), _DOWN)):
+                cp = pltpu.make_async_copy(
+                    pad.at[:, s[0] : s[1], LANE : LANE + w],
+                    pad.at[:, d[0] : d[1], LANE : LANE + w],
+                    send_sem.at[sl])
+                cp.start()
+                cp.wait()
+        else:
+            send_up = pltpu.make_async_remote_copy(
+                pad.at[:, sub_v : 2 * sub_v, LANE : LANE + w],
+                pad.at[:, h + sub_v : h + 2 * sub_v, LANE : LANE + w],
+                send_sem.at[_UP], recv_sem.at[_UP], device_id=nbr(-1, 0),
+            )
+            send_down = pltpu.make_async_remote_copy(
+                pad.at[:, h : h + sub_v, LANE : LANE + w],
+                pad.at[:, 0:sub_v, LANE : LANE + w],
+                send_sem.at[_DOWN], recv_sem.at[_DOWN], device_id=nbr(+1, 0),
+            )
+            pl.when(up_in)(send_up.start)
+            pl.when(down_in)(send_down.start)
+            pl.when(up_in)(send_up.wait_send)
+            pl.when(down_in)(send_down.wait_send)
+            pl.when(down_in)(send_up.wait_recv)
+            pl.when(up_in)(send_down.wait_recv)
+
+        # Phase 2: column bands at FULL padded height — the transferred
+        # bands carry the just-arrived row ghosts, so corners propagate in
+        # two hops exactly as in halo.py / the monolithic kernel.
+        if periodic and Cc == 1:
+            for s, d, sl in (((LANE, 2 * LANE), (w + LANE, w + 2 * LANE),
+                              _LEFT),
+                             ((w, w + LANE), (0, LANE), _RIGHT)):
+                cp = pltpu.make_async_copy(
+                    pad.at[:, :, s[0] : s[1]], pad.at[:, :, d[0] : d[1]],
+                    send_sem.at[sl])
+                cp.start()
+                cp.wait()
+        else:
+            send_left = pltpu.make_async_remote_copy(
+                pad.at[:, :, LANE : 2 * LANE],
+                pad.at[:, :, w + LANE : w + 2 * LANE],
+                send_sem.at[_LEFT], recv_sem.at[_LEFT], device_id=nbr(0, -1),
+            )
+            send_right = pltpu.make_async_remote_copy(
+                pad.at[:, :, w : w + LANE],
+                pad.at[:, :, 0:LANE],
+                send_sem.at[_RIGHT], recv_sem.at[_RIGHT], device_id=nbr(0, +1),
+            )
+            pl.when(left_in)(send_left.start)
+            pl.when(right_in)(send_right.start)
+            pl.when(left_in)(send_left.wait_send)
+            pl.when(right_in)(send_right.wait_send)
+            pl.when(right_in)(send_left.wait_recv)
+            pl.when(left_in)(send_right.wait_recv)
+
+    # --- Compute: the _stencil_kernel windowed-DMA grid over the HBM pad.
+    def window_copy(cc, ii, jj, s):
+        return pltpu.make_async_copy(
+            pad.at[cc, pl.ds(ii * th, ext_h), pl.ds(jj * tw, ext_w)],
+            win.at[s], wsems.at[s])
+
+    @pl.when(step == 0)
+    def _():
+        window_copy(c, i, j, slot).start()
+
+    last = step == pl.num_programs(0) * ni * nj - 1
+
+    @pl.when(jnp.logical_not(last))
+    def _():
+        nstep = step + 1
+        nc = nstep // (ni * nj)
+        nij = lax.rem(nstep, ni * nj)
+        window_copy(nc, nij // nj, lax.rem(nij, nj), 1 - slot).start()
+
+    window_copy(c, i, j, slot).wait()
+
+    # Valid box of the block in padded coords; outside it live
+    # image-boundary ghosts (zero semantics) and never-written buffer.
+    # Periodic: EVERY ghost is valid (filled by wrap or remote band) even
+    # on a self-wrap axis, where the exchange predicate is False.
+    def _i32(p):
+        return jnp.int32(p) if isinstance(p, bool) else p.astype(jnp.int32)
+
+    row_lo = sub_v - (r if periodic else r * _i32(up_in))
+    row_hi = sub_v + h + (r if periodic else r * _i32(down_in))
+    col_lo = LANE - (r if periodic else r * _i32(left_in))
+    col_hi = LANE + w + (r if periodic else r * _i32(right_in))
+
+    w0h, w0w = th + 2 * r, tw + 2 * r
+    rows = (i * th + (sub_v - r)
+            + lax.broadcasted_iota(jnp.int32, (w0h, 1), 0))
+    cols = (j * tw + (LANE - r)
+            + lax.broadcasted_iota(jnp.int32, (1, w0w), 1))
+    ok = (((rows >= row_lo) & (rows < row_hi))
+          & ((cols >= col_lo) & (cols < col_hi)))
+    cur = _to_f32(win[slot][sub_v - r : sub_v + r + th,
+                           LANE - r : LANE + r + tw])
+    cur = jnp.where(ok, cur, 0.0)
+
+    acc = _correlate_window(cur, taps, sep, k, th, tw)
+    if quantize:
+        acc = jnp.clip(jnp.rint(acc), 0.0, 255.0)
+    out_ref[0] = _from_f32(acc, out_ref.dtype)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("filt", "grid", "boundary", "quantize", "out_dtype",
-                     "interpret"),
+                     "interpret", "tiled", "tile"),
 )
 def fused_rdma_step(
     block: jnp.ndarray,
@@ -229,6 +424,8 @@ def fused_rdma_step(
     quantize: bool = True,
     out_dtype=None,
     interpret=None,
+    tiled: bool | None = None,
+    tile: tuple[int, int] | None = None,
 ) -> jnp.ndarray:
     """One halo-exchange + stencil iteration, entirely inside one kernel.
 
@@ -236,6 +433,12 @@ def fused_rdma_step(
     is the local (C, h, w) tile.  Semantically identical to
     ``halo.halo_exchange`` followed by the one-step correlate (+ optional
     u8 quantization) — see tests/test_rdma.py for the bit-exactness proof.
+
+    ``tiled=None`` auto-selects: blocks whose monolithic VMEM footprint
+    (f32 padded buffer + output) exceeds ``_TILED_VMEM_BYTES`` use the
+    HBM-pad + windowed-DMA variant (``_rdma_tiled_kernel``); small blocks
+    keep the all-VMEM kernel (lower latency, no per-window DMA).  ``tile``
+    sets the tiled variant's output tile (default ``DEFAULT_TILE``).
     """
     if boundary not in ("zero", "periodic"):
         raise ValueError(f"boundary must be zero|periodic, got {boundary!r}")
@@ -250,24 +453,87 @@ def fused_rdma_step(
         raise ValueError(f"block {(h, w)} smaller than filter radius {r}")
     sep = None  # rank-1 split saves little at one level; keep 2D order
     taps = tuple(float(t) for t in filt.taps.reshape(-1))
+    periodic = boundary == "periodic"
+    vma = getattr(jax.typeof(block), "vma", frozenset())
+    cparams = pltpu.CompilerParams(
+        collective_id=collective_id("rdma_halo_stencil"),
+        has_side_effects=True,
+    )
+
+    sub_v = _sublane(block.dtype)
+    if tiled is None:
+        mono_bytes = (C * (h + 2 * r) * (w + 2 * r) * 4
+                      + C * h * w * jnp.dtype(out_dtype).itemsize)
+        tiled = mono_bytes > _TILED_VMEM_BYTES
+        if tiled and r > min(sub_v, 128):
+            # Silently falling back to the monolithic kernel here would
+            # trade this clear error for an opaque Mosaic VMEM failure.
+            raise ValueError(
+                f"block {(C, h, w)} needs ~{mono_bytes >> 20} MB of VMEM "
+                f"(over the {_TILED_VMEM_BYTES >> 20} MB monolithic "
+                f"budget) but the tiled kernel requires radius <= "
+                f"{min(sub_v, 128)}, got {r}; use a finer mesh")
+
+    if not tiled:
+        kernel = functools.partial(
+            _rdma_kernel, taps=taps, sep=sep, k=k, r=r, C=C, h=h, w=w,
+            R=grid[0], Cc=grid[1], periodic=periodic, quantize=quantize,
+        )
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((C, h, w), out_dtype, vma=vma),
+            scratch_shapes=[
+                pltpu.VMEM((C, h + 2 * r, w + 2 * r), jnp.float32),
+                pltpu.SemaphoreType.DMA((4,)),
+                pltpu.SemaphoreType.DMA((4,)),
+            ],
+            compiler_params=cparams,
+            interpret=interpret,
+        )(block)
+
+    # ---- tiled variant ----
+    if r > min(sub_v, 128):
+        raise ValueError(
+            f"tiled RDMA kernel needs radius <= {min(sub_v, 128)} "
+            f"(aligned-band ghost transfers), got {r}")
+    from parallel_convolution_tpu.ops.pallas_stencil import (
+        DEFAULT_TILE, _round_up,
+    )
+
+    LANE = 128
+    t0, t1 = tile if tile is not None else DEFAULT_TILE
+    th = min(_round_up(t0, sub_v), _round_up(h, sub_v))
+    tw = min(_round_up(t1, LANE), _round_up(w, LANE))
+    gh, gw = -(-h // th), -(-w // tw)
+    ext_h, ext_w = th + 2 * sub_v, tw + 2 * LANE
+    # Pad buffer: interior at (sub_v, LANE); sized so the LAST window
+    # [gh-1·th, +ext_h) fits — any rim beyond the ghost ring is never
+    # valid (masked) and never sent (transfers address interior/ghost
+    # coordinates only).
+    h_pad = max((gh - 1) * th + ext_h, h + 2 * sub_v)
+    w_pad = max((gw - 1) * tw + ext_w, w + 2 * LANE)
 
     kernel = functools.partial(
-        _rdma_kernel, taps=taps, sep=sep, k=k, r=r, C=C, h=h, w=w,
-        R=grid[0], Cc=grid[1], periodic=boundary == "periodic",
-        quantize=quantize,
+        _rdma_tiled_kernel, taps=taps, sep=sep, k=k, r=r, C=C, h=h, w=w,
+        R=grid[0], Cc=grid[1], periodic=periodic, quantize=quantize,
+        th=th, tw=tw, sub_v=sub_v,
     )
-    vma = getattr(jax.typeof(block), "vma", frozenset())
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((C, h, w), out_dtype, vma=vma),
+        grid=(C, gh, gw),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((1, th, tw), lambda c, i, j: (c, i, j)),
+        out_shape=jax.ShapeDtypeStruct((C, gh * th, gw * tw), out_dtype,
+                                       vma=vma),
         scratch_shapes=[
-            pltpu.VMEM((C, h + 2 * r, w + 2 * r), jnp.float32),
+            pltpu.MemorySpace.HBM((C, h_pad, w_pad), block.dtype),
+            pltpu.VMEM((2, ext_h, ext_w), block.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA((4,)),
             pltpu.SemaphoreType.DMA((4,)),
         ],
-        compiler_params=pltpu.CompilerParams(
-            collective_id=collective_id("rdma_halo_stencil"),
-            has_side_effects=True,
-        ),
+        compiler_params=cparams,
         interpret=interpret,
     )(block)
+    return out[:, :h, :w]

@@ -123,7 +123,7 @@ def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
 
             p = pallas_rdma.fused_rdma_step(
                 v, filt, grid, boundary, quantize=quantize,
-                out_dtype=v.dtype,
+                out_dtype=v.dtype, tile=tile,
             )
             if needs_mask:
                 p = p * _valid_mask(valid_hw, block_hw).astype(p.dtype)

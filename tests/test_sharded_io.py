@@ -52,3 +52,27 @@ def test_run_raw_file_sharded_end_to_end(tmp_path):
     model.run_raw_file_sharded(src, dst, 45, 61, "rgb", 5)
     want = oracle.run_serial_u8(img, filters.get_filter("blur3"), 5)
     np.testing.assert_array_equal(imageio.read_raw(dst, 45, 61, "rgb"), want)
+
+
+def test_northstar_rehearsal_small():
+    """The north-star rehearsal pipeline (scripts/northstar_rehearsal.py)
+    at a fast size: stripe-written input, sharded-IO + checkpoint child,
+    naive-pipeline child for the differential RSS proof, windowed oracle
+    spot-check, byte-identical outputs.  The recorded 8192² rehearsal
+    row lives in evidence/; this keeps the machinery itself under test.
+    """
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(repo, "scripts", "northstar_rehearsal.py")
+    env = dict(os.environ, NS_ROWS="192", NS_COLS="256")
+    proc = subprocess.run([sys.executable, script], env=env,
+                          capture_output=True, text=True, timeout=540)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    import json
+
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["outputs_identical"]
+    assert all(row["oracle_windows_bitexact"].values())
