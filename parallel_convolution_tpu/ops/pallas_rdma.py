@@ -64,7 +64,8 @@ from jax.experimental.pallas import tpu as pltpu
 from parallel_convolution_tpu.ops.collective_ids import collective_id
 from parallel_convolution_tpu.ops.filters import Filter
 from parallel_convolution_tpu.ops.pallas_stencil import (
-    _correlate_window, _from_f32, _sublane, _to_f32, on_tpu,
+    _correlate_window, _from_f32, _prefetch_window, _sublane, _to_f32,
+    on_tpu,
 )
 
 # Semaphore slots: one (send, recv) pair per direction.
@@ -259,19 +260,20 @@ def _rdma_kernel(in_ref, out_ref, pad, send_sem, recv_sem, *,
 # VMEM per program: 2 window slots of (th + 2·sub_v, tw + 256) storage
 # dtype — ~1.7 MB at the 256×512 f32 default, independent of block size.
 #
-# Honesty note on transfer SHAPES: band extents are aligned (sub_v rows /
-# 128 cols / full padded height), but the orthogonal extent of the
-# interior copy and of each band is the raw block h or w, which is a
-# lane/sublane multiple only when the global image divides the mesh that
-# way (production-size blocks are; odd test blocks are not).  Whether
-# real Mosaic also constrains DMA *shape* alignment for HBM↔HBM copies
-# cannot be validated in this environment — the tiled path's multi-chip
-# form only runs under the interpreter here (same standing caveat as the
-# monolithic kernel's STATUS; single-chip silicon runs the degenerate
-# no-exchange form).  If silicon rejects raw-extent bands, the fix is
-# rounding the band's orthogonal extent up to the next multiple — the
-# pad buffer already has rim to absorb it and the compute mask already
-# ignores it.
+# Honesty note on alignment coverage: the scheme is FULLY aligned
+# (every start and every extent) precisely when the block shape itself
+# is (sub_v, 128)-aligned — then the h/w-derived starts (row h, h+sub_v;
+# col w, w+LANE) and the orthogonal extents (h, w) are all multiples.
+# For non-multiple blocks, both those starts and extents are raw h/w,
+# and whether real Mosaic constrains HBM↔HBM copies that way cannot be
+# validated in this environment (the tiled path's multi-chip form only
+# runs under the interpreter; single-chip silicon runs the degenerate
+# no-exchange form — same standing caveat as the monolithic STATUS).
+# If silicon rejects raw-h/w transfers, the fix is at the CALLER: pad
+# the global image so blocks are (sub_v, 128)-multiples — the framework
+# already pads to mesh multiples (`parallel/step._prepare`) and the
+# valid-box mask here already ignores rim, so widening that padding is
+# a one-line change with no kernel edits.
 
 _TILED_VMEM_BYTES = 10 * 2**20  # monolithic-kernel budget before auto-tiling
 
@@ -284,7 +286,6 @@ def _rdma_tiled_kernel(in_ref, out_ref, pad, win, wsems, xsem, send_sem,
     c, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     ni, nj = pl.num_programs(1), pl.num_programs(2)
     step = (c * ni + i) * nj + j
-    slot = lax.rem(step, 2)
 
     up_in, down_in, left_in, right_in, nbr = _topology(R, Cc, periodic)
 
@@ -367,20 +368,7 @@ def _rdma_tiled_kernel(in_ref, out_ref, pad, win, wsems, xsem, send_sem,
             pad.at[cc, pl.ds(ii * th, ext_h), pl.ds(jj * tw, ext_w)],
             win.at[s], wsems.at[s])
 
-    @pl.when(step == 0)
-    def _():
-        window_copy(c, i, j, slot).start()
-
-    last = step == pl.num_programs(0) * ni * nj - 1
-
-    @pl.when(jnp.logical_not(last))
-    def _():
-        nstep = step + 1
-        nc = nstep // (ni * nj)
-        nij = lax.rem(nstep, ni * nj)
-        window_copy(nc, nij // nj, lax.rem(nij, nj), 1 - slot).start()
-
-    window_copy(c, i, j, slot).wait()
+    slot = _prefetch_window(window_copy)
 
     # Valid box of the block in padded coords; outside it live
     # image-boundary ghosts (zero semantics) and never-written buffer.
@@ -496,6 +484,14 @@ def fused_rdma_step(
         raise ValueError(
             f"tiled RDMA kernel needs radius <= {min(sub_v, 128)} "
             f"(aligned-band ghost transfers), got {r}")
+    if h < sub_v or w < 128:
+        # A band narrower than the block would make src/dst of the band
+        # copies overlap (undefined for real DMA engines even though the
+        # interpreter's atomic copies happen to produce the right bytes).
+        raise ValueError(
+            f"tiled RDMA kernel needs blocks >= ({sub_v}, 128) for "
+            f"non-overlapping band transfers, got {(h, w)}; use the "
+            "monolithic kernel (tiled=False) for small blocks")
     from parallel_convolution_tpu.ops.pallas_stencil import (
         DEFAULT_TILE, _round_up,
     )

@@ -126,34 +126,25 @@ def _correlate_window(win, taps, sep, k, th, tw):
     return acc
 
 
-def _stencil_kernel(hbm_ref, out_ref, scratch, sems, *, taps, sep, k, r, th,
-                    tw, ext_h, ext_w, quantize):
-    """One grid program: DMA window c,i,j → VMEM, stencil it, emit tile.
+def _prefetch_window(window_copy):
+    """Double-buffered window pipeline shared by every gridded kernel.
 
-    ``scratch`` holds two (ext_h, ext_w) slots — the (th+2r, tw+2r)
-    stencil window rounded up to the HBM tiling (see ``_sublane``); the
-    alignment rim is DMA'd but never read.  Program n waits on the window
-    it prefetched during program n-1 and starts program n+1's copy before
-    computing (double buffering, slot = parity of linear step).
+    ``window_copy(cc, ii, jj, slot)`` must return the async copy of grid
+    program (cc, ii, jj)'s window into scratch ``slot``.  Program n waits
+    on the window it prefetched during program n-1 and starts program
+    n+1's copy before computing (slot = parity of the linearized step);
+    the first program primes the pipeline with its own window.  Returns
+    the slot holding the current program's window.
     """
     c, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     ni, nj = pl.num_programs(1), pl.num_programs(2)
     step = (c * ni + i) * nj + j
     slot = jax.lax.rem(step, 2)
 
-    def window_copy(cc, ii, jj, slot):
-        return pltpu.make_async_copy(
-            hbm_ref.at[cc, pl.ds(ii * th, ext_h), pl.ds(jj * tw, ext_w)],
-            scratch.at[slot],
-            sems.at[slot],
-        )
-
-    # First program primes the pipeline with its own window.
     @pl.when(step == 0)
     def _():
         window_copy(c, i, j, slot).start()
 
-    # Kick off the *next* program's window before waiting on ours.
     last = step == pl.num_programs(0) * ni * nj - 1
 
     @pl.when(jnp.logical_not(last))
@@ -164,6 +155,26 @@ def _stencil_kernel(hbm_ref, out_ref, scratch, sems, *, taps, sep, k, r, th,
         window_copy(nc, nij // nj, jax.lax.rem(nij, nj), 1 - slot).start()
 
     window_copy(c, i, j, slot).wait()
+    return slot
+
+
+def _stencil_kernel(hbm_ref, out_ref, scratch, sems, *, taps, sep, k, r, th,
+                    tw, ext_h, ext_w, quantize):
+    """One grid program: DMA window c,i,j → VMEM, stencil it, emit tile.
+
+    ``scratch`` holds two (ext_h, ext_w) slots — the (th+2r, tw+2r)
+    stencil window rounded up to the HBM tiling (see ``_sublane``); the
+    alignment rim is DMA'd but never read.
+    """
+
+    def window_copy(cc, ii, jj, slot):
+        return pltpu.make_async_copy(
+            hbm_ref.at[cc, pl.ds(ii * th, ext_h), pl.ds(jj * tw, ext_w)],
+            scratch.at[slot],
+            sems.at[slot],
+        )
+
+    slot = _prefetch_window(window_copy)
 
     acc = _correlate_window(scratch[slot], taps, sep, k, th, tw)
     if quantize:
@@ -279,10 +290,7 @@ def _fused_kernel(off_ref, hbm_ref, out_ref, scratch, sems, *,
     read + one HBM write buy T iterations — the bandwidth analog of the
     fuse=T collective saving.
     """
-    c, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
-    ni, nj = pl.num_programs(1), pl.num_programs(2)
-    step = (c * ni + i) * nj + j
-    slot = jax.lax.rem(step, 2)
+    i, j = pl.program_id(1), pl.program_id(2)
 
     def window_copy(cc, ii, jj, slot):
         return pltpu.make_async_copy(
@@ -291,20 +299,7 @@ def _fused_kernel(off_ref, hbm_ref, out_ref, scratch, sems, *,
             sems.at[slot],
         )
 
-    @pl.when(step == 0)
-    def _():
-        window_copy(c, i, j, slot).start()
-
-    last = step == pl.num_programs(0) * ni * nj - 1
-
-    @pl.when(jnp.logical_not(last))
-    def _():
-        nstep = step + 1
-        nc = nstep // (ni * nj)
-        nij = jax.lax.rem(nstep, ni * nj)
-        window_copy(nc, nij // nj, jax.lax.rem(nij, nj), 1 - slot).start()
-
-    window_copy(c, i, j, slot).wait()
+    slot = _prefetch_window(window_copy)
 
     # Global coords of the window's top-left at level 0.  The scratch slot
     # is the (th+2rT, tw+2rT) stencil window plus an alignment rim (bottom/

@@ -225,6 +225,19 @@ def test_rdma_auto_tiles_beyond_vmem_bound():
     np.testing.assert_array_equal(got, want)
 
 
+def test_rdma_tiled_rejects_sub_band_blocks():
+    """Blocks narrower than one transfer band would self-overlap the
+    band copies (undefined on real DMA engines) — must be rejected."""
+    import jax.numpy as jnp
+
+    from parallel_convolution_tpu.ops import pallas_rdma
+
+    small = jnp.zeros((1, 8, 64), jnp.float32)
+    with pytest.raises(ValueError, match="non-overlapping band"):
+        pallas_rdma.fused_rdma_step(small, filters.get_filter("blur3"),
+                                    (2, 2), tiled=True)
+
+
 def test_rdma_auto_untileable_raises():
     """Over-VMEM-budget block + radius too big for aligned bands must be
     a clear error, not a silent fall-through to a Mosaic VMEM failure."""
