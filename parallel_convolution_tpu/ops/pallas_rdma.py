@@ -453,14 +453,15 @@ def fused_rdma_step(
         mono_bytes = (C * (h + 2 * r) * (w + 2 * r) * 4
                       + C * h * w * jnp.dtype(out_dtype).itemsize)
         tiled = mono_bytes > _TILED_VMEM_BYTES
-        if tiled and r > min(sub_v, 128):
+        if tiled and (r > min(sub_v, 128) or h < sub_v or w < 128):
             # Silently falling back to the monolithic kernel here would
             # trade this clear error for an opaque Mosaic VMEM failure.
             raise ValueError(
                 f"block {(C, h, w)} needs ~{mono_bytes >> 20} MB of VMEM "
                 f"(over the {_TILED_VMEM_BYTES >> 20} MB monolithic "
                 f"budget) but the tiled kernel requires radius <= "
-                f"{min(sub_v, 128)}, got {r}; use a finer mesh")
+                f"{min(sub_v, 128)} (got {r}) and blocks >= "
+                f"({sub_v}, 128); use a finer or differently-shaped mesh")
 
     if not tiled:
         kernel = functools.partial(
@@ -490,8 +491,9 @@ def fused_rdma_step(
         # interpreter's atomic copies happen to produce the right bytes).
         raise ValueError(
             f"tiled RDMA kernel needs blocks >= ({sub_v}, 128) for "
-            f"non-overlapping band transfers, got {(h, w)}; use the "
-            "monolithic kernel (tiled=False) for small blocks")
+            f"non-overlapping band transfers, got {(h, w)}; blocks this "
+            "small fit the monolithic kernel (tiled=False) unless the "
+            "other dimension is huge — then reshape the mesh")
     from parallel_convolution_tpu.ops.pallas_stencil import (
         DEFAULT_TILE, _round_up,
     )

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""BASELINE config 5 both ways: unfused (the r03 status quo) vs the
+round-4 fused convergence path (temporal fusion between checks).
+
+VERDICT r03 item 6: "measure config 5 both ways".  Runs the same jacobi
+run-to-convergence workload (scaled to the attached hardware like
+baseline_configs.py) with (a) shifted/fuse=1 — what every prior round
+measured — and (b) temporal fusion between convergence checks: the
+Pallas 2D-tap kernel on TPU (jacobi3 has no rank-1 factorization, so
+the per-kernel default tile applies — see DEFAULT_TILE), the XLA
+shifted path off-TPU (mirroring baseline_configs.py's backend
+fallback).  Emits one JSON row per variant plus a ratio row.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import _path  # noqa: F401
+
+
+def main() -> int:
+    from parallel_convolution_tpu.utils.platform import (
+        apply_platform_env, enable_compile_cache, on_tpu,
+    )
+
+    apply_platform_env()
+    enable_compile_cache()
+
+    import jax
+    import numpy as np
+
+    from parallel_convolution_tpu.ops.filters import get_filter
+    from parallel_convolution_tpu.parallel import step
+    from parallel_convolution_tpu.parallel.mesh import make_grid_mesh
+    from parallel_convolution_tpu.utils import bench
+
+    platform = "tpu" if on_tpu() else jax.default_backend()
+    scale = 4 if platform == "tpu" else 16
+    size = 32768 // scale
+    mesh = make_grid_mesh(jax.devices())
+    filt = get_filter("jacobi3")
+    x = np.random.default_rng(0).random((1, size, size)).astype(np.float32)
+
+    def run(tag, **kw):
+        # warm/compile outside the timed span
+        bench.fence(step.sharded_converge(x, filt, tol=1e-3, max_iters=200,
+                                          check_every=10, mesh=mesh, **kw)[0])
+        t0 = time.perf_counter()
+        out, iters = step.sharded_converge(x, filt, tol=1e-3, max_iters=200,
+                                           check_every=10, mesh=mesh, **kw)
+        bench.fence(out)
+        secs = time.perf_counter() - t0
+        row = {"variant": tag, "workload": f"jacobi3 {size}x{size} tol=1e-3 "
+               "check_every=10", "platform": platform,
+               "iters_run": iters, "wall_s": round(secs, 3),
+               "iters_per_s": round(iters / secs, 2), **kw}
+        print(json.dumps(row), flush=True)
+        return row, np.asarray(out)
+
+    fused_backend = "pallas" if platform == "tpu" else "shifted"
+    a, out_a = run("unfused (r03 status quo)", backend="shifted")
+    b, out_b = run("fused (round 4)", backend=fused_backend, fuse=8)
+    identical = bool(np.array_equal(out_a, out_b)) and (
+        a["iters_run"] == b["iters_run"])
+    print(json.dumps({
+        "speedup_fused_vs_unfused": round(
+            b["iters_per_s"] / a["iters_per_s"], 2),
+        "bit_identical_results": identical,
+    }))
+    return 0 if identical else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
