@@ -61,6 +61,12 @@ def main() -> int:
         # traffic of f32; exact by construction in quantize mode.
         ("pallas_sep", "u8", 16, shape),
         ("pallas_sep", "u8", 32, shape),
+        # RDMA tier at a tiled-kernel-sized block: degenerate (no remote
+        # partner) on a 1x1 mesh, but every driver round re-proves the
+        # kernel + barrier compile and run on real silicon (fuse=1 by
+        # design: the exchange lives inside the kernel).
+        ("pallas_rdma", "f32", 1,
+         (min(shape[0], 2048), min(shape[1], 2048))),
     ]
     candidates = {}
     for backend, storage, fuse, cshape in configs:

@@ -9,9 +9,11 @@ from parallel_convolution_tpu.utils.config import RunConfig
 
 def test_config_roundtrip():
     c = RunConfig(rows=100, cols=200, mode="rgb", backend="pallas",
-                  mesh_shape=(2, 4), fuse=4, storage="bf16")
+                  mesh_shape=(2, 4), fuse=4, storage="bf16",
+                  tile=(1024, 512))
     c2 = RunConfig.from_json(c.to_json())
     assert c2 == c
+    assert c2.tile == (1024, 512)  # JSON list normalizes back to a tuple
 
 
 def test_config_validation():
@@ -21,6 +23,10 @@ def test_config_validation():
         RunConfig(rows=1, cols=1, backend="cuda")
     with pytest.raises(ValueError, match="positive"):
         RunConfig(rows=0, cols=1)
+    with pytest.raises(ValueError, match="tile"):
+        RunConfig(rows=1, cols=1, tile=(0, 128))
+    with pytest.raises(ValueError, match="tile"):
+        RunConfig(rows=1, cols=1, tile=(8, 128, 2))
 
 
 def test_config_build_model(grey_small):
