@@ -65,28 +65,53 @@ def test_fuse_too_deep_raises(grey_small):
         step.sharded_iterate(x, filt, 40, mesh=_mesh((8, 1)), fuse=20)
 
 
+def _slab_depths(fn, xs):
+    """Halo-slab depths of every collective-permute in ``fn``'s HLO."""
+    import re
+
+    hlo = fn.lower(xs).compile().as_text()
+    shapes = re.findall(
+        r"f32\[1,(\d+),(\d+)\][^\n]*collective-permute", hlo
+    )
+    assert shapes, "no collective-permute in HLO"
+    return {min(int(a), int(b)) for a, b in shapes}
+
+
 def test_fused_halo_exchanges_deep_slabs(grey_small):
     # fuse=5 must exchange 5-deep halo slabs once per chunk (1/5 the
     # collective rounds of fuse=1, whose slabs are 1-deep).
-    import re
-
     filt = filters.get_filter("blur3")
     m = _mesh((2, 2))
     x = imageio.interleaved_to_planar(grey_small).astype(np.float32)
     xs, valid_hw, block_hw = step._prepare(x, m, 1)
 
-    def slab_depths(fuse):
-        fn = step._build_iterate(m, filt, 10, True, valid_hw, block_hw,
-                                 "shifted", fuse)
-        hlo = fn.lower(xs).compile().as_text()
-        shapes = re.findall(
-            r"f32\[1,(\d+),(\d+)\][^\n]*collective-permute", hlo
-        )
-        assert shapes, "no collective-permute in HLO"
-        return {min(int(a), int(b)) for a, b in shapes}
+    def depths(fuse):
+        return _slab_depths(step._build_iterate(
+            m, filt, 10, True, valid_hw, block_hw, "shifted", fuse), xs)
 
-    assert slab_depths(1) == {1}
-    assert slab_depths(5) == {5}
+    assert depths(1) == {1}
+    assert depths(5) == {5}
+
+
+def test_fused_convergence_exchanges_deep_slabs(grey_small):
+    """The round-4 fused convergence path must carry the same structural
+    saving: inside the while_loop chunk, fused steps exchange fuse-deep
+    slabs (one collective round per fuse iterations) — asserted in the
+    compiled HLO, no silicon needed."""
+    filt = filters.get_filter("blur3")
+    m = _mesh((2, 2))
+    x = imageio.interleaved_to_planar(grey_small).astype(np.float32)
+    xs, valid_hw, block_hw = step._prepare(x, m, 1)
+
+    def depths(fuse):
+        return _slab_depths(step._build_converge(
+            m, filt, 0.5, 40, 10, True, valid_hw, block_hw, "shifted",
+            "zero", fuse), xs)
+
+    assert depths(1) == {1}
+    # Fused program contains BOTH depths: 4-deep slabs in the fused
+    # fori_loop plus 1-deep in the remainder/pair-forming single steps.
+    assert depths(4) == {1, 4}
 
 
 @pytest.mark.parametrize("fuse", [2, 4])
