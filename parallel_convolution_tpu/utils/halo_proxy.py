@@ -9,8 +9,11 @@ report its p50, clearly labeled as a CPU functional proxy (it validates
 the mechanism and gives a magnitude, not ICI latency).
 
 Run as ``python -m parallel_convolution_tpu.utils.halo_proxy`` with a clean
-environment; prints ONE JSON line.  A subprocess is required because the
-parent's jax is already initialized on the TPU platform.
+environment; prints ONE JSON line (or one line per config under
+``--sweep``, the block-size/radius scaling record — note
+``run_in_subprocess`` parses only the LAST line and never passes
+--sweep).  A subprocess is required because the parent's jax is already
+initialized on the TPU platform.
 """
 
 from __future__ import annotations
@@ -37,13 +40,25 @@ def main() -> int:
                           f"{len(devs)} {devs[0].platform if devs else '-'}"}))
         return 1
     mesh = make_grid_mesh(devs)
-    # 60 trials (vs the 20 default): this CPU proxy rides host scheduling
-    # noise — its p50 swung 16.0 → 10.7 ms between identical-code rounds
-    # at 20 trials (BENCH_r02 vs r03); a deeper median pins the medians.
-    row = bench.bench_halo_p50((512, 512), r=1, mesh=mesh, trials=60)
-    row["proxy"] = "cpu-mesh"
-    row["devices"] = len(devs)
-    print(json.dumps(row))
+
+    def one(block, r, trials=60):
+        # 60 trials (vs the 20 default): this CPU proxy rides host
+        # scheduling noise — its p50 swung 16.0 → 10.7 ms between
+        # identical-code rounds at 20 trials (BENCH_r02 vs r03); a
+        # deeper median pins the medians.
+        row = bench.bench_halo_p50(block, r=r, mesh=mesh, trials=trials)
+        row["proxy"] = "cpu-mesh"
+        row["devices"] = len(devs)
+        return row
+
+    if "--sweep" in sys.argv:
+        # Scaling record: latency vs per-device block size and radius
+        # (the reference's small-block latency-bound regime, SURVEY §3.2).
+        for block, r in (((64, 64), 1), ((256, 256), 1), ((512, 512), 1),
+                         ((1024, 1024), 1), ((512, 512), 2)):
+            print(json.dumps(one(block, r, trials=40)), flush=True)
+        return 0
+    print(json.dumps(one((512, 512), 1)))
     return 0
 
 
