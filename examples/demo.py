@@ -20,7 +20,13 @@ import numpy as np
 from parallel_convolution_tpu.models import ConvolutionModel
 from parallel_convolution_tpu.ops import filters, oracle
 from parallel_convolution_tpu.utils import imageio
+from parallel_convolution_tpu.utils.platform import apply_platform_env
 from parallel_convolution_tpu.utils.tracing import PhaseTimer
+
+# Honor JAX_PLATFORMS even when a site hook pre-pinned another platform
+# programmatically (utils/platform.py) — without this, JAX_PLATFORMS=cpu
+# runs on (or hangs waiting for) the ambient accelerator instead.
+apply_platform_env()
 
 
 def main() -> int:
