@@ -64,19 +64,19 @@ from jax.experimental.pallas import tpu as pltpu
 from parallel_convolution_tpu.ops.collective_ids import collective_id
 from parallel_convolution_tpu.ops.filters import Filter
 from parallel_convolution_tpu.ops.pallas_stencil import (
-    _correlate_window, _from_f32, _prefetch_window, _sublane, _to_f32,
-    on_tpu,
+    DEFAULT_TILE, _correlate_window, _from_f32, _prefetch_window,
+    _round_up, _sublane, _to_f32, on_tpu,
 )
 
 # Semaphore slots: one (send, recv) pair per direction.
 _UP, _DOWN, _LEFT, _RIGHT = 0, 1, 2, 3
 
 
-def _neighbor_barrier(dirs):
+def _neighbor_barrier(up_in, down_in, left_in, right_in, nbr):
     """Start-of-kernel rendezvous with every RDMA partner.
 
-    ``dirs`` is [(exists, (x, y) device id)] for the four cardinal
-    neighbors.  Each device signals the global barrier semaphore of every
+    Arguments are ``_topology``'s returns.  Each device signals the
+    global barrier semaphore of every
     existing neighbor, then waits until all of ITS neighbors have signaled
     it.  This closes the cross-invocation race the per-invocation race
     detector cannot see: without it, a fast device's iteration-N+1 remote
@@ -93,6 +93,8 @@ def _neighbor_barrier(dirs):
     one.  Leftover signals (a neighbor already in N+2's barrier) simply
     pre-credit the next wait; counts stay balanced.
     """
+    dirs = [(up_in, nbr(-1, 0)), (down_in, nbr(+1, 0)),
+            (left_in, nbr(0, -1)), (right_in, nbr(0, +1))]
     bsem = pltpu.get_barrier_semaphore()
     n_wait = jnp.int32(0)
     for exists, dev in dirs:
@@ -168,10 +170,7 @@ def _rdma_kernel(in_ref, out_ref, pad, send_sem, recv_sem, *,
     # RDMA partner has entered THIS invocation (see _neighbor_barrier).
     # Self-wrap axes (periodic R==1 / Cc==1) have python-False predicates
     # and drop out statically.
-    _neighbor_barrier([
-        (up_in, nbr(-1, 0)), (down_in, nbr(+1, 0)),
-        (left_in, nbr(0, -1)), (right_in, nbr(0, +1)),
-    ])
+    _neighbor_barrier(up_in, down_in, left_in, right_in, nbr)
 
     # --- Phase 1: rows.  My top interior rows -> upper neighbor's bottom
     # ghost; my bottom interior rows -> lower neighbor's top ghost.
@@ -297,10 +296,7 @@ def _rdma_tiled_kernel(in_ref, out_ref, pad, win, wsems, xsem, send_sem,
         intr.start()
         intr.wait()
 
-        _neighbor_barrier([
-            (up_in, nbr(-1, 0)), (down_in, nbr(+1, 0)),
-            (left_in, nbr(0, -1)), (right_in, nbr(0, +1)),
-        ])
+        _neighbor_barrier(up_in, down_in, left_in, right_in, nbr)
 
         # Phase 1: row bands (interior cols only; ghost cols not yet live).
         if periodic and R == 1:
@@ -494,10 +490,6 @@ def fused_rdma_step(
             f"non-overlapping band transfers, got {(h, w)}; blocks this "
             "small fit the monolithic kernel (tiled=False) unless the "
             "other dimension is huge — then reshape the mesh")
-    from parallel_convolution_tpu.ops.pallas_stencil import (
-        DEFAULT_TILE, _round_up,
-    )
-
     LANE = 128
     t0, t1 = tile if tile is not None else DEFAULT_TILE
     th = min(_round_up(t0, sub_v), _round_up(h, sub_v))
