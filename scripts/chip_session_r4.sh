@@ -1,0 +1,21 @@
+#!/bin/sh
+# One-shot chip session: run every record that is waiting on real TPU
+# silicon (BASELINE.md "Round-4 chip-session status note") and land the
+# rows in evidence/.  Safe to re-run; each tool is independent.
+#
+#   sh scripts/chip_session_r4.sh
+#
+# Probe first — the axon tunnel dies transiently and jax then HANGS on
+# backend init (memory: tpu-env-quirks):
+#   timeout 60 python -c "import jax; print(jax.devices())"
+set -x
+cd "$(dirname "$0")/.."
+
+python scripts/validate_walls.py > evidence/validate_walls.json \
+  2> /tmp/vw.err && echo "validate_walls OK"
+python scripts/converge_fuse_bench.py > evidence/converge_fuse_tpu.jsonl \
+  2> /tmp/cf.err && echo "converge_fuse OK"
+python scripts/rdma_on_silicon.py > evidence/rdma_silicon.json \
+  2> /tmp/rs.err && echo "rdma_on_silicon (incl. tiled) OK"
+python bench.py > /tmp/bench_r4_sanity.json 2> /tmp/bench_r4_sanity.err \
+  && tail -c 400 /tmp/bench_r4_sanity.json
