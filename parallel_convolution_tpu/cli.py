@@ -8,6 +8,8 @@ of raw outputs) with subcommands:
   serial    same via the NumPy oracle (the serial main(); golden path)
   generate  create a deterministic test image (the bundled-waterfall analog)
   compare   byte-compare two raw images (the reference's validation step)
+  convert   raw -> PGM/PPM for visual inspection
+  bench     time a synthetic workload, print one JSON row (MPI_Wtime tier)
   info      devices / mesh / filters at a glance
 """
 
@@ -25,6 +27,27 @@ def _add_image_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("cols", type=int)
     p.add_argument("loops", type=int)
     p.add_argument("mode", choices=["grey", "rgb"])
+
+
+def _add_perf_args(p: argparse.ArgumentParser) -> None:
+    """Filter/mesh/kernel knobs shared by the run and bench subcommands."""
+    # Choices come from the canonical jax-free registries so a new backend
+    # or storage tier lands in the CLI without a second edit.
+    from parallel_convolution_tpu.utils.config import BACKENDS, STORAGES
+
+    p.add_argument("--filter", default="blur3", dest="filter_name")
+    p.add_argument("--mesh", default=None,
+                   help="RxC grid, e.g. 2x4 (default: all devices)")
+    p.add_argument("--backend", default="shifted", choices=list(BACKENDS))
+    p.add_argument("--storage", default="f32", choices=list(STORAGES),
+                   help="iteration-carry dtype; narrower carries shrink "
+                        "HBM/ICI traffic and stay bit-exact for u8 images")
+    p.add_argument("--fuse", type=int, default=1, metavar="T",
+                   help="iterations per halo exchange (temporal fusion)")
+    p.add_argument("--tile", default=None, metavar="TH,TW",
+                   help="Pallas kernel output-tile override, e.g. "
+                        "1024,512 (default: per-kernel tuned value; "
+                        "results are bit-identical for any tile)")
 
 
 def _mesh_from_flag(spec: str | None):
@@ -48,23 +71,7 @@ def main(argv: list[str] | None = None) -> int:
     run = sub.add_parser("run", help="distributed filtering on the TPU mesh")
     _add_image_args(run)
     run.add_argument("-o", "--output", required=True)
-    run.add_argument("--filter", default="blur3", dest="filter_name")
-    run.add_argument("--mesh", default=None,
-                     help="RxC grid, e.g. 2x4 (default: all devices)")
-    # Choices come from the canonical jax-free registries so a new backend
-    # or storage tier lands in the CLI without a second edit.
-    from parallel_convolution_tpu.utils.config import BACKENDS, STORAGES
-
-    run.add_argument("--backend", default="shifted", choices=list(BACKENDS))
-    run.add_argument("--storage", default="f32", choices=list(STORAGES),
-                     help="iteration-carry dtype; narrower carries shrink "
-                          "HBM/ICI traffic and stay bit-exact for u8 images")
-    run.add_argument("--fuse", type=int, default=1, metavar="T",
-                     help="iterations per halo exchange (temporal fusion)")
-    run.add_argument("--tile", default=None, metavar="TH,TW",
-                     help="Pallas kernel output-tile override, e.g. "
-                          "1024,512 (default: per-kernel tuned value; "
-                          "results are bit-identical for any tile)")
+    _add_perf_args(run)
     run.add_argument("--boundary", default="zero",
                      choices=["zero", "periodic"],
                      help="edge handling: zero ghost ring (the reference) "
@@ -109,6 +116,17 @@ def main(argv: list[str] | None = None) -> int:
     conv_.add_argument("mode", choices=["grey", "rgb"])
     conv_.add_argument("-o", "--output", required=True,
                        help=".pgm (grey) or .ppm (rgb) path")
+
+    bench_p = sub.add_parser(
+        "bench", help="time a synthetic workload; one JSON row to stdout"
+    )
+    bench_p.add_argument("rows", type=int)
+    bench_p.add_argument("cols", type=int)
+    bench_p.add_argument("loops", type=int)
+    bench_p.add_argument("mode", choices=["grey", "rgb"])
+    _add_perf_args(bench_p)
+    bench_p.add_argument("--reps", type=int, default=3,
+                         help="timing repetitions (min 1)")
 
     sub.add_parser("info", help="devices, default mesh, filters")
 
@@ -183,18 +201,40 @@ def main(argv: list[str] | None = None) -> int:
               f"-> {args.output}")
         return 0
 
-    # run
-    from parallel_convolution_tpu.models import ConvolutionModel, JacobiSolver
-
-    mesh = _mesh_from_flag(args.mesh)
+    # run / bench share the mesh + tile flags
     tile = None
-    if args.tile:
+    if getattr(args, "tile", None):
         try:
             tile = tuple(int(v) for v in args.tile.split(","))
             if len(tile) != 2 or min(tile) <= 0:
                 raise ValueError
         except ValueError:
             ap.error(f"--tile must be TH,TW positive ints, got {args.tile!r}")
+    mesh = _mesh_from_flag(args.mesh)
+
+    if args.cmd == "bench":
+        import json
+
+        from parallel_convolution_tpu.ops.filters import get_filter
+        from parallel_convolution_tpu.utils import bench as bench_lib
+        from parallel_convolution_tpu.utils.platform import (
+            enable_compile_cache,
+        )
+
+        enable_compile_cache()
+        row = bench_lib.bench_iterate(
+            (args.rows, args.cols), get_filter(args.filter_name),
+            args.loops, mesh=mesh,
+            channels=3 if args.mode == "rgb" else 1,
+            backend=args.backend, storage=args.storage, fuse=args.fuse,
+            reps=args.reps, tile=tile,
+        )
+        print(json.dumps(row))
+        return 0
+
+    # run
+    from parallel_convolution_tpu.models import ConvolutionModel, JacobiSolver
+
     if args.converge is not None:
         solver = JacobiSolver(
             filt=args.filter_name, tol=args.converge, max_iters=args.loops,

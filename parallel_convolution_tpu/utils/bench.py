@@ -128,6 +128,7 @@ def bench_iterate(
     kernels."""
     if mesh is None:
         mesh = make_grid_mesh()
+    reps = max(1, reps)  # reps=0 would leave the slope path's median empty
     H, W = shape
     rng = np.random.default_rng(0)
     x = rng.integers(0, 256, size=(channels, H, W)).astype(np.float32)

@@ -74,6 +74,18 @@ def test_cli_tile_flag(tmp_path, capsys):
                   "--tile", "16x128"])
 
 
+def test_cli_bench_subcommand(capsys):
+    """`pconv-tpu bench` prints one machine-readable row (C10 via CLI)."""
+    import json
+
+    assert cli.main(["bench", "64", "96", "3", "grey", "--mesh", "2x2",
+                     "--backend", "pallas_sep", "--fuse", "2",
+                     "--tile", "16,128", "--reps", "1"]) == 0
+    row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert row["mesh"] == "2x2" and row["gpixels_per_s"] > 0
+    assert row["backend"] == "pallas_sep" and row["fuse"] == 2
+
+
 def test_cli_info(capsys):
     assert cli.main(["info"]) == 0
     out = capsys.readouterr().out
