@@ -20,13 +20,20 @@ import sys
 
 
 def main() -> int:
+    from parallel_convolution_tpu.utils.platform import ensure_live_backend
+
+    # Dead-tunnel guard: probe + env-pin application (or labeled CPU
+    # fallback) in one shared shim — see utils/platform.py.
+    platform_note = ensure_live_backend()
+    if platform_note:
+        print(f"# {platform_note}", file=sys.stderr)
+
     import jax
 
     from parallel_convolution_tpu.utils.platform import (
-        apply_platform_env, enable_compile_cache, on_tpu,
+        enable_compile_cache, on_tpu,
     )
 
-    apply_platform_env()
     enable_compile_cache()
 
     from parallel_convolution_tpu.ops.filters import get_filter
@@ -147,6 +154,8 @@ def main() -> int:
         # virtual CPU devices — mechanism + magnitude, not ICI latency.
         result["halo_p50_cpu_mesh_proxy_us"] = halo_proxy["p50_us"]
         result["halo_p50_proxy_mesh"] = halo_proxy.get("mesh")
+    if platform_note:
+        result["platform_note"] = platform_note
     print(json.dumps(result))
     return 0
 

@@ -201,7 +201,9 @@ def main(argv: list[str] | None = None) -> int:
               f"-> {args.output}")
         return 0
 
-    # run / bench share the mesh + tile flags
+    # run / bench share the tile flag; mesh construction stays inside
+    # each branch (bench must not touch jax.devices() before its
+    # dead-tunnel guard has settled the platform).
     tile = None
     if getattr(args, "tile", None):
         try:
@@ -210,7 +212,6 @@ def main(argv: list[str] | None = None) -> int:
                 raise ValueError
         except ValueError:
             ap.error(f"--tile must be TH,TW positive ints, got {args.tile!r}")
-    mesh = _mesh_from_flag(args.mesh)
 
     if args.cmd == "bench":
         import json
@@ -218,10 +219,15 @@ def main(argv: list[str] | None = None) -> int:
         from parallel_convolution_tpu.ops.filters import get_filter
         from parallel_convolution_tpu.utils import bench as bench_lib
         from parallel_convolution_tpu.utils.platform import (
-            enable_compile_cache,
+            enable_compile_cache, ensure_live_backend,
         )
 
+        # Same dead-tunnel guard as the driver bench.py: a benchmark
+        # that hangs forever on backend init is worse than a labeled
+        # CPU fallback row.
+        note = ensure_live_backend()
         enable_compile_cache()
+        mesh = _mesh_from_flag(args.mesh)
         row = bench_lib.bench_iterate(
             (args.rows, args.cols), get_filter(args.filter_name),
             args.loops, mesh=mesh,
@@ -229,12 +235,15 @@ def main(argv: list[str] | None = None) -> int:
             backend=args.backend, storage=args.storage, fuse=args.fuse,
             reps=args.reps, tile=tile,
         )
+        if note:
+            row["platform_note"] = note
         print(json.dumps(row))
         return 0
 
     # run
     from parallel_convolution_tpu.models import ConvolutionModel, JacobiSolver
 
+    mesh = _mesh_from_flag(args.mesh)
     if args.converge is not None:
         solver = JacobiSolver(
             filt=args.filter_name, tol=args.converge, max_iters=args.loops,

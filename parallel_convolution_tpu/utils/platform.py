@@ -74,6 +74,66 @@ def apply_platform_env() -> None:
         force_platform(want, warn=True)
 
 
+# The probe child re-applies JAX_PLATFORMS through jax.config exactly like
+# apply_platform_env (inlined: the child may not have the package on its
+# path), so it probes the SAME backend the parent would select — not
+# blindly the ambient tunnel when the caller explicitly asked for cpu.
+_PROBE_SRC = """
+import os, jax
+w = os.environ.get("JAX_PLATFORMS")
+if w:
+    try:
+        jax.config.update("jax_platforms", w)
+    except Exception:
+        pass
+jax.devices()
+"""
+
+
+def ensure_live_backend(timeout: float = 120.0) -> str | None:
+    """Guard a benchmark entrypoint against a dead accelerator tunnel.
+
+    The ambient platform here is a network tunnel that dies transiently;
+    when it does, the first ``jax.devices()`` blocks FOREVER (observed: a
+    6-hour outage mid-round-4), which would hang the driver.  Probes
+    backend init in a child process (inheriting env + site hook, so it
+    reproduces the parent's selection); on success applies the env pin
+    and returns None.  On hang/failure it pins cpu and returns a note
+    string for the result row — or raises if the cpu pin cannot take
+    (proceeding would hit the same infinite hang the probe exists to
+    prevent).
+    """
+    import subprocess
+
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            timeout=timeout, capture_output=True, text=True,
+        )
+        if p.returncode != 0:
+            # A FAST failure is a real error (typo'd JAX_PLATFORMS,
+            # broken install), not the hang this guard exists for —
+            # surface the child's stderr and let the parent reproduce
+            # the error in-process instead of mislabeling it "tunnel
+            # down" and silently benchmarking the CPU.
+            print(f"pconv-tpu: backend probe failed (rc={p.returncode}); "
+                  f"proceeding to reproduce the error in-process:\n"
+                  f"{p.stderr.strip()[-500:]}", file=sys.stderr)
+        apply_platform_env()
+        return None
+    except subprocess.TimeoutExpired:
+        pass  # the hang case: fall through to the cpu fallback
+    if not force_platform("cpu", warn=True):
+        raise RuntimeError(
+            "accelerator backend unresponsive AND the cpu fallback pin "
+            "could not be applied (a backend already initialized) — "
+            "refusing to proceed into an indefinite hang"
+        )
+    return ("ambient accelerator backend unresponsive (tunnel down?); "
+            "fell back to CPU so this row is a CPU measurement, NOT the "
+            "chip record")
+
+
 def on_tpu() -> bool:
     """True when the default backend drives real TPU silicon.
 
