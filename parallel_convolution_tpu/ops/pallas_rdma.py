@@ -65,7 +65,7 @@ from parallel_convolution_tpu.ops.collective_ids import collective_id
 from parallel_convolution_tpu.ops.filters import Filter
 from parallel_convolution_tpu.ops.pallas_stencil import (
     DEFAULT_TILE, _correlate_window, _from_f32, _prefetch_window,
-    _round_up, _sublane, _to_f32, on_tpu,
+    _quantize_acc, _round_up, _sublane, _to_f32, on_tpu,
 )
 
 # Semaphore slots: one (send, recv) pair per direction.
@@ -136,7 +136,8 @@ def _topology(R, Cc, periodic):
 
 
 def _rdma_kernel(in_ref, out_ref, pad, send_sem, recv_sem, *,
-                 taps, sep, k, r, C, h, w, R, Cc, periodic, quantize):
+                 taps, sep, k, r, C, h, w, R, Cc, periodic, quantize,
+                 convex):
     """One device's program: exchange ghosts in-kernel, then stencil.
 
     ``pad`` is the (C, h+2r, w+2r) f32 working buffer; interior = my block,
@@ -230,7 +231,7 @@ def _rdma_kernel(in_ref, out_ref, pad, send_sem, recv_sem, *,
     for c in range(C):
         acc = _correlate_window(pad[c], taps, sep, k, h, w)
         if quantize:
-            acc = jnp.clip(jnp.rint(acc), 0.0, 255.0)
+            acc = _quantize_acc(acc, convex)
         out_ref[c] = _from_f32(acc, out_ref.dtype)
 
 
@@ -279,7 +280,7 @@ _TILED_VMEM_BYTES = 10 * 2**20  # monolithic-kernel budget before auto-tiling
 
 def _rdma_tiled_kernel(in_ref, out_ref, pad, win, wsems, xsem, send_sem,
                        recv_sem, *, taps, sep, k, r, C, h, w, R, Cc,
-                       periodic, quantize, th, tw, sub_v):
+                       periodic, quantize, convex, th, tw, sub_v):
     LANE = 128
     ext_h, ext_w = th + 2 * sub_v, tw + 2 * LANE
     c, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
@@ -391,7 +392,7 @@ def _rdma_tiled_kernel(in_ref, out_ref, pad, win, wsems, xsem, send_sem,
 
     acc = _correlate_window(cur, taps, sep, k, th, tw)
     if quantize:
-        acc = jnp.clip(jnp.rint(acc), 0.0, 255.0)
+        acc = _quantize_acc(acc, convex)
     out_ref[0] = _from_f32(acc, out_ref.dtype)
 
 
@@ -427,8 +428,11 @@ def fused_rdma_step(
     if boundary not in ("zero", "periodic"):
         raise ValueError(f"boundary must be zero|periodic, got {boundary!r}")
     if interpret is None:
-        interpret = (False if on_tpu()
-                     else pltpu.InterpretParams(dma_execution_mode="on_wait"))
+        interpret = not on_tpu()
+    if interpret is True:
+        # Plain-bool callers (the step builder resolves interpret from the
+        # MESH platform) get the DMA-faithful interpreter configuration.
+        interpret = pltpu.InterpretParams(dma_execution_mode="on_wait")
     if out_dtype is None:
         out_dtype = block.dtype
     C, h, w = block.shape
@@ -463,6 +467,7 @@ def fused_rdma_step(
         kernel = functools.partial(
             _rdma_kernel, taps=taps, sep=sep, k=k, r=r, C=C, h=h, w=w,
             R=grid[0], Cc=grid[1], periodic=periodic, quantize=quantize,
+            convex=filt.convex,
         )
         return pl.pallas_call(
             kernel,
@@ -506,7 +511,7 @@ def fused_rdma_step(
     kernel = functools.partial(
         _rdma_tiled_kernel, taps=taps, sep=sep, k=k, r=r, C=C, h=h, w=w,
         R=grid[0], Cc=grid[1], periodic=periodic, quantize=quantize,
-        th=th, tw=tw, sub_v=sub_v,
+        convex=filt.convex, th=th, tw=tw, sub_v=sub_v,
     )
     out = pl.pallas_call(
         kernel,

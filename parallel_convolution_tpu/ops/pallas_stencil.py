@@ -158,8 +158,18 @@ def _prefetch_window(window_copy):
     return slot
 
 
+def _quantize_acc(acc, convex):
+    """In-kernel u8 store-back on an f32 acc: rint, then clip — except the
+    clip is elided for convex filters, where it is provably the identity
+    (``Filter.convex``); results are bit-identical either way."""
+    acc = jnp.rint(acc)
+    if not convex:
+        acc = jnp.clip(acc, 0.0, 255.0)
+    return acc
+
+
 def _stencil_kernel(hbm_ref, out_ref, scratch, sems, *, taps, sep, k, r, th,
-                    tw, ext_h, ext_w, quantize):
+                    tw, ext_h, ext_w, quantize, convex):
     """One grid program: DMA window c,i,j → VMEM, stencil it, emit tile.
 
     ``scratch`` holds two (ext_h, ext_w) slots — the (th+2r, tw+2r)
@@ -180,7 +190,7 @@ def _stencil_kernel(hbm_ref, out_ref, scratch, sems, *, taps, sep, k, r, th,
     if quantize:
         # Fused u8 store-back: saves one full HBM round trip per iteration
         # vs quantizing in a separate XLA fusion after the kernel.
-        acc = jnp.clip(jnp.rint(acc), 0.0, 255.0)
+        acc = _quantize_acc(acc, convex)
     out_ref[0] = _from_f32(acc, out_ref.dtype)
 
 
@@ -245,7 +255,8 @@ def correlate_padded_pallas(
     taps = tuple(float(t) for t in filt.taps.reshape(-1))
     kernel = functools.partial(
         _stencil_kernel, taps=taps, sep=sep,
-        k=k, r=r, th=th, tw=tw, ext_h=ext_h, ext_w=ext_w, quantize=quantize
+        k=k, r=r, th=th, tw=tw, ext_h=ext_h, ext_w=ext_w, quantize=quantize,
+        convex=filt.convex,
     )
     # Propagate varying-mesh-axes so the kernel composes under shard_map
     # (check_vma needs the out type to declare what it varies over).
@@ -281,7 +292,7 @@ def correlate_shifted_pallas(x: jnp.ndarray, filt: Filter, **kw) -> jnp.ndarray:
 
 def _fused_kernel(off_ref, hbm_ref, out_ref, scratch, sems, *,
                   taps, sep, k, r, T, th, tw, ext_h, ext_w, valid_hw,
-                  quantize):
+                  quantize, convex):
     """T in-VMEM stencil levels on one (th + 2rT, tw + 2rT) window.
 
     The window shrinks by r per level; after each level, positions outside
@@ -334,7 +345,7 @@ def _fused_kernel(off_ref, hbm_ref, out_ref, scratch, sems, *,
         ch, cw = th + 2 * r * (T - s), tw + 2 * r * (T - s)
         acc = _correlate_window(cur, taps, sep, k, ch, cw)
         if quantize:
-            acc = jnp.clip(jnp.rint(acc), 0.0, 255.0)
+            acc = _quantize_acc(acc, convex)
         if valid_hw is not None:
             # Level-s window starts r*s deeper; slice the hoisted iotas.
             rows = rows0[r * s : r * s + ch, :]
@@ -398,7 +409,7 @@ def fused_iterate_pallas(
         _fused_kernel, taps=taps, sep=sep,
         k=k, r=r, T=T, th=th, tw=tw, ext_h=ext_h, ext_w=ext_w,
         valid_hw=None if valid_hw is None else tuple(valid_hw),
-        quantize=quantize,
+        quantize=quantize, convex=filt.convex,
     )
     vma = getattr(jax.typeof(padded), "vma", frozenset())
     out = pl.pallas_call(

@@ -68,7 +68,8 @@ def _valid_mask(valid_hw, block_hw, margin: int = 0):
 
 def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
                      backend: str, fuse: int = 1, boundary: str = "zero",
-                     tile: tuple[int, int] | None = None):
+                     tile: tuple[int, int] | None = None,
+                     interpret: bool | None = None):
     """``fuse`` iterations on a local block per halo exchange.
 
     fuse=1 is the reference's loop shape: exchange 1-deep halos, stencil,
@@ -108,7 +109,7 @@ def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
 
             return pallas_stencil.correlate_padded_pallas(
                 p, filt, quantize=quantize, out_dtype=out_dtype,
-                separable=sep, tile=tile,
+                separable=sep, tile=tile, interpret=interpret,
             )
         out = _correlate_for_backend(backend)(p, filt)
         if quantize:
@@ -123,7 +124,7 @@ def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
 
             p = pallas_rdma.fused_rdma_step(
                 v, filt, grid, boundary, quantize=quantize,
-                out_dtype=v.dtype, tile=tile,
+                out_dtype=v.dtype, tile=tile, interpret=interpret,
             )
             if needs_mask:
                 p = p * _valid_mask(valid_hw, block_hw).astype(p.dtype)
@@ -141,7 +142,7 @@ def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
             return pallas_stencil.fused_iterate_pallas(
                 p, off, filt, fuse, None if periodic else tuple(valid_hw),
                 quantize=quantize, out_dtype=v.dtype, separable=sep,
-                tile=tile,
+                tile=tile, interpret=interpret,
             )
         for t in range(fuse):
             margin = depth - r * (t + 1)
@@ -151,6 +152,19 @@ def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
         return p.astype(v.dtype)
 
     return step
+
+
+def _mesh_interpret(mesh: Mesh) -> bool:
+    """interpret= for Pallas kernels compiled for THIS mesh's devices.
+
+    The global default backend can be a TPU while the mesh is a forced-CPU
+    one (utils.platform.cpu_devices in a process that already initialized
+    the tunnel backend) — resolving off jax.devices() there hands Mosaic
+    kernels to the CPU lowering, which rejects them.
+    """
+    from parallel_convolution_tpu.utils.platform import device_on_tpu
+
+    return not device_on_tpu(mesh.devices.flat[0])
 
 
 def _check_block_size(filt: Filter, block_hw) -> None:
@@ -174,11 +188,13 @@ def _build_iterate(mesh: Mesh, filt: Filter, iters: int, quantize: bool,
         raise ValueError(
             f"fuse={fuse} needs blocks >= {filt.radius * fuse}, got {block_hw}"
         )
+    interp = _mesh_interpret(mesh)
     chunk = _make_block_step(filt, grid, valid_hw, block_hw, quantize,
-                             backend, fuse, boundary, tile)
+                             backend, fuse, boundary, tile, interp)
     n_chunks, rem = divmod(iters, fuse)
     tail = (_make_block_step(filt, grid, valid_hw, block_hw, quantize,
-                             backend, rem, boundary, tile) if rem else None)
+                             backend, rem, boundary, tile, interp)
+            if rem else None)
 
     def body(block):
         block = lax.fori_loop(0, n_chunks, lambda _, v: chunk(v), block)
@@ -224,10 +240,11 @@ def _build_converge(mesh: Mesh, filt: Filter, tol: float, max_iters: int,
             f"fuse={fuse} needs blocks >= {filt.radius * fuse}, got "
             f"{block_hw}{clamp_note}"
         )
+    interp = _mesh_interpret(mesh)
     step = _make_block_step(filt, grid, valid_hw, block_hw, quantize, backend,
-                            boundary=boundary, tile=tile)
+                            boundary=boundary, tile=tile, interpret=interp)
     fused = (_make_block_step(filt, grid, valid_hw, block_hw, quantize,
-                              backend, fuse, boundary, tile)
+                              backend, fuse, boundary, tile, interp)
              if fuse > 1 else None)
 
     def body(block):
@@ -374,6 +391,12 @@ def sharded_iterate(x, filt: Filter, iters: int, mesh: Mesh | None = None,
     precision/bandwidth trade.  ``tile=(TH, TW)`` overrides the Pallas
     kernels' VMEM output-tile shape (the scripts/tune_pallas.py knob);
     None = the per-kernel tuned default.
+
+    ``quantize=True`` is the u8 store-back semantics and assumes pixel
+    values in [0, 255] (a decoded u8 image): convex filters elide the
+    provably-idle clamp (``Filter.convex``), so a float plane fed in with
+    out-of-range values is out of contract — it propagates unclamped
+    where pre-round-4 code clamped it on the first store-back.
     """
     if mesh is None:
         mesh = make_grid_mesh()

@@ -134,12 +134,23 @@ def ensure_live_backend(timeout: float = 120.0) -> str | None:
             "chip record")
 
 
-def on_tpu() -> bool:
-    """True when the default backend drives real TPU silicon.
+def device_on_tpu(d) -> bool:
+    """True when ``d`` is real TPU silicon.
 
     Checks device_kind too: experimental PJRT proxies (e.g. platform
     'axon') report a platform name != 'tpu' while still being TPUs — the
     Mosaic path must be used there, not the Pallas interpreter.
+    """
+    kind = (getattr(d, "device_kind", "") or "").lower()
+    return "tpu" in d.platform.lower() or "tpu" in kind
+
+
+def on_tpu() -> bool:
+    """True when the DEFAULT backend drives real TPU silicon.
+
+    One process can hold both a TPU default backend and a forced-CPU
+    mesh (``cpu_devices``); code compiling for a specific mesh must ask
+    ``device_on_tpu(mesh.devices.flat[0])``, not this global.
     """
     import jax
 
@@ -147,8 +158,7 @@ def on_tpu() -> bool:
         d = jax.devices()[0]
     except Exception:
         return False
-    kind = (getattr(d, "device_kind", "") or "").lower()
-    return "tpu" in d.platform.lower() or "tpu" in kind
+    return device_on_tpu(d)
 
 
 def cpu_devices(n: int | None = None) -> list:

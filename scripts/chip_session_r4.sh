@@ -8,14 +8,20 @@
 # Probe first — the axon tunnel dies transiently and jax then HANGS on
 # backend init (memory: tpu-env-quirks):
 #   timeout 60 python -c "import jax; print(jax.devices())"
+#
+# Outputs go through a temp file + rename so a failed (or interrupted)
+# rerun can never leave a truncated/empty evidence row behind.
 set -x
 cd "$(dirname "$0")/.."
 
-python scripts/validate_walls.py > evidence/validate_walls.json \
-  2> /tmp/vw.err && echo "validate_walls OK"
-python scripts/converge_fuse_bench.py > evidence/converge_fuse_tpu.jsonl \
-  2> /tmp/cf.err && echo "converge_fuse OK"
-python scripts/rdma_on_silicon.py > evidence/rdma_silicon.json \
-  2> /tmp/rs.err && echo "rdma_on_silicon (incl. tiled) OK"
+run_to() {
+  out="$1"; shift
+  "$@" > "$out.tmp" 2> "/tmp/$(basename "$out").err" \
+    && mv "$out.tmp" "$out" && echo "$out OK"
+}
+
+run_to evidence/validate_walls.json python scripts/validate_walls.py
+run_to evidence/converge_fuse_tpu.jsonl python scripts/converge_fuse_bench.py
+run_to evidence/rdma_silicon.json python scripts/rdma_on_silicon.py
 python bench.py > /tmp/bench_r4_sanity.json 2> /tmp/bench_r4_sanity.err \
   && tail -c 400 /tmp/bench_r4_sanity.json

@@ -48,3 +48,14 @@ def test_custom_filter_any_odd_size():
     f = filters.make_filter("box7", np.ones((7, 7)), divisor=49)
     assert f.size == 7
     assert abs(float(f.taps.sum()) - 1.0) < 1e-6
+
+
+def test_convex_truth_table():
+    # Convex = non-negative taps summing to <= 1: the quantize-mode clip is
+    # provably the identity and the Pallas kernels elide it (~2 VPU ops/px
+    # per level).  Filters with negative taps or gain > 1 must keep it.
+    for name in ["blur3", "box3", "gaussian5", "jacobi3", "identity3"]:
+        assert filters.get_filter(name).convex, name
+    for name in ["edge3", "edge5", "sharpen3"]:
+        assert not filters.get_filter(name).convex, name
+    assert filters.gaussian(7, 1.5).convex

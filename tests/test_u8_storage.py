@@ -128,3 +128,35 @@ def test_u8_model_api(grey_small):
     got = m.run_image(grey_small, 5)
     want = oracle.run_serial_u8(grey_small, filters.get_filter("blur3"), 5)
     np.testing.assert_array_equal(got, want)
+
+
+def test_u8_nonconvex_filter_keeps_clip(grey_odd):
+    # sharpen3 has negative taps (not convex) → the kernels must keep the
+    # [0, 255] clamp; on real images sharpening over/undershoots, so this
+    # exercises clipping being LIVE, not just present.
+    filt = filters.get_filter("sharpen3")
+    want = oracle.run_serial_u8(grey_odd, filt, 4)
+    x = imageio.interleaved_to_planar(grey_odd).astype(np.float32)
+    for backend in ("pallas", "pallas_sep"):
+        out = step.sharded_iterate(x, filt, 4, mesh=_mesh((2, 2)),
+                                   quantize=True, backend=backend,
+                                   storage="u8")
+        got = imageio.planar_to_interleaved(np.asarray(out).astype(np.uint8))
+        np.testing.assert_array_equal(got, want)
+
+
+def test_u8_convex_saturated_image_stays_in_range():
+    # All-255 input through a NON-dyadic convex filter (gaussian taps do
+    # not sum to exactly 1.0 in f32): the elided-clip path must still
+    # produce bytes <= 255 — the convexity proof's boundary case.
+    img = np.full((40, 56), 255, dtype=np.uint8)
+    filt = filters.gaussian(5, 1.2)
+    assert filt.convex and not filt.dyadic
+    want = oracle.run_serial_u8(img, filt, 5)
+    x = imageio.interleaved_to_planar(img).astype(np.float32)
+    for fuse in (1, 5):
+        out = step.sharded_iterate(x, filt, 5, mesh=_mesh((2, 2)),
+                                   quantize=True, backend="pallas_sep",
+                                   storage="u8", fuse=fuse)
+        got = imageio.planar_to_interleaved(np.asarray(out).astype(np.uint8))
+        np.testing.assert_array_equal(got, want)
