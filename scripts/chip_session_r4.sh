@@ -5,14 +5,15 @@
 #
 #   sh scripts/chip_session_r4.sh
 #
-# Probe first — the axon tunnel dies transiently and jax then HANGS on
-# backend init (memory: tpu-env-quirks):
-#   timeout 60 python -c "import jax; print(jax.devices())"
 #
 # Outputs go through a temp file + rename so a failed (or interrupted)
 # rerun can never leave a truncated/empty evidence row behind.
 set -x
 cd "$(dirname "$0")/.."
+
+# Dead-tunnel guard: a dead tunnel makes jax HANG on backend init, which
+# would eat the whole session window; fail fast instead.
+timeout 60 python -c "import jax; print(jax.devices())"   || { echo "tunnel dead; aborting chip session" >&2; exit 1; }
 
 run_to() {
   out="$1"; shift

@@ -1,0 +1,36 @@
+#!/bin/sh
+# Second-recovery chip session (round 4): the records still waiting on
+# TPU silicon after the tunnel's SECOND mid-round death (BASELINE.md
+# status note).  Ordered by value so another outage costs the least:
+#
+#   1. flagship tile/fuse re-tune with the round-4 convex-clamp elision
+#      (the headline number; the elision measured +39% on pallas/f32/fuse1
+#      before the tunnel died)
+#   2. rdma_on_silicon — two-size tiled probe with full error capture
+#      (diagnose the remote-compile HTTP 500)
+#   3. validate_walls — the rerun whose output was lost
+#   4. bench.py sanity
+#
+set -x
+cd "$(dirname "$0")/.."
+
+# Dead-tunnel guard: a dead tunnel makes jax HANG on backend init, which
+# would eat the whole session window; fail fast instead.
+timeout 60 python -c "import jax; print(jax.devices())"   || { echo "tunnel dead; aborting chip session" >&2; exit 1; }
+
+run_to() {
+  out="$1"; shift
+  "$@" > "$out.tmp" 2> "/tmp/$(basename "$out").err" \
+    && mv "$out.tmp" "$out" && echo "$out OK"
+}
+
+run_to evidence/tune_convex_r4.jsonl \
+  python scripts/tune_pallas.py --backend pallas_sep --storage bf16 \
+    --iters 100 --tiles 1024x512,1536x512,2048x512,1024x768 --fuses 24,32,40
+run_to evidence/tune_convex_r4_u8.jsonl \
+  python scripts/tune_pallas.py --backend pallas_sep --storage u8 \
+    --iters 100 --tiles 1024x512,2048x512 --fuses 32,40
+run_to evidence/rdma_silicon.json python scripts/rdma_on_silicon.py
+run_to evidence/validate_walls.json python scripts/validate_walls.py
+python bench.py > /tmp/bench_r4b_sanity.json 2> /tmp/bench_r4b_sanity.err \
+  && tail -c 400 /tmp/bench_r4b_sanity.json

@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Labeled multi-chip projection from measured single-chip rows.
+
+SURVEY.md §7 ("single-chip reality"): with one physical chip attached,
+multi-chip perf numbers must be CLEARLY-LABELED extrapolations, not
+measurements.  This tool is that label made executable: it reads the
+measured single-chip rows (`evidence/baseline_tpu.json`) and projects
+BASELINE configs 2/4/5 onto their target mesh with an explicit analytic
+model — every hardware assumption is a flag, every row carries
+``"projection": true`` and echoes the assumptions it used.
+
+Model (per fused chunk of T iterations, per chip, block h×w×C,
+storage s bytes/px, filter radius r):
+
+  compute_s = T * h * w * C / measured_gpx_per_chip
+  halo_bytes = 2 * (h + w) * r * T * C * s        (both axes, both sides)
+  halo_s    = halo_bytes / ici_bytes_s + 2 * phases * latency_s
+
+Two sequential ppermute phases propagate corners (parallel/halo.py), so
+latency enters twice per exchange.  Convergence (config 5) adds one
+allreduce latency every check_every iterations.  The projection divides
+compute by (compute + halo) — i.e. it assumes NO comm/compute overlap,
+the conservative end; XLA's async collectives can only do better.
+
+Defaults: ``--ici-gb-s 45`` (per-link-class aggregate for a v5e 2D
+torus neighbor exchange; an ASSUMPTION, not a measurement) and
+``--latency-us 5`` (per collective phase; bracketed by the CPU-mesh
+functional proxy's sub-ms p50 and typical ICI small-message latencies).
+Sensitivity: pass different values; rows are cheap.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+CONFIGS = [
+    # (name, global (H, W, C), mesh (R, Cc), storage bytes, fuse T, radius,
+    #  check_every or None)
+    ("2: blur3 1920x2520 rgb on 2x2", (1920, 2520, 3), (2, 2), 2, 16, 1, None),
+    ("4: blur3 65536^2 rgb on 4x4 (north star)", (65536, 65536, 3), (4, 4),
+     2, 16, 1, None),
+    ("5: jacobi3 32768^2 f32 on 4x4", (32768, 32768, 1), (4, 4), 4, 1, 1, 10),
+]
+
+# Fallback single-chip basis (copied from evidence/baseline_tpu.json as of
+# 2026-07-29) — used only if that file is unreadable; the live rows are
+# preferred so a re-measure propagates here automatically.  Configs 4/5
+# time exactly the target per-chip block; config 2's basis row timed the
+# FULL image (4x the 2x2 per-chip block) — per-chip rates usually drop at
+# smaller blocks, so that projection leans optimistic and its row says so.
+FALLBACK_BASIS = {
+    "2:": ("blur3 1920x2520x3 100 iters", 266.403),
+    "4:": ("blur3 16384x16384x3 5 iters", 86.658),
+    "5:": ("jacobi3 8192x8192 tol=1e-3", 22.42),
+}
+
+
+def load_basis() -> dict:
+    """{config-prefix: (workload, per-chip rate)} from the evidence rows."""
+    import os
+
+    basis = dict(FALLBACK_BASIS)
+    path = os.path.join(os.path.dirname(__file__), "..", "evidence",
+                        "baseline_tpu.json")
+    try:
+        with open(path) as f:
+            for line in f:
+                row = json.loads(line)
+                pref = row.get("config", " ")[:2]
+                if pref in basis:
+                    rate = row.get("gpixels_per_s_per_chip",
+                                   row.get("iters_per_s"))
+                    if rate:
+                        basis[pref] = (row["workload"], float(rate))
+    except OSError:
+        pass
+    return basis
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ici-gb-s", type=float, default=45.0,
+                    help="assumed neighbor-exchange ICI bandwidth, GB/s")
+    ap.add_argument("--latency-us", type=float, default=5.0,
+                    help="assumed per-collective-phase latency, us")
+    args = ap.parse_args()
+    ici = args.ici_gb_s * 1e9
+    lat = args.latency_us * 1e-6
+
+    basis_map = load_basis()
+    for name, (H, W, C), (R, Cc), sbytes, T, r, check_every in CONFIGS:
+        basis_workload, basis = basis_map[name[:2]]
+        chips = R * Cc
+        h, w = H // R, W // Cc
+        px_per_iter = h * w * C
+
+        if check_every is None:
+            compute_s = T * px_per_iter / (basis * 1e9)
+        else:
+            # basis is iters/s at this block size; fuse=1 semantics.
+            compute_s = T / basis
+        halo_bytes = 2 * (h + w) * r * T * C * sbytes
+        halo_s = halo_bytes / ici + 2 * 2 * lat  # 2 phases, signal+drain
+        if check_every is not None:
+            halo_s += lat * T / check_every  # amortized allreduce
+        eff = compute_s / (compute_s + halo_s)
+
+        row = {
+            "projection": True,
+            "config": name,
+            "mesh": f"{R}x{Cc}",
+            "basis_row": basis_workload,
+            "basis_per_chip": basis,
+            "assumed_ici_gb_s": args.ici_gb_s,
+            "assumed_latency_us": args.latency_us,
+            "halo_bytes_per_chunk": halo_bytes,
+            "halo_overhead_pct": round((1 - eff) * 100, 2),
+            "projected_per_chip": round(basis * eff, 2),
+            "unit": "iters/s" if check_every is not None else "Gpx/s",
+            "note": "no-overlap analytic projection, NOT a measurement",
+        }
+        if check_every is None:
+            row["projected_fleet"] = round(basis * eff * chips, 2)
+        else:
+            # A lockstep Jacobi solve advances ONE global iteration at a
+            # time: 16 chips don't iterate 16x faster, they carry 16x the
+            # area at the same rate — that IS the scaling claim.
+            row["projected_solve_iters_per_s"] = round(basis * eff, 2)
+            row["area_scaled_x"] = chips
+        if name.startswith("2:"):
+            row["basis_block_px_ratio"] = 4.0
+            row["basis_caveat"] = ("basis row timed the full image, 4x the "
+                                   "per-chip block; per-chip rates drop at "
+                                   "smaller blocks, so this leans optimistic")
+        print(json.dumps(row))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -184,3 +184,26 @@ def test_block_smaller_than_radius_raises():
     tiny = np.ones((1, 8, 3), np.float32)  # W blocks of 1 < radius 2 on 1×4
     with pytest.raises(ValueError, match="smaller than filter radius"):
         step.sharded_iterate(tiny, filt, 1, mesh=_mesh((1, 4)))
+
+
+def test_mesh_interpret_resolves_from_mesh_devices():
+    # One process can hold a TPU default backend AND a forced-CPU mesh
+    # (the driver's entry() + dryrun_multichip sequence); interpret= must
+    # come from the mesh's own devices, not jax.devices() — a CPU mesh
+    # always interprets, and a device reporting a TPU kind never does.
+    # (Platform-agnostic: under PCTPU_TEST_PLATFORM=tpu the real mesh is
+    # a TPU one and the expectation flips.)
+    from parallel_convolution_tpu.utils.platform import device_on_tpu
+
+    devs = jax.devices()
+    m = mesh_lib.make_grid_mesh(devs[: min(4, len(devs))])
+    assert step._mesh_interpret(m) is (not device_on_tpu(devs[0]))
+
+    class FakeTpuDevice:
+        platform = "axon"
+        device_kind = "TPU v5 lite"
+
+    class FakeMesh:
+        devices = np.asarray([[FakeTpuDevice()]])
+
+    assert step._mesh_interpret(FakeMesh()) is False
