@@ -7,9 +7,11 @@
 #      (the headline number; the elision measured +39% on pallas/f32/fuse1
 #      before the tunnel died)
 #   2. rdma_on_silicon — two-size tiled probe with full error capture
-#      (diagnose the remote-compile HTTP 500)
-#   3. validate_walls — the rerun whose output was lost
-#   4. bench.py sanity
+#      (re-record the remote-compile HTTP 500)
+#   3. tiled_repro_probe — six-step construct ladder attributing that
+#      crash to a specific Pallas construct
+#   4. validate_walls — the rerun whose output was lost
+#   5. bench.py sanity
 #
 set -x
 cd "$(dirname "$0")/.."
@@ -31,6 +33,7 @@ run_to evidence/tune_convex_r4_u8.jsonl \
   python scripts/tune_pallas.py --backend pallas_sep --storage u8 \
     --iters 100 --tiles 1024x512,2048x512 --fuses 32,40
 run_to evidence/rdma_silicon.json python scripts/rdma_on_silicon.py
+run_to evidence/tiled_repro.jsonl python scripts/tiled_repro_probe.py
 run_to evidence/validate_walls.json python scripts/validate_walls.py
 python bench.py > /tmp/bench_r4b_sanity.json 2> /tmp/bench_r4b_sanity.err \
   && tail -c 400 /tmp/bench_r4b_sanity.json

@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""Isolate which construct of the tiled RDMA kernel kills the compile helper.
+
+`scripts/rdma_on_silicon.py` records that `_rdma_tiled_kernel` is
+rejected on silicon with an HTTP 500 (`tpu_compile_helper` subprocess
+crash, no Mosaic diagnostic).  The monolithic kernel — which shares the
+barrier, remote copies, semaphores, and ANY→VMEM input DMA — compiles
+fine, so the suspects are the constructs ONLY the tiled variant uses.
+
+The probes form an additive ladder: each adds EXACTLY ONE construct on
+top of the previous probe, so the first failing row's own delta names
+the offender:
+
+  a_unused_hbm_scratch   Δ: an HBM scratch buffer is allocated (never
+                            touched; compute goes in→VMEM→out)
+  b_hbm_roundtrip        Δ: DMA into and out of the HBM scratch
+  c_hbm_internal_copy    Δ: HBM→HBM copy between two scratch regions
+  d_windowed_from_hbm    Δ: gridded pl.ds windowed DMA out of the
+                            scratch (refill copy runs EVERY program —
+                            wasteful but construct-free)
+  e_when_step0           Δ: the refill copy moves under the one-shot
+                            @pl.when(step == 0) guard
+  f_collective_params    Δ: CompilerParams(collective_id,
+                            has_side_effects) as the real kernel passes
+
+Emits one JSON row per probe (failures are IN the record); exit 0 iff
+every probe produced a row.  Off-TPU it exits 1 — the interpreter
+accepts all six, there is nothing to learn from it here.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import _path  # noqa: F401
+
+
+def main() -> int:
+    from parallel_convolution_tpu.utils.platform import (
+        apply_platform_env, enable_compile_cache, on_tpu,
+    )
+
+    apply_platform_env()
+    enable_compile_cache()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if not on_tpu():
+        print(json.dumps({"probe": "tiled_repro", "skipped": "no TPU"}))
+        return 1
+
+    H, W = 256, 512
+    TH, TW = 64, 128
+    x = np.arange(H * W, dtype=np.float32).reshape(H, W) % 251.0
+
+    def run(name, fn, want):
+        try:
+            got = np.asarray(jax.jit(fn)(jnp.asarray(x)))
+            row = {"probe": name, "mosaic_compiled": True,
+                   "correct": bool(np.array_equal(got, want))}
+        except Exception as e:
+            msg = repr(e)
+            if len(msg) > 3000:
+                msg = msg[:1500] + " ...[elided]... " + msg[-1500:]
+            row = {"probe": name, "mosaic_compiled": False, "error": msg}
+        print(json.dumps(row), flush=True)
+
+    ANY_IO = dict(
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_shape=jax.ShapeDtypeStruct((H, W), jnp.float32),
+    )
+
+    # a. HBM scratch allocated but never touched; data moves via VMEM
+    #    (the ANY→VMEM path the monolithic kernel already proves).
+    def k_a(in_ref, out_ref, hbm, vmem, sem):
+        cp = pltpu.make_async_copy(in_ref, vmem, sem)
+        cp.start()
+        cp.wait()
+        cp2 = pltpu.make_async_copy(vmem, out_ref, sem)
+        cp2.start()
+        cp2.wait()
+
+    run("a_unused_hbm_scratch", lambda v: pl.pallas_call(
+        k_a, **ANY_IO,
+        scratch_shapes=[pltpu.MemorySpace.HBM((H, W), jnp.float32),
+                        pltpu.VMEM((H, W), jnp.float32),
+                        pltpu.SemaphoreType.DMA(())],
+    )(v), x)
+
+    # b. + DMA into and out of the HBM scratch.
+    def k_b(in_ref, out_ref, hbm, sem):
+        cp = pltpu.make_async_copy(in_ref, hbm, sem)
+        cp.start()
+        cp.wait()
+        cp2 = pltpu.make_async_copy(hbm, out_ref, sem)
+        cp2.start()
+        cp2.wait()
+
+    run("b_hbm_roundtrip", lambda v: pl.pallas_call(
+        k_b, **ANY_IO,
+        scratch_shapes=[pltpu.MemorySpace.HBM((H, W), jnp.float32),
+                        pltpu.SemaphoreType.DMA(())],
+    )(v), x)
+
+    # c. + HBM→HBM copy between two regions of one scratch.
+    def k_c(in_ref, out_ref, hbm, sem):
+        cp = pltpu.make_async_copy(in_ref, hbm.at[0], sem)
+        cp.start()
+        cp.wait()
+        cp2 = pltpu.make_async_copy(hbm.at[0], hbm.at[1], sem)
+        cp2.start()
+        cp2.wait()
+        cp3 = pltpu.make_async_copy(hbm.at[1], out_ref, sem)
+        cp3.start()
+        cp3.wait()
+
+    run("c_hbm_internal_copy", lambda v: pl.pallas_call(
+        k_c, **ANY_IO,
+        scratch_shapes=[pltpu.MemorySpace.HBM((2, H, W), jnp.float32),
+                        pltpu.SemaphoreType.DMA(())],
+    )(v), x)
+
+    # d. + gridded pl.ds windowed DMA out of the scratch.  The refill
+    #    copy runs unconditionally in EVERY program (grid steps execute
+    #    sequentially on the core, so this is waste, not a race) — the
+    #    one-shot guard is probe e's delta, not this one's.
+    def make_k_win(guarded):
+        def k_win(in_ref, out_ref, hbm, win, sems, xsem):
+            i, j = pl.program_id(0), pl.program_id(1)
+
+            def refill():
+                cp = pltpu.make_async_copy(in_ref, hbm, xsem)
+                cp.start()
+                cp.wait()
+
+            if guarded:
+                pl.when(jnp.logical_and(i == 0, j == 0))(refill)
+            else:
+                refill()
+            cp = pltpu.make_async_copy(
+                hbm.at[pl.ds(i * TH, TH), pl.ds(j * TW, TW)], win, sems)
+            cp.start()
+            cp.wait()
+            out_ref[...] = win[...]
+        return k_win
+
+    GRID_IO = dict(
+        grid=(H // TH, W // TW),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((TH, TW), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((H, W), jnp.float32),
+    )
+    SCRATCH = [pltpu.MemorySpace.HBM((H, W), jnp.float32),
+               pltpu.VMEM((TH, TW), jnp.float32),
+               pltpu.SemaphoreType.DMA(()),
+               pltpu.SemaphoreType.DMA(())]
+
+    run("d_windowed_from_hbm", lambda v: pl.pallas_call(
+        make_k_win(False), **GRID_IO, scratch_shapes=SCRATCH)(v), x)
+
+    # e. + the @pl.when(step == 0) one-shot refill guard.
+    run("e_when_step0", lambda v: pl.pallas_call(
+        make_k_win(True), **GRID_IO, scratch_shapes=SCRATCH)(v), x)
+
+    # f. + the collective compiler params the real kernel passes.
+    run("f_collective_params", lambda v: pl.pallas_call(
+        make_k_win(True), **GRID_IO, scratch_shapes=SCRATCH,
+        compiler_params=pltpu.CompilerParams(collective_id=1,
+                                             has_side_effects=True),
+    )(v), x)
+
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
