@@ -38,16 +38,72 @@ def _add_perf_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--filter", default="blur3", dest="filter_name")
     p.add_argument("--mesh", default=None,
                    help="RxC grid, e.g. 2x4 (default: all devices)")
-    p.add_argument("--backend", default="shifted", choices=list(BACKENDS))
-    p.add_argument("--storage", default="f32", choices=list(STORAGES),
-                   help="iteration-carry dtype; narrower carries shrink "
-                        "HBM/ICI traffic and stay bit-exact for u8 images")
-    p.add_argument("--fuse", type=int, default=1, metavar="T",
-                   help="iterations per halo exchange (temporal fusion)")
+    p.add_argument("--backend", default=None, choices=list(BACKENDS),
+                   help="correlate implementation (default: shifted, the "
+                        "normative XLA path)")
+    p.add_argument("--storage", default=None, choices=list(STORAGES),
+                   help="iteration-carry dtype (default: f32); narrower "
+                        "carries shrink HBM/ICI traffic and stay "
+                        "bit-exact for u8 images")
+    p.add_argument("--fuse", type=int, default=None, metavar="T",
+                   help="iterations per halo exchange (temporal fusion; "
+                        "default 1)")
     p.add_argument("--tile", default=None, metavar="TH,TW",
                    help="Pallas kernel output-tile override, e.g. "
                         "1024,512 (default: per-kernel tuned value; "
                         "results are bit-identical for any tile)")
+    p.add_argument("--fast", action="store_true",
+                   help="on a TPU, fill any knob NOT explicitly passed "
+                        "with the measured flagship family "
+                        "(pallas_sep/bf16/fuse 32, BASELINE.md; fuse "
+                        "clamped to the per-device block).  Off-TPU the "
+                        "compiled XLA path is already the fast one, so "
+                        "unset knobs keep their normal defaults.  "
+                        "Explicit flags always win; output bits are "
+                        "identical either way.  The resolved knobs are "
+                        "printed — pass them explicitly when resuming a "
+                        "checkpoint on different hardware")
+
+
+def _resolve_perf_knobs(args, mesh) -> None:
+    """Fill backend/storage/fuse (argparse default None = not passed).
+
+    --fast on a TPU resolves unset knobs to the measured flagship family
+    (BASELINE.md: pallas_sep / bf16 / fuse 32, with fuse clamped so
+    blocks stay >= radius*fuse) and prints the resolution — checkpoint
+    resume keys on these values, so a resume on different hardware needs
+    them passed explicitly.  Explicit flags always win (None-sentinel,
+    not value comparison: an explicit `--fuse 1` stays unfused).  Off-TPU
+    the Pallas kernels run under the interpreter — far slower than
+    compiled XLA — so --fast leaves unset knobs at the normal defaults.
+    All combinations are bit-identical; knobs change speed, never bytes.
+
+    Must run after the platform is settled (on_tpu touches jax.devices,
+    which the bench path guards behind ensure_live_backend).
+    """
+    from parallel_convolution_tpu.utils.platform import on_tpu
+
+    if getattr(args, "fast", False) and on_tpu():
+        from parallel_convolution_tpu.ops.filters import get_filter
+        from parallel_convolution_tpu.parallel.mesh import grid_shape
+
+        if args.backend is None:
+            args.backend = "pallas_sep"
+        if args.storage is None:
+            args.storage = "bf16"
+        if args.fuse is None:
+            R, C = grid_shape(mesh)
+            block = min(-(-args.rows // R), -(-args.cols // C))
+            r = get_filter(args.filter_name).radius
+            args.fuse = max(1, min(32, block // max(1, r)))
+        print(f"# --fast resolved: backend={args.backend} "
+              f"storage={args.storage} fuse={args.fuse}", file=sys.stderr)
+    if args.backend is None:
+        args.backend = "shifted"
+    if args.storage is None:
+        args.storage = "f32"
+    if args.fuse is None:
+        args.fuse = 1
 
 
 def _mesh_from_flag(spec: str | None):
@@ -228,6 +284,7 @@ def main(argv: list[str] | None = None) -> int:
         note = ensure_live_backend()
         enable_compile_cache()
         mesh = _mesh_from_flag(args.mesh)
+        _resolve_perf_knobs(args, mesh)
         row = bench_lib.bench_iterate(
             (args.rows, args.cols), get_filter(args.filter_name),
             args.loops, mesh=mesh,
@@ -244,6 +301,7 @@ def main(argv: list[str] | None = None) -> int:
     from parallel_convolution_tpu.models import ConvolutionModel, JacobiSolver
 
     mesh = _mesh_from_flag(args.mesh)
+    _resolve_perf_knobs(args, mesh)
     if args.converge is not None:
         solver = JacobiSolver(
             filt=args.filter_name, tol=args.converge, max_iters=args.loops,

@@ -130,3 +130,47 @@ def test_medium_golden_canonical_aspect():
                              storage="bf16", fuse=5)
     got = model.run_image(img, 25)
     np.testing.assert_array_equal(got, want)
+
+
+def test_fast_preset_resolution(monkeypatch):
+    # --fast fills only UNSET knobs (argparse None-sentinel: an explicit
+    # `--fuse 1` stays unfused), only on a TPU (off-TPU the interpreter
+    # would make the "fast" preset the slow one), and clamps fuse to the
+    # per-device block so small images never trip the fuse>=block error.
+    import argparse
+
+    import jax
+
+    from parallel_convolution_tpu import cli
+    from parallel_convolution_tpu.parallel import mesh as mesh_lib
+    from parallel_convolution_tpu.utils import platform as plat
+
+    m = mesh_lib.make_grid_mesh(jax.devices()[:4], (2, 2))
+
+    def ns(**kw):
+        base = dict(fast=True, backend=None, storage=None, fuse=None,
+                    rows=1920, cols=2520, filter_name="blur3")
+        base.update(kw)
+        return argparse.Namespace(**base)
+
+    monkeypatch.setattr(plat, "on_tpu", lambda: True)
+    a = ns()
+    cli._resolve_perf_knobs(a, m)
+    assert (a.backend, a.storage, a.fuse) == ("pallas_sep", "bf16", 32)
+
+    a = ns(backend="pallas", fuse=1)  # explicit flags always win
+    cli._resolve_perf_knobs(a, m)
+    assert (a.backend, a.storage, a.fuse) == ("pallas", "bf16", 1)
+
+    a = ns(rows=40, cols=40)  # 20x20 blocks: fuse clamps to the block
+    cli._resolve_perf_knobs(a, m)
+    assert a.fuse == 20
+
+    a = ns(fast=False)
+    cli._resolve_perf_knobs(a, m)
+    assert (a.backend, a.storage, a.fuse) == ("shifted", "f32", 1)
+
+    monkeypatch.setattr(plat, "on_tpu", lambda: False)
+    a = ns()
+    cli._resolve_perf_knobs(a, m)  # off-TPU: normal defaults
+    assert (a.backend, a.storage, a.fuse) == ("shifted", "f32", 1)
