@@ -68,6 +68,9 @@ def main() -> int:
         # traffic of f32; exact by construction in quantize mode.
         ("pallas_sep", "u8", 16, shape),
         ("pallas_sep", "u8", 32, shape),
+        # Round-4 experiment: unmasked-interior launch split (bit-identical
+        # by construction; a default only if this row beats the flagship).
+        ("pallas_sep+isplit", "bf16", 32, shape),
         # RDMA tier at a tiled-kernel-sized block: degenerate (no remote
         # partner) on a 1x1 mesh, but every driver round re-proves the
         # kernel + barrier compile and run on real silicon (fuse=1 by
@@ -75,9 +78,21 @@ def main() -> int:
         ("pallas_rdma", "f32", 1,
          (min(shape[0], 2048), min(shape[1], 2048))),
     ]
+    from parallel_convolution_tpu.parallel.mesh import grid_shape
+
     candidates = {}
     for backend, storage, fuse, cshape in configs:
         name = f"{backend}/{storage}/fuse{fuse}"
+        isplit = backend.endswith("+isplit")
+        if isplit:
+            backend = backend[: -len("+isplit")]
+            if grid_shape(mesh) != (1, 1):
+                # On a multi-device grid the split is a forced no-op; the
+                # row would re-measure the flagship config under a
+                # different name and let noise decide the "experiment".
+                print(f"# {name} skipped: interior split needs a 1x1 grid",
+                      file=sys.stderr)
+                continue
         if cshape != shape:
             # Off-default shape must be visible in the candidate name so
             # wall_s values across rows can't be misread as comparable.
@@ -86,6 +101,7 @@ def main() -> int:
             row = bench.bench_iterate(
                 cshape, filt, iters, mesh=mesh, backend=backend,
                 storage=storage, fuse=fuse, reps=reps,
+                interior_split=isplit,
             )
             candidates[name] = row
             print(f"# {name}: {row}", file=sys.stderr)

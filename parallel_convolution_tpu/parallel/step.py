@@ -69,7 +69,8 @@ def _valid_mask(valid_hw, block_hw, margin: int = 0):
 def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
                      backend: str, fuse: int = 1, boundary: str = "zero",
                      tile: tuple[int, int] | None = None,
-                     interpret: bool | None = None):
+                     interpret: bool | None = None,
+                     interior_split: bool = False):
     """``fuse`` iterations on a local block per halo exchange.
 
     fuse=1 is the reference's loop shape: exchange 1-deep halos, stencil,
@@ -143,6 +144,9 @@ def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
                 p, off, filt, fuse, None if periodic else tuple(valid_hw),
                 quantize=quantize, out_dtype=v.dtype, separable=sep,
                 tile=tile, interpret=interpret,
+                # Static (0,0) offsets hold exactly on the 1x1 grid — the
+                # only topology where per-tile interior-ness is static.
+                interior_split=interior_split and grid == (1, 1),
             )
         for t in range(fuse):
             margin = depth - r * (t + 1)
@@ -179,7 +183,8 @@ def _check_block_size(filt: Filter, block_hw) -> None:
 def _build_iterate(mesh: Mesh, filt: Filter, iters: int, quantize: bool,
                    valid_hw, block_hw, backend: str, fuse: int = 1,
                    boundary: str = "zero",
-                   tile: tuple[int, int] | None = None):
+                   tile: tuple[int, int] | None = None,
+                   interior_split: bool = False):
     """Compile the fixed-count iteration runner for one (mesh, config)."""
     grid = grid_shape(mesh)
     _check_block_size(filt, block_hw)
@@ -190,10 +195,12 @@ def _build_iterate(mesh: Mesh, filt: Filter, iters: int, quantize: bool,
         )
     interp = _mesh_interpret(mesh)
     chunk = _make_block_step(filt, grid, valid_hw, block_hw, quantize,
-                             backend, fuse, boundary, tile, interp)
+                             backend, fuse, boundary, tile, interp,
+                             interior_split)
     n_chunks, rem = divmod(iters, fuse)
     tail = (_make_block_step(filt, grid, valid_hw, block_hw, quantize,
-                             backend, rem, boundary, tile, interp)
+                             backend, rem, boundary, tile, interp,
+                             interior_split)
             if rem else None)
 
     def body(block):
@@ -360,7 +367,8 @@ def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
                      valid_hw, quantize: bool = True,
                      backend: str = "shifted", fuse: int = 1,
                      boundary: str = "zero",
-                     tile: tuple[int, int] | None = None):
+                     tile: tuple[int, int] | None = None,
+                     interior_split: bool = False):
     """Iterate an already-sharded padded (C, Hp, Wp) array in place(-ish).
 
     The zero-copy entry for huge images loaded via utils.sharded_io: input
@@ -372,7 +380,8 @@ def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
     R, Cc = grid_shape(mesh)
     block_hw = (xs.shape[1] // R, xs.shape[2] // Cc)
     fn = _build_iterate(mesh, filt, iters, quantize, tuple(valid_hw),
-                        block_hw, backend, fuse, boundary, _norm_tile(tile))
+                        block_hw, backend, fuse, boundary, _norm_tile(tile),
+                        interior_split)
     return fn(xs)
 
 
@@ -380,7 +389,8 @@ def sharded_iterate(x, filt: Filter, iters: int, mesh: Mesh | None = None,
                     quantize: bool = True, backend: str = "shifted",
                     storage: str = "f32", fuse: int = 1,
                     boundary: str = "zero",
-                    tile: tuple[int, int] | None = None):
+                    tile: tuple[int, int] | None = None,
+                    interior_split: bool = False):
     """Run ``iters`` stencil iterations of a global (C, H, W) f32 image
     sharded over the 2D mesh.  Returns the global (C, H, W) f32 result
     (bit-identical to the serial oracle for any mesh shape).
@@ -404,7 +414,8 @@ def sharded_iterate(x, filt: Filter, iters: int, mesh: Mesh | None = None,
     xs, valid_hw, block_hw = _prepare(x, mesh, filt.radius, storage)
     out = iterate_prepared(xs, filt, iters, mesh, valid_hw,
                            quantize=quantize, backend=backend, fuse=fuse,
-                           boundary=boundary, tile=tile)
+                           boundary=boundary, tile=tile,
+                           interior_split=interior_split)
     return out[:, : valid_hw[0], : valid_hw[1]].astype(jnp.float32)
 
 

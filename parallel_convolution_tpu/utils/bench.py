@@ -119,13 +119,15 @@ def bench_iterate(
     fuse: int = 1,
     reps: int = 3,
     tile: tuple[int, int] | None = None,
+    interior_split: bool = False,
 ) -> dict:
     """Gpixels/sec/chip for the standard fixed-iteration workload.
 
     ``tile`` overrides the Pallas output-tile shape (None = per-kernel
     default) — passed explicitly because it is a static jit argument;
     monkeypatching the module defaults does NOT reach already-traced
-    kernels."""
+    kernels.  ``interior_split`` benches the unmasked-interior launch
+    split (1x1 grids, fused Pallas backends only)."""
     if mesh is None:
         mesh = make_grid_mesh()
     reps = max(1, reps)  # reps=0 would leave the slope path's median empty
@@ -140,7 +142,8 @@ def bench_iterate(
     # real pipeline gets.
     xs, valid_hw, block_hw = step_lib._prepare(x, mesh, filt.radius, storage)
     fn = step_lib._build_iterate(mesh, filt, iters, quantize, valid_hw,
-                                 block_hw, backend, fuse, tile=tile)
+                                 block_hw, backend, fuse, tile=tile,
+                                 interior_split=interior_split)
     out = fence(fn(xs))  # compile + warmup
 
     # The fence itself can cost a large constant on tunnel platforms

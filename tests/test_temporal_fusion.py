@@ -159,3 +159,73 @@ def test_fused_pallas_multi_tile():
         pallas_stencil.DEFAULT_TILE = old
     got = np.asarray(out)[:, :48, :300].astype(np.uint8)
     np.testing.assert_array_equal(got[0], want)
+
+
+def test_interior_range_geometry():
+    # The split's static tile classification, directly.
+    from parallel_convolution_tpu.ops.pallas_stencil import _interior_range
+
+    # 45x300, tile 8x128, depth 3: rows [1,4] of 6, col [1,1] of 3.
+    assert _interior_range((45, 300), (8, 128), 3, (6, 3)) == ((1, 4), (1, 1))
+    # Too narrow for any interior column -> no split.
+    assert _interior_range((45, 150), (8, 128), 3, (6, 2)) is None
+    # Depth deeper than one tile row: i_lo rounds up past row 1.
+    assert _interior_range((64, 300), (8, 128), 10, (8, 3)) == ((2, 5), (1, 1))
+
+
+@pytest.mark.parametrize("hw,tile", [
+    ((45, 300), (8, 128)),    # interior = rows [1,4] x col [1,1]
+    ((45, 150), (8, 128)),    # no interior column -> fallback single call
+    ((64, 520), (16, 128)),   # dividing height, 2 interior cols
+])
+def test_interior_split_bitexact(hw, tile):
+    # Unmasked-interior launch split vs the single masked call: identical
+    # bytes whether the geometry yields several, one, or zero interior
+    # tiles (the zero case must silently fall back).
+    img = imageio.generate_test_image(hw[0], hw[1], "grey", seed=19)
+    filt = filters.get_filter("blur3")
+    x = imageio.interleaved_to_planar(img).astype(np.float32)
+    m = _mesh((1, 1))
+    base = step.sharded_iterate(x, filt, 6, mesh=m, quantize=True,
+                                backend="pallas_sep", fuse=3, tile=tile)
+    split = step.sharded_iterate(x, filt, 6, mesh=m, quantize=True,
+                                 backend="pallas_sep", fuse=3, tile=tile,
+                                 interior_split=True)
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(split))
+    want = oracle.run_serial_u8(img, filt, 6)
+    got = imageio.planar_to_interleaved(np.asarray(split).astype(np.uint8))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_interior_split_rgb_radius2_u8():
+    # radius-2 filter (deeper rings), RGB, u8 carries, non-dividing shape
+    # wide enough that the split is genuinely active.
+    from parallel_convolution_tpu.ops.pallas_stencil import _interior_range
+
+    img = imageio.generate_test_image(45, 300, "rgb", seed=21)
+    filt = filters.get_filter("gaussian5")
+    assert _interior_range((45, 300), (8, 128), 2 * 2, (6, 3)) is not None
+    x = imageio.interleaved_to_planar(img).astype(np.float32)
+    m = _mesh((1, 1))
+    out = step.sharded_iterate(x, filt, 4, mesh=m, quantize=True,
+                               backend="pallas", storage="u8", fuse=2,
+                               tile=(8, 128), interior_split=True)
+    want = oracle.run_serial_u8(img, filt, 4)
+    got = imageio.planar_to_interleaved(np.asarray(out).astype(np.uint8))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_interior_split_noop_on_multichip_and_fuse1(grey_odd):
+    # The split only applies to fused Pallas launches on a 1x1 grid; on a
+    # 2x2 mesh (dynamic offsets) or fuse=1 the flag must be a silent no-op
+    # with identical results.
+    filt = filters.get_filter("blur3")
+    x = imageio.interleaved_to_planar(grey_odd).astype(np.float32)
+    for mesh_shape, fuse in (((2, 2), 3), ((1, 1), 1)):
+        m = _mesh(mesh_shape)
+        a = step.sharded_iterate(x, filt, 3, mesh=m, quantize=True,
+                                 backend="pallas_sep", fuse=fuse)
+        b = step.sharded_iterate(x, filt, 3, mesh=m, quantize=True,
+                                 backend="pallas_sep", fuse=fuse,
+                                 interior_split=True)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
