@@ -25,13 +25,16 @@ def main() -> int:
     ap.add_argument("--platform", default=None)
     args = ap.parse_args()
 
+    from parallel_convolution_tpu.utils.platform import apply_platform_env
+
+    apply_platform_env()  # site hook's pin beats JAX_PLATFORMS otherwise
+
     import jax
 
     if args.platform:
-        try:
-            jax.config.update("jax_platforms", args.platform)
-        except Exception:
-            pass
+        from parallel_convolution_tpu.utils.platform import force_platform
+
+        force_platform(args.platform, warn=True)
 
     import numpy as np
 

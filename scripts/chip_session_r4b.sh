@@ -32,6 +32,9 @@ run_to evidence/tune_convex_r4.jsonl \
 run_to evidence/tune_convex_r4_u8.jsonl \
   python scripts/tune_pallas.py --backend pallas_sep --storage u8 \
     --iters 100 --tiles 1024x512,2048x512 --fuses 32,40
+run_to evidence/tune_isplit_r4.jsonl \
+  python scripts/tune_pallas.py --backend pallas_sep --storage bf16 \
+    --iters 100 --tiles 1024x512,512x512 --fuses 32 --isplit
 run_to evidence/rdma_silicon.json python scripts/rdma_on_silicon.py
 run_to evidence/tiled_repro.jsonl python scripts/tiled_repro_probe.py
 run_to evidence/validate_walls.json python scripts/validate_walls.py

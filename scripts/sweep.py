@@ -31,13 +31,16 @@ def main() -> int:
                     help="force jax platform (e.g. cpu)")
     args = ap.parse_args()
 
+    from parallel_convolution_tpu.utils.platform import apply_platform_env
+
+    apply_platform_env()  # site hook's pin beats JAX_PLATFORMS otherwise
+
     import jax
 
     if args.platform:
-        try:
-            jax.config.update("jax_platforms", args.platform)
-        except Exception:
-            pass
+        from parallel_convolution_tpu.utils.platform import force_platform
+
+        force_platform(args.platform, warn=True)
 
     from parallel_convolution_tpu.ops.filters import get_filter
     from parallel_convolution_tpu.parallel.mesh import dims_create, make_grid_mesh

@@ -28,7 +28,14 @@ def main() -> int:
                     help="comma list of HxW tiles, e.g. 1024x512,128x512")
     ap.add_argument("--fuses", default=None,
                     help="comma list of fusion depths, e.g. 16,32,64")
+    ap.add_argument("--isplit", action="store_true",
+                    help="bench the unmasked-interior launch split "
+                         "(1x1 grid only; rows carry isplit:true)")
     args = ap.parse_args()
+
+    from parallel_convolution_tpu.utils.platform import apply_platform_env
+
+    apply_platform_env()  # site hook's pin beats JAX_PLATFORMS otherwise
 
     import jax
     import numpy as np
@@ -50,6 +57,15 @@ def main() -> int:
     fuses = (1, 2, 4, 8, 16)
     if args.fuses:
         fuses = tuple(int(v) for v in args.fuses.split(","))
+    if args.isplit:
+        # The split only exists on the fused (fuse > 1) kernel path; a
+        # fuse=1 row stamped isplit:true would record a fabricated no-op
+        # "measurement" in the evidence file.
+        dropped = [f for f in fuses if f <= 1]
+        fuses = tuple(f for f in fuses if f > 1)
+        if dropped:
+            print(f"# --isplit: dropped fuse{dropped} (split needs fuse>1)",
+                  file=sys.stderr)
     for tile in tiles:
         for fuse in fuses:
             # tile is threaded through as an explicit static jit argument —
@@ -60,8 +76,11 @@ def main() -> int:
                 row = bench.bench_iterate(
                     (H, W), filt, args.iters, mesh=mesh, backend=args.backend,
                     storage=args.storage, fuse=fuse, reps=2, tile=tile,
+                    interior_split=args.isplit,
                 )
                 row.update(tile=f"{tile[0]}x{tile[1]}")
+                if args.isplit:
+                    row.update(isplit=True)
                 results.append(row)
                 print(json.dumps(row), flush=True)
             except Exception as e:
