@@ -220,7 +220,8 @@ def _build_iterate(mesh: Mesh, filt: Filter, iters: int, quantize: bool,
 def _build_converge(mesh: Mesh, filt: Filter, tol: float, max_iters: int,
                     check_every: int, quantize: bool, valid_hw, block_hw,
                     backend: str, boundary: str = "zero", fuse: int = 1,
-                    tile: tuple[int, int] | None = None):
+                    tile: tuple[int, int] | None = None,
+                    interior_split: bool = False):
     """Compile the run-to-convergence runner (C6: every-N diff + allreduce).
 
     ``fuse``/``tile`` are the flagship iteration knobs (temporal fusion,
@@ -251,7 +252,8 @@ def _build_converge(mesh: Mesh, filt: Filter, tol: float, max_iters: int,
     step = _make_block_step(filt, grid, valid_hw, block_hw, quantize, backend,
                             boundary=boundary, tile=tile, interpret=interp)
     fused = (_make_block_step(filt, grid, valid_hw, block_hw, quantize,
-                              backend, fuse, boundary, tile, interp)
+                              backend, fuse, boundary, tile, interp,
+                              interior_split)
              if fuse > 1 else None)
 
     def body(block):
@@ -423,7 +425,8 @@ def sharded_converge(x, filt: Filter, tol: float, max_iters: int,
                      check_every: int = 1, mesh: Mesh | None = None,
                      quantize: bool = False, backend: str = "shifted",
                      storage: str = "f32", boundary: str = "zero",
-                     fuse: int = 1, tile: tuple[int, int] | None = None):
+                     fuse: int = 1, tile: tuple[int, int] | None = None,
+                     interior_split: bool = False):
     """Run-to-convergence (BASELINE config 5).  Returns (result, iters_run).
 
     ``fuse``/``tile`` mirror :func:`sharded_iterate`: fused chunks run
@@ -436,6 +439,7 @@ def sharded_converge(x, filt: Filter, tol: float, max_iters: int,
     xs, valid_hw, block_hw = _prepare(x, mesh, filt.radius, storage)
     fn = _build_converge(mesh, filt, float(tol), int(max_iters),
                          int(check_every), quantize, valid_hw, block_hw,
-                         backend, boundary, int(fuse), _norm_tile(tile))
+                         backend, boundary, int(fuse), _norm_tile(tile),
+                         interior_split)
     out, done = fn(xs)
     return out[:, : valid_hw[0], : valid_hw[1]].astype(jnp.float32), int(done)

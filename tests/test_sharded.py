@@ -207,3 +207,18 @@ def test_mesh_interpret_resolves_from_mesh_devices():
         devices = np.asarray([[FakeTpuDevice()]])
 
     assert step._mesh_interpret(FakeMesh()) is False
+
+
+def test_converge_interior_split_bitexact():
+    # The convergence path's fused chunks accept the interior split too;
+    # iterate count and bytes must match the unsplit run exactly.
+    img = imageio.generate_test_image(45, 300, "grey", seed=23)
+    x = imageio.interleaved_to_planar(img).astype(np.float32)
+    filt = filters.get_filter("jacobi3")
+    m = mesh_lib.make_grid_mesh(jax.devices()[:1], (1, 1))
+    kw = dict(tol=0.05, max_iters=40, check_every=5, mesh=m,
+              backend="pallas_sep", fuse=3, tile=(8, 128))
+    out_a, it_a = step.sharded_converge(x, filt, **kw)
+    out_b, it_b = step.sharded_converge(x, filt, interior_split=True, **kw)
+    assert it_a == it_b
+    np.testing.assert_array_equal(np.asarray(out_a), np.asarray(out_b))
