@@ -52,6 +52,11 @@ def _add_perf_args(p: argparse.ArgumentParser) -> None:
                    help="Pallas kernel output-tile override, e.g. "
                         "1024,512 (default: per-kernel tuned value; "
                         "results are bit-identical for any tile)")
+    p.add_argument("--interior-split", action="store_true",
+                   dest="interior_split",
+                   help="unmasked-interior launch split for fused Pallas "
+                        "backends on a 1x1 grid (bit-identical; opt-in "
+                        "experiment, silently a no-op elsewhere)")
     p.add_argument("--fast", action="store_true",
                    help="on a TPU, fill any knob NOT explicitly passed "
                         "with the measured flagship family "
@@ -289,6 +294,7 @@ def main(argv: list[str] | None = None) -> int:
             (args.rows, args.cols), get_filter(args.filter_name),
             args.loops, mesh=mesh,
             channels=3 if args.mode == "rgb" else 1,
+            interior_split=args.interior_split,
             backend=args.backend, storage=args.storage, fuse=args.fuse,
             reps=args.reps, tile=tile,
         )
@@ -308,6 +314,7 @@ def main(argv: list[str] | None = None) -> int:
             check_every=args.check_every, mesh=mesh, backend=args.backend,
             quantize=True, fuse=args.fuse, tile=tile,
             boundary=args.boundary, storage=args.storage,
+            interior_split=args.interior_split,
         )
         img = imageio.read_raw(args.image, args.rows, args.cols, args.mode)
         x = imageio.interleaved_to_planar(img).astype(np.float32)
@@ -323,7 +330,8 @@ def main(argv: list[str] | None = None) -> int:
     model = ConvolutionModel(filt=args.filter_name, mesh=mesh,
                              backend=args.backend, storage=args.storage,
                              fuse=args.fuse, boundary=args.boundary,
-                             tile=tile)
+                             tile=tile,
+                             interior_split=args.interior_split)
     if args.checkpoint:
         from parallel_convolution_tpu.parallel import step as step_lib
         from parallel_convolution_tpu.utils import checkpoint, sharded_io
@@ -334,7 +342,7 @@ def main(argv: list[str] | None = None) -> int:
             xs, model.filt, args.loops, mesh, (args.rows, args.cols),
             ckpt_dir=args.checkpoint, every=args.checkpoint_every,
             backend=args.backend, fuse=args.fuse, boundary=args.boundary,
-            tile=tile,
+            tile=tile, interior_split=args.interior_split,
         )
         sharded_io.save_sharded(args.output, out, args.rows, args.cols,
                                 args.mode)

@@ -38,6 +38,8 @@ class JacobiSolver:
     storage: str = "f32"  # iteration-carry dtype (see sharded_converge)
     fuse: int = 1  # fused iterations between convergence checks
     tile: tuple[int, int] | None = None  # Pallas kernel tile override
+    interior_split: bool = False  # unmasked-interior launch split (see
+    #                ConvolutionModel; fused chunks only)
 
     def __post_init__(self) -> None:
         if isinstance(self.filt, str):
@@ -53,5 +55,6 @@ class JacobiSolver:
             quantize=self.quantize, backend=self.backend,
             boundary=self.boundary, storage=self.storage,
             fuse=self.fuse, tile=self.tile,
+            interior_split=self.interior_split,
         )
         return np.asarray(out), iters

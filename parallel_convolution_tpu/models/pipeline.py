@@ -47,6 +47,8 @@ class ConvolutionModel:
     boundary: str = "zero"  # 'periodic' = torus wrap (ring topology)
     tile: tuple[int, int] | None = None  # Pallas kernel output-tile (TH, TW)
     #                override; None = per-kernel tuned default
+    interior_split: bool = False  # unmasked-interior launch split (fused
+    #                Pallas on a 1x1 grid; bit-identical, opt-in experiment)
 
     def __post_init__(self) -> None:
         if isinstance(self.filt, str):
@@ -62,7 +64,7 @@ class ConvolutionModel:
             x, self.filt, iters, mesh=self.mesh,
             quantize=self.quantize, backend=self.backend,
             storage=self.storage, fuse=self.fuse, boundary=self.boundary,
-            tile=self.tile,
+            tile=self.tile, interior_split=self.interior_split,
         )
 
     def run_image(self, img: np.ndarray, iters: int) -> np.ndarray:
@@ -117,5 +119,6 @@ class ConvolutionModel:
             xs, self.filt, iters, self.mesh, (rows, cols),
             quantize=self.quantize, backend=self.backend,
             fuse=self.fuse, boundary=self.boundary, tile=self.tile,
+            interior_split=self.interior_split,
         )
         sharded_io.save_sharded(dst, out, rows, cols, mode)

@@ -130,6 +130,7 @@ def run_checkpointed(
     fuse: int = 1,
     boundary: str = "zero",
     tile: tuple[int, int] | None = None,
+    interior_split: bool = False,
 ) -> jax.Array:
     """Iterate with a snapshot every ``every`` iterations; auto-resume.
 
@@ -164,14 +165,15 @@ def run_checkpointed(
 
     while done < total_iters:
         chunk = min(every, total_iters - done)
-        # tile is a pure perf knob (bit-identical for any value in every
-        # mode), so it is deliberately NOT part of the resume-compatibility
-        # config above.  fuse IS kept there: it is only bit-identical under
-        # quantize=True — in float mode with a narrow storage dtype the
-        # fused kernel keeps f32 intermediates the unfused path would have
-        # rounded through storage every iteration.
+        # tile and interior_split are pure perf knobs (bit-identical for
+        # any value in every mode), so they are deliberately NOT part of
+        # the resume-compatibility config above.  fuse IS kept there: it
+        # is only bit-identical under quantize=True — in float mode with a
+        # narrow storage dtype the fused kernel keeps f32 intermediates
+        # the unfused path would have rounded through storage every
+        # iteration.
         xs = step_lib.iterate_prepared(
-            xs, filt, chunk, mesh, valid_hw,
+            xs, filt, chunk, mesh, valid_hw, interior_split=interior_split,
             quantize=quantize, backend=backend, fuse=min(fuse, chunk),
             boundary=boundary, tile=tile,
         )
