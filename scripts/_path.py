@@ -1,7 +1,21 @@
 """Make the repo root importable when a script runs as `python scripts/x.py`
-(sys.path[0] is then scripts/, not the repo root)."""
+(sys.path[0] is then scripts/, not the repo root) — and honor a
+``JAX_PLATFORMS`` env pin before any backend can initialize.
+
+The second job matters because the site hook pins the tunnel platform
+programmatically, which beats the env var: a script pinned to CPU would
+otherwise still initialize the tunnel backend and HANG whenever the
+tunnel is dead.  Doing it here makes every script hang-proof by
+construction instead of each one remembering to call the shim (this is a
+no-op — importing nothing — when JAX_PLATFORMS is unset).
+"""
 
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("JAX_PLATFORMS"):
+    from parallel_convolution_tpu.utils.platform import apply_platform_env
+
+    apply_platform_env()

@@ -31,10 +31,6 @@ def main() -> int:
                     help="force jax platform (e.g. cpu)")
     args = ap.parse_args()
 
-    from parallel_convolution_tpu.utils.platform import apply_platform_env
-
-    apply_platform_env()  # site hook's pin beats JAX_PLATFORMS otherwise
-
     import jax
 
     if args.platform:

@@ -33,10 +33,6 @@ def main() -> int:
                          "(1x1 grid only; rows carry isplit:true)")
     args = ap.parse_args()
 
-    from parallel_convolution_tpu.utils.platform import apply_platform_env
-
-    apply_platform_env()  # site hook's pin beats JAX_PLATFORMS otherwise
-
     import jax
     import numpy as np
 
