@@ -78,21 +78,14 @@ def main() -> int:
         ("pallas_rdma", "f32", 1,
          (min(shape[0], 2048), min(shape[1], 2048))),
     ]
-    from parallel_convolution_tpu.parallel.mesh import grid_shape
-
     candidates = {}
     for backend, storage, fuse, cshape in configs:
         name = f"{backend}/{storage}/fuse{fuse}"
         isplit = backend.endswith("+isplit")
         if isplit:
+            # Round 5: the split dispatches per-device edge-class launches,
+            # so the row is meaningful on ANY grid (1x1 included).
             backend = backend[: -len("+isplit")]
-            if grid_shape(mesh) != (1, 1):
-                # On a multi-device grid the split is a forced no-op; the
-                # row would re-measure the flagship config under a
-                # different name and let noise decide the "experiment".
-                print(f"# {name} skipped: interior split needs a 1x1 grid",
-                      file=sys.stderr)
-                continue
         if cshape != shape:
             # Off-default shape must be visible in the candidate name so
             # wall_s values across rows can't be misread as comparable.

@@ -55,8 +55,9 @@ def _add_perf_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--interior-split", action="store_true",
                    dest="interior_split",
                    help="unmasked-interior launch split for fused Pallas "
-                        "backends on a 1x1 grid (bit-identical; opt-in "
-                        "experiment, silently a no-op elsewhere)")
+                        "backends: per-device edge-class launches skip "
+                        "ghost-ring masking on provably-interior tiles "
+                        "(bit-identical; no-op for fuse=1 and periodic)")
     p.add_argument("--fast", action="store_true",
                    help="on a TPU, fill any knob NOT explicitly passed "
                         "with the measured flagship family "

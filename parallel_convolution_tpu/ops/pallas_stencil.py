@@ -290,25 +290,65 @@ def correlate_shifted_pallas(x: jnp.ndarray, filt: Filter, **kw) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 
-def _interior_range(valid_hw, tile_hw, depth, grid_hw):
-    """Inclusive (i, j) grid ranges whose level-0 windows sit fully inside
-    the image, for a block at global offset (0, 0) spanning the image.
+def _norm_block_off(block_off):
+    """Normalize a static block offset to ((r0_lo, r0_hi), (c0_lo, c0_hi)).
 
-    Tile (i, j) covers image rows [i*th - depth, i*th + th + depth); it is
-    interior iff that range lies in [0, H) (ditto columns).  Returns None
-    when no tile qualifies (then the split is pointless).
+    Accepts the exact-offset shorthand ``(r0, c0)`` or the range form — the
+    range form exists because one SPMD program can serve a *class* of
+    device positions (e.g. every non-edge row of a grid), whose offsets
+    share interior geometry without being a single static value.
+    """
+    r, c = block_off
+    r = (int(r), int(r)) if not hasattr(r, "__len__") else (int(r[0]), int(r[1]))
+    c = (int(c), int(c)) if not hasattr(c, "__len__") else (int(c[0]), int(c[1]))
+    return r, c
+
+
+def _interior_range(valid_hw, tile_hw, depth, grid_hw, block_off=(0, 0)):
+    """Inclusive (i, j) grid ranges whose level-0 windows sit fully inside
+    the image, for a block at static global offset ``block_off``.
+
+    Tile (i, j) of a block at offset (r0, c0) covers image rows
+    [r0 + i*th - depth, r0 + i*th + th + depth); it is interior iff that
+    range lies in [0, H) (ditto columns).  ``block_off`` components may be
+    (lo, hi) ranges — the bounds are then conservative over every offset
+    in the range (lo decides the low edge, hi the high edge), so one
+    result serves a whole class of device positions.  Returns None when no
+    tile qualifies (then the split is pointless).
     """
     H, W = valid_hw
     th, tw = tile_hw
     gh, gw = grid_hw
-    i_lo = -(-depth // th)                 # smallest i with i*th >= depth
-    i_hi = (H - th - depth) // th          # largest i with end <= H
-    j_lo = -(-depth // tw)
-    j_hi = (W - tw - depth) // tw
+    (r0l, r0h), (c0l, c0h) = _norm_block_off(block_off)
+    i_lo = max(0, -(-(depth - r0l) // th))   # smallest i: r0 + i*th >= depth
+    i_hi = (H - r0h - th - depth) // th      # largest i: end <= H
+    j_lo = max(0, -(-(depth - c0l) // tw))
+    j_hi = (W - c0h - tw - depth) // tw
     i_hi, j_hi = min(i_hi, gh - 1), min(j_hi, gw - 1)
     if i_lo > i_hi or j_lo > j_hi:
         return None
     return (i_lo, i_hi), (j_lo, j_hi)
+
+
+def axis_offset_classes(n_dev: int, block: int):
+    """Static block-offset classes along one grid axis, as (lo, hi) ranges.
+
+    Under shard_map a device's block offset ``a * block`` is dynamic, but
+    its *interior geometry* only depends on which image edges the block
+    can touch — so devices collapse into at most three static classes per
+    axis: first row (offset exactly 0), last row (exactly (n-1)*block),
+    and the middle band (offsets in [block, (n-2)*block], which
+    ``_interior_range`` treats conservatively).  The caller dispatches on
+    the dynamic axis index (``step._axis_class_index``) to the per-class
+    specialized launch; this is what makes the unmasked-interior split
+    reachable on any grid, not just 1×1.
+    """
+    if n_dev == 1:
+        return [(0, 0)]
+    if n_dev == 2:
+        return [(0, 0), (block, block)]
+    last = (n_dev - 1) * block
+    return [(0, 0), (block, last - block), (last, last)]
 
 
 def _fused_kernel(off_ref, hbm_ref, out_ref, scratch, sems, *,
@@ -383,7 +423,8 @@ def _fused_kernel(off_ref, hbm_ref, out_ref, scratch, sems, *,
 @functools.partial(
     jax.jit,
     static_argnames=("filt", "T", "valid_hw", "tile", "interpret",
-                     "quantize", "out_dtype", "separable", "interior_split"),
+                     "quantize", "out_dtype", "separable", "interior_split",
+                     "block_off"),
 )
 def fused_iterate_pallas(
     padded: jnp.ndarray,
@@ -397,6 +438,7 @@ def fused_iterate_pallas(
     out_dtype=None,
     separable: bool = False,
     interior_split: bool = False,
+    block_off: tuple | None = None,
 ) -> jnp.ndarray:
     """T stencil iterations of a deep-padded (C, h+2rT, w+2rT) block.
 
@@ -406,14 +448,21 @@ def fused_iterate_pallas(
     Bit-exact with T applications of the one-step kernel (same op order,
     intermediates at full f32 in VMEM).
 
-    ``interior_split=True`` (caller contract: the block's offsets are
-    STATICALLY (0, 0) and the block spans the whole image — i.e. a 1×1
-    grid) splits the launch into an UNMASKED interior call plus masked
-    border-strip calls: tiles whose level-0 window provably sits inside
-    the image skip the per-level ghost-ring multiplies (~2 of ~9 VPU
-    ops/px/level) and the level-0 select.  Bit-identical by construction
-    (the masks it skips are the identity there); measured on its own
-    bench row before ever becoming a default.
+    ``interior_split=True`` splits the launch into an UNMASKED interior
+    call plus masked border-strip calls: tiles whose level-0 window
+    provably sits inside the image skip the per-level ghost-ring
+    multiplies (~2 of ~9 VPU ops/px/level) and the level-0 select.
+    It requires ``block_off`` — the STATIC global offset of this block,
+    either exact ``(r0, c0)`` or per-component ``(lo, hi)`` ranges
+    covering every offset one SPMD program may see (see
+    ``axis_offset_classes``); the runtime ``offsets`` array must lie
+    within it.  Raises ValueError if ``block_off`` is missing, so a
+    caller on a sharded layout cannot silently skip ghost-ring masking
+    with offsets the classification never saw.  The masked border calls
+    keep using the dynamic ``offsets``, so offset *ranges* are exact, not
+    approximate.  Bit-identical by construction (the masks it skips are
+    the identity there); measured on its own bench row before ever
+    becoming a default.
     """
     if interpret is None:
         interpret = not on_tpu()
@@ -469,7 +518,15 @@ def fused_iterate_pallas(
 
     split = None
     if interior_split and valid_hw is not None:
-        split = _interior_range(valid_hw, (th, tw), r * T, (gh, gw))
+        if block_off is None:
+            raise ValueError(
+                "interior_split requires a static block_off — the global "
+                "(row0, col0) of this block, exact or as (lo, hi) ranges; "
+                "without it the unmasked-interior classification cannot be "
+                "sound for arbitrary runtime offsets"
+            )
+        split = _interior_range(valid_hw, (th, tw), r * T, (gh, gw),
+                                block_off)
     if split is None:
         return call((gh, gw), (0, 0), True)[:, :h, :w]
 

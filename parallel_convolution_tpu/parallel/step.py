@@ -66,6 +66,16 @@ def _valid_mask(valid_hw, block_hw, margin: int = 0):
     return ok[None].astype(jnp.float32)
 
 
+def _axis_class_index(a, n: int):
+    """Dynamic index of device ``a``'s offset class along an ``n``-device
+    axis, matching ``pallas_stencil.axis_offset_classes`` order."""
+    if n == 1:
+        return jnp.int32(0)
+    if n == 2:
+        return a.astype(jnp.int32)
+    return jnp.where(a == 0, 0, jnp.where(a == n - 1, 2, 1)).astype(jnp.int32)
+
+
 def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
                      backend: str, fuse: int = 1, boundary: str = "zero",
                      tile: tuple[int, int] | None = None,
@@ -140,14 +150,38 @@ def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
                 lax.axis_index("x") * block_hw[0],
                 lax.axis_index("y") * block_hw[1],
             ]).astype(jnp.int32)
-            return pallas_stencil.fused_iterate_pallas(
-                p, off, filt, fuse, None if periodic else tuple(valid_hw),
-                quantize=quantize, out_dtype=v.dtype, separable=sep,
-                tile=tile, interpret=interpret,
-                # Static (0,0) offsets hold exactly on the 1x1 grid — the
-                # only topology where per-tile interior-ness is static.
-                interior_split=interior_split and grid == (1, 1),
-            )
+
+            def fused(p, off, block_off):
+                return pallas_stencil.fused_iterate_pallas(
+                    p, off, filt, fuse,
+                    None if periodic else tuple(valid_hw),
+                    quantize=quantize, out_dtype=v.dtype, separable=sep,
+                    tile=tile, interpret=interpret,
+                    interior_split=block_off is not None,
+                    block_off=block_off,
+                )
+
+            if not interior_split or periodic:
+                return fused(p, off, None)
+            # Interior split on any grid: a device's offset is dynamic
+            # under SPMD, but its interior geometry depends only on which
+            # image edges its block can touch — at most 3 static offset
+            # classes per axis (pallas_stencil.axis_offset_classes).
+            # One lax.switch per chunk picks this device's specialized
+            # launch; the masked border calls inside each branch still use
+            # the dynamic `off`, so class offset *ranges* stay exact.
+            rcls = pallas_stencil.axis_offset_classes(grid[0], block_hw[0])
+            ccls = pallas_stencil.axis_offset_classes(grid[1], block_hw[1])
+            if len(rcls) == 1 and len(ccls) == 1:
+                return fused(p, off, (rcls[0], ccls[0]))
+            branches = [
+                (lambda bo: lambda pp, oo: fused(pp, oo, bo))((rr, cc))
+                for rr in rcls for cc in ccls
+            ]
+            idx = (_axis_class_index(lax.axis_index("x"), grid[0])
+                   * len(ccls)
+                   + _axis_class_index(lax.axis_index("y"), grid[1]))
+            return lax.switch(idx, branches, p, off)
         for t in range(fuse):
             margin = depth - r * (t + 1)
             p = correlate_level(p, v.dtype)
@@ -334,6 +368,40 @@ def _correlate_padded_xla(padded: jnp.ndarray, filt: Filter) -> jnp.ndarray:
     return out[:, 0]
 
 
+# Module-scope so jit's function-identity cache holds: a per-call lambda
+# would retrace + recompile the reducer on every contract check.
+_minmax_f32 = jax.jit(
+    lambda a: jnp.stack([jnp.min(a), jnp.max(a)]).astype(jnp.float32))
+
+
+def _check_quantize_contract(xs, filt: Filter, quantize: bool) -> None:
+    """Fail loudly on out-of-contract quantize-mode inputs (ADVICE r4).
+
+    ``quantize=True`` assumes u8-range pixel values (a decoded image,
+    SURVEY §2 C1 semantics).  Convex filters elide the provably-idle
+    store-back clamp, so a float plane with values outside [0, 255] would
+    propagate UNCLAMPED where pre-elision code clamped it on the first
+    store-back — silently different bytes.  One min/max reduce over the
+    input per run (negligible vs the iterations) turns that into an error.
+    Traced callers skip the check: the contract stays documented but is
+    unverifiable mid-trace.
+    """
+    if not (quantize and filt.convex) or jnp.dtype(xs.dtype) == jnp.uint8:
+        return
+    if isinstance(xs, jax.core.Tracer):
+        return
+    # One fused device reduction + one 2-float readback (separate min/max
+    # dispatches would each stream the whole array from HBM).
+    lo, hi = (float(v) for v in _minmax_f32(xs))
+    if lo < 0.0 or hi > 255.0:
+        raise ValueError(
+            f"quantize=True input has values in [{lo}, {hi}], outside the "
+            "u8 contract [0, 255]: convex filters elide the store-back "
+            "clamp, so out-of-range values would propagate unclamped. "
+            "Clamp the input (or use quantize=False for float planes)."
+        )
+
+
 def _check_storage(storage: str, quantize: bool) -> None:
     if storage == "u8" and not quantize:
         raise ValueError(
@@ -370,15 +438,24 @@ def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
                      backend: str = "shifted", fuse: int = 1,
                      boundary: str = "zero",
                      tile: tuple[int, int] | None = None,
-                     interior_split: bool = False):
+                     interior_split: bool = False,
+                     check_contract: bool = True):
     """Iterate an already-sharded padded (C, Hp, Wp) array in place(-ish).
 
     The zero-copy entry for huge images loaded via utils.sharded_io: input
     stays in its blocked sharding, output keeps the padded extent (pass it
     straight to ``save_sharded``).  The input array is donated.
+
+    ``check_contract=False`` skips the quantize-range input check (one
+    full-array reduction) — for loop callers like
+    ``utils.checkpoint.run_checkpointed`` that validated the initial state
+    once and whose chunk inputs are in contract by induction (quantized
+    outputs are always in [0, 255]).
     """
     if jnp.dtype(xs.dtype) == jnp.uint8 and not quantize:
         _check_storage("u8", quantize)  # public entry: same guard as above
+    if check_contract:
+        _check_quantize_contract(xs, filt, quantize)
     R, Cc = grid_shape(mesh)
     block_hw = (xs.shape[1] // R, xs.shape[2] // Cc)
     fn = _build_iterate(mesh, filt, iters, quantize, tuple(valid_hw),
@@ -406,9 +483,10 @@ def sharded_iterate(x, filt: Filter, iters: int, mesh: Mesh | None = None,
 
     ``quantize=True`` is the u8 store-back semantics and assumes pixel
     values in [0, 255] (a decoded u8 image): convex filters elide the
-    provably-idle clamp (``Filter.convex``), so a float plane fed in with
-    out-of-range values is out of contract — it propagates unclamped
-    where pre-round-4 code clamped it on the first store-back.
+    provably-idle clamp (``Filter.convex``), so a float plane with
+    out-of-range values is out of contract — it raises ValueError up
+    front (``_check_quantize_contract``) rather than silently producing
+    different bytes than the pre-elision code.
     """
     if mesh is None:
         mesh = make_grid_mesh()
@@ -437,6 +515,7 @@ def sharded_converge(x, filt: Filter, tol: float, max_iters: int,
         mesh = make_grid_mesh()
     _check_storage(storage, quantize)
     xs, valid_hw, block_hw = _prepare(x, mesh, filt.radius, storage)
+    _check_quantize_contract(xs, filt, quantize)
     fn = _build_converge(mesh, filt, float(tol), int(max_iters),
                          int(check_every), quantize, valid_hw, block_hw,
                          backend, boundary, int(fuse), _norm_tile(tile),

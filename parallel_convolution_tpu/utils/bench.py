@@ -220,11 +220,23 @@ def bench_halo_p50(
     r: int = 1,
     mesh=None,
     trials: int = 20,
+    chain_rounds: int | None = None,
 ) -> dict:
-    """p50 latency of one compiled two-phase halo exchange over the mesh.
+    """p50 amortized latency of one compiled halo exchange over the mesh.
 
     ``block_shape`` is the per-device block (the reference's per-rank tile);
     latency is what bounds small-block scaling (SURVEY.md §3.2).
+
+    DEFINITION (round 5, one procedure for every consumer): each trial
+    times ONE jitted span of ``chain_rounds`` on-device chained exchanges
+    and divides by the count; the row's p50/p90 are over trials.  A single
+    fenced round — the pre-round-5 procedure on standard backends — is
+    dominated by per-dispatch host scheduling noise (the CPU-mesh proxy's
+    p50 swung 1.4 → 16 ms, 10×, across otherwise identical driver runs);
+    amortizing over 256 rounds measures the steady-state per-exchange
+    cost, which is what the fuse=T collective saving is priced against.
+    On lying-fence tunnel platforms the slope scheme below (4096-round
+    chains minus a 1-round span) additionally cancels the fence constant.
     """
     if mesh is None:
         mesh = make_grid_mesh()
@@ -267,16 +279,30 @@ def bench_halo_p50(
     # bench_iterate).  Slopes are clamped at 0: a negative slope is pure
     # jitter, and falling back to the fenced wall would report the tunnel,
     # not the halo.
-    k = 4096 if _needs_readback_fence() else 1
-    fn1, fnk = rounds(1), rounds(k)
-    fence(fn1(x)), fence(fnk(x))  # compile
+    lying_fence = _needs_readback_fence()
+    k = chain_rounds or (4096 if lying_fence else 256)
+    if lying_fence:
+        k = max(2, k)  # the slope below divides by k - 1
+    fnk = rounds(k)
+    fence(fnk(x))  # compile
     times = []
     clamped = 0
-    for _ in range(trials):
-        t0 = time.perf_counter()
-        fence(fn1(x))
-        t1 = time.perf_counter() - t0
-        if k > 1:
+    if not lying_fence:
+        # Amortized per-round cost: one fenced span of k on-device rounds
+        # per trial.  Dispatch + fence cost appears once per k rounds
+        # (<1% for k=256), so trial-to-trial spread reflects the exchange,
+        # not the host scheduler.
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            fence(fnk(x))
+            times.append((time.perf_counter() - t0) / k)
+    else:
+        fn1 = rounds(1)
+        fence(fn1(x))  # compile
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            fence(fn1(x))
+            t1 = time.perf_counter() - t0
             t0 = time.perf_counter()
             fence(fnk(x))
             tk = time.perf_counter() - t0
@@ -288,8 +314,6 @@ def bench_halo_p50(
                 clamped += 1
                 slope = 0.0
             times.append(slope)
-        else:
-            times.append(t1)
     times.sort()
     p50 = 1e6 * times[len(times) // 2]
     p90 = 1e6 * times[int(len(times) * 0.9)]
@@ -299,7 +323,8 @@ def bench_halo_p50(
         "p50_us": round(p50, 1),
         "p90_us": round(p90, 1),
         "trials": trials,
-        "timing": timing_mode(),
+        "rounds_per_trial": k,
+        "timing": timing_mode() if lying_fence else f"amortized-{k}",
     }
     if clamped:
         row["clamped_trials"] = clamped

@@ -41,11 +41,11 @@ def main() -> int:
         return 1
     mesh = make_grid_mesh(devs)
 
-    def one(block, r, trials=60):
-        # 60 trials (vs the 20 default): this CPU proxy rides host
-        # scheduling noise — its p50 swung 16.0 → 10.7 ms between
-        # identical-code rounds at 20 trials (BENCH_r02 vs r03); a
-        # deeper median pins the medians.
+    def one(block, r, trials=12):
+        # Each trial is already an amortized 256-round span (round-5
+        # bench_halo_p50 definition), so a dozen trials replace the old
+        # 60-deep median over single dispatches whose p50 swung 10×
+        # (1.4 → 16 ms) across identical-code driver runs.
         row = bench.bench_halo_p50(block, r=r, mesh=mesh, trials=trials)
         row["proxy"] = "cpu-mesh"
         row["devices"] = len(devs)
@@ -54,9 +54,11 @@ def main() -> int:
     if "--sweep" in sys.argv:
         # Scaling record: latency vs per-device block size and radius
         # (the reference's small-block latency-bound regime, SURVEY §3.2).
+        # >= 11 trials so p90 (times[int(n*0.9)]) is a percentile, not the
+        # max sample wearing a percentile's name.
         for block, r in (((64, 64), 1), ((256, 256), 1), ((512, 512), 1),
                          ((1024, 1024), 1), ((512, 512), 2)):
-            print(json.dumps(one(block, r, trials=40)), flush=True)
+            print(json.dumps(one(block, r)), flush=True)
         return 0
     print(json.dumps(one((512, 512), 1)))
     return 0

@@ -17,8 +17,13 @@ timeout 60 python -c "import jax; print(jax.devices())"   || { echo "tunnel dead
 
 run_to() {
   out="$1"; shift
-  "$@" > "$out.tmp" 2> "/tmp/$(basename "$out").err" \
-    && mv "$out.tmp" "$out" && echo "$out OK"
+  if "$@" > "$out.tmp" 2> "/tmp/$(basename "$out").err"; then
+    mv "$out.tmp" "$out" && echo "$out OK"
+  else
+    # Never leave a stale .tmp in evidence/ — it reads like a record.
+    rm -f "$out.tmp"
+    echo "$out FAILED (stderr: /tmp/$(basename "$out").err)" >&2
+  fi
 }
 
 run_to evidence/validate_walls.json python scripts/validate_walls.py

@@ -66,13 +66,19 @@ def load_basis() -> dict:
     try:
         with open(path) as f:
             for line in f:
-                row = json.loads(line)
-                pref = row.get("config", " ")[:2]
-                if pref in basis:
-                    rate = row.get("gpixels_per_s_per_chip",
-                                   row.get("iters_per_s"))
-                    if rate:
-                        basis[pref] = (row["workload"], float(rate))
+                # Per-line guard: one malformed/blank row (or a matching
+                # row missing "workload") must not kill the tool — skip it
+                # and let FALLBACK_BASIS cover that config.
+                try:
+                    row = json.loads(line)
+                    pref = row.get("config", " ")[:2]
+                    if pref in basis:
+                        rate = row.get("gpixels_per_s_per_chip",
+                                       row.get("iters_per_s"))
+                        if rate:
+                            basis[pref] = (row["workload"], float(rate))
+                except (ValueError, KeyError, TypeError):
+                    continue
     except OSError:
         pass
     return basis

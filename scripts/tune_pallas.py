@@ -30,7 +30,7 @@ def main() -> int:
                     help="comma list of fusion depths, e.g. 16,32,64")
     ap.add_argument("--isplit", action="store_true",
                     help="bench the unmasked-interior launch split "
-                         "(1x1 grid only; rows carry isplit:true)")
+                         "(any grid; rows carry isplit:true)")
     args = ap.parse_args()
 
     import jax

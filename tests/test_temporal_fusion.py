@@ -215,17 +215,92 @@ def test_interior_split_rgb_radius2_u8():
     np.testing.assert_array_equal(got, want)
 
 
-def test_interior_split_noop_on_multichip_and_fuse1(grey_odd):
-    # The split only applies to fused Pallas launches on a 1x1 grid; on a
-    # 2x2 mesh (dynamic offsets) or fuse=1 the flag must be a silent no-op
-    # with identical results.
+def test_interior_split_noop_on_fuse1(grey_odd):
+    # The split only exists on the fused (fuse > 1) Pallas kernel path;
+    # with fuse=1 the flag must be a silent no-op with identical results.
     filt = filters.get_filter("blur3")
     x = imageio.interleaved_to_planar(grey_odd).astype(np.float32)
-    for mesh_shape, fuse in (((2, 2), 3), ((1, 1), 1)):
-        m = _mesh(mesh_shape)
-        a = step.sharded_iterate(x, filt, 3, mesh=m, quantize=True,
-                                 backend="pallas_sep", fuse=fuse)
-        b = step.sharded_iterate(x, filt, 3, mesh=m, quantize=True,
-                                 backend="pallas_sep", fuse=fuse,
-                                 interior_split=True)
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    m = _mesh((1, 1))
+    a = step.sharded_iterate(x, filt, 3, mesh=m, quantize=True,
+                             backend="pallas_sep", fuse=1)
+    b = step.sharded_iterate(x, filt, 3, mesh=m, quantize=True,
+                             backend="pallas_sep", fuse=1,
+                             interior_split=True)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_interior_range_offset_classes():
+    # The per-axis offset classes and the offset-range interior ranges
+    # that make the split sound on multi-device grids.
+    from parallel_convolution_tpu.ops.pallas_stencil import (
+        _interior_range, axis_offset_classes)
+
+    assert axis_offset_classes(1, 64) == [(0, 0)]
+    assert axis_offset_classes(2, 64) == [(0, 0), (64, 64)]
+    assert axis_offset_classes(4, 64) == [(0, 0), (64, 128), (192, 192)]
+    # Image (128, 512) on a 2x2 device grid -> blocks (64, 256), kernel
+    # tiles (16, 128) -> per-block tile grid (4, 2), depth 4.
+    # Top-left block (offset (0, 0)): tile row 0 / col 0 cross the image's
+    # top/left edge; the bottom/right tiles see neighbor data via the halo,
+    # so they are interior w.r.t. the IMAGE.
+    assert _interior_range((128, 512), (16, 128), 4, (4, 2),
+                           ((0, 0), (0, 0))) == ((1, 3), (1, 1))
+    # Bottom-right block (offset (64, 256)): the far tiles cross H/W.
+    assert _interior_range((128, 512), (16, 128), 4, (4, 2),
+                           ((64, 64), (256, 256))) == ((0, 2), (0, 0))
+    # Middle-band row range (offsets 64..128 of a 4-high grid over 256
+    # rows): conservative over the whole band -> every tile row interior.
+    assert _interior_range((256, 512), (16, 128), 4, (4, 4),
+                           ((64, 128), (0, 0))) == ((0, 3), (1, 2))
+
+
+@pytest.mark.parametrize("mshape", [(2, 2), (2, 4), (4, 2)])
+def test_interior_split_multichip_bitexact(mshape):
+    # The generalized split on real multi-device grids: every device
+    # dispatches to its edge-class launch, masked borders keep dynamic
+    # offsets, and the bytes match both the unsplit run and the oracle.
+    # 90x300 is non-divisible by every grid here (pad-rim devices too).
+    img = imageio.generate_test_image(90, 300, "grey", seed=23)
+    filt = filters.get_filter("blur3")
+    x = imageio.interleaved_to_planar(img).astype(np.float32)
+    m = _mesh(mshape)
+    kw = dict(quantize=True, backend="pallas_sep", fuse=3, tile=(8, 128))
+    base = step.sharded_iterate(x, filt, 6, mesh=m, **kw)
+    split = step.sharded_iterate(x, filt, 6, mesh=m, interior_split=True,
+                                 **kw)
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(split))
+    want = oracle.run_serial_u8(img, filt, 6)
+    got = imageio.planar_to_interleaved(np.asarray(split).astype(np.uint8))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_interior_split_multichip_bf16_radius2():
+    # Deep rings (radius-2, fuse=2 -> depth 4) + bf16 carries on a 2x2
+    # grid; bit-exact vs the unsplit fused path and the oracle.
+    img = imageio.generate_test_image(64, 300, "rgb", seed=29)
+    filt = filters.get_filter("gaussian5")
+    x = imageio.interleaved_to_planar(img).astype(np.float32)
+    m = _mesh((2, 2))
+    kw = dict(quantize=True, backend="pallas", fuse=2, tile=(8, 128),
+              storage="bf16")
+    base = step.sharded_iterate(x, filt, 4, mesh=m, **kw)
+    split = step.sharded_iterate(x, filt, 4, mesh=m, interior_split=True,
+                                 **kw)
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(split))
+    want = oracle.run_serial_u8(img, filt, 4)
+    got = imageio.planar_to_interleaved(np.asarray(split).astype(np.uint8))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_interior_split_requires_block_off():
+    # ADVICE r4: the unmasked-interior contract is now enforced — a direct
+    # caller on a sharded layout cannot silently skip ghost-ring masking.
+    from parallel_convolution_tpu.ops import pallas_stencil
+
+    filt = filters.get_filter("blur3")
+    import jax.numpy as jnp
+    p = jnp.zeros((1, 38, 140), jnp.float32)
+    with pytest.raises(ValueError, match="block_off"):
+        pallas_stencil.fused_iterate_pallas(
+            p, jnp.zeros((2,), jnp.int32), filt, 3, (32, 134),
+            tile=(8, 128), interior_split=True)

@@ -160,3 +160,22 @@ def test_u8_convex_saturated_image_stays_in_range():
                                    storage="u8", fuse=fuse)
         got = imageio.planar_to_interleaved(np.asarray(out).astype(np.uint8))
         np.testing.assert_array_equal(got, want)
+
+
+def test_quantize_contract_out_of_range_raises():
+    # ADVICE r4: with a convex filter the store-back clamp is elided, so a
+    # float plane outside [0, 255] must be rejected up front instead of
+    # silently propagating unclamped.
+    filt = filters.get_filter("blur3")
+    x = np.full((1, 16, 24), 300.0, dtype=np.float32)
+    with pytest.raises(ValueError, match="outside the u8 contract"):
+        step.sharded_iterate(x, filt, 2, mesh=_mesh((2, 2)), quantize=True)
+    with pytest.raises(ValueError, match="outside the u8 contract"):
+        step.sharded_converge(x, filt, tol=0.5, max_iters=4, quantize=True,
+                              mesh=_mesh((2, 2)))
+    # Non-convex filters keep the live clamp -> unchanged behavior, no error.
+    sharp = filters.get_filter("sharpen3")
+    step.sharded_iterate(x, sharp, 1, mesh=_mesh((2, 2)), quantize=True)
+    # In-contract input through a convex filter: untouched fast path.
+    ok = np.full((1, 16, 24), 128.0, dtype=np.float32)
+    step.sharded_iterate(ok, filt, 1, mesh=_mesh((2, 2)), quantize=True)
