@@ -1,0 +1,88 @@
+#!/bin/sh
+# Round-5 chip session: everything still waiting on TPU silicon, ordered
+# by value so another tunnel outage costs the least.  Supersedes
+# chip_session_r4b.sh (same legs 1-5, plus the round-5 additions).
+#
+#   1. flagship tile/fuse re-tune with the convex-clamp elision (the
+#      headline number; +39% preliminary on pallas/f32/fuse1)
+#   2. profiler trace + interior-split A/B (VERDICT r4 item 5: confirm or
+#      correct the 1.47 TF/s VPU-ceiling claim, then one measured attempt
+#      past it — the generalized split is that attempt)
+#   3. u8-carry re-tune
+#   4. rdma_on_silicon + tiled_repro_probe (VERDICT item 2: attribute the
+#      tiled-kernel compile-helper crash to a construct)
+#   5. validate_walls rerun (lost to the round-4 file-swap accident)
+#   6. config-2 working-set-matched re-measure (VERDICT item 7: the
+#      266.4 Gpx/s/chip row is a cache-resident artifact; measure the
+#      same config at a working set matching the 8192^2 flagship)
+#   7. bench.py sanity (isplit row now valid on any grid)
+#
+set -x
+cd "$(dirname "$0")/.."
+
+# Dead-tunnel guard: a dead tunnel makes jax HANG on backend init, which
+# would eat the whole session window; fail fast instead.
+timeout 60 python -c "import jax; print(jax.devices())" \
+  || { echo "tunnel dead; aborting chip session" >&2; exit 1; }
+
+# Per-leg timeout: the tunnel dies transiently MID-session too, and a
+# dead tunnel makes the next leg's fresh python HANG in backend init —
+# the start-of-session guard above only protects the first process.
+LEG_TIMEOUT="${LEG_TIMEOUT:-2400}"
+
+run_to() {
+  out="$1"; shift
+  if timeout "$LEG_TIMEOUT" "$@" \
+       > "$out.tmp" 2> "/tmp/$(basename "$out").err"; then
+    mv "$out.tmp" "$out" && echo "$out OK"
+  else
+    # Never leave a stale .tmp in evidence/ — it reads like a record.
+    rm -f "$out.tmp"
+    echo "$out FAILED (stderr: /tmp/$(basename "$out").err)" >&2
+  fi
+}
+
+# 1. Flagship re-tune (bf16 carries, elision active since round 4).
+run_to evidence/tune_convex_r5.jsonl \
+  python scripts/tune_pallas.py --backend pallas_sep --storage bf16 \
+    --iters 100 --tiles 1024x512,1536x512,2048x512,1024x768 --fuses 24,32,40
+
+# 2. Trace + interior-split A/B at the flagship point.
+run_to evidence/profile_flagship_r5.jsonl \
+  python scripts/profile_flagship.py --size 8192 --fuse 32 --reps 3 --ab
+
+# 3. u8 carries.
+run_to evidence/tune_convex_r5_u8.jsonl \
+  python scripts/tune_pallas.py --backend pallas_sep --storage u8 \
+    --iters 100 --tiles 1024x512,2048x512 --fuses 32,40
+
+# 4. RDMA: monolithic re-proof + tiled-construct attribution ladder.
+run_to evidence/rdma_silicon_r5.json python scripts/rdma_on_silicon.py
+run_to evidence/tiled_repro_r5.jsonl python scripts/tiled_repro_probe.py
+
+# 5. Wall cross-validation rerun.
+run_to evidence/validate_walls_r5.json python scripts/validate_walls.py
+
+# 6. Config-2 at its true size vs a working-set-matched size (same
+#    backend/fuse): the gap quantifies the cache-residency inflation.
+run_to evidence/config2_matched_r5.jsonl python - <<'EOF'
+import json
+import jax
+from parallel_convolution_tpu.ops.filters import get_filter
+from parallel_convolution_tpu.parallel.mesh import make_grid_mesh
+from parallel_convolution_tpu.utils import bench
+mesh = make_grid_mesh(jax.devices()[:1], (1, 1))
+filt = get_filter("blur3")
+for shape, tag in (((1920, 2520), "config2-true-size"),
+                   ((7680, 7680), "config2-working-set-matched")):
+    row = bench.bench_iterate(shape, filt, 100, mesh=mesh, channels=3,
+                              backend="pallas_sep", storage="bf16",
+                              fuse=16, reps=3)
+    row["tag"] = tag
+    print(json.dumps(row), flush=True)
+EOF
+
+# 7. Driver-bench sanity.
+timeout "$LEG_TIMEOUT" python bench.py \
+    > /tmp/bench_r5_sanity.json 2> /tmp/bench_r5_sanity.err \
+  && tail -c 500 /tmp/bench_r5_sanity.json
