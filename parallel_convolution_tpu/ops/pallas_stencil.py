@@ -239,9 +239,7 @@ def correlate_padded_pallas(
     H, W = Hp - 2 * r, Wp - 2 * r
 
     sub = _sublane(padded.dtype)
-    th = min(_round_up(tile[0], sub), _round_up(H, sub))
-    tw = min(_round_up(tile[1], 128), _round_up(W, 128))
-    gh, gw = -(-H // th), -(-W // tw)
+    th, tw, gh, gw = fused_tile_grid((H, W), padded.dtype, tile, sep)
     # Tile-aligned DMA window: starts i*th / j*tw are aligned because
     # th % sub == 0 and tw % 128 == 0; extents rounded up from th+2r.
     ext_h, ext_w = th + _round_up(2 * r, sub), tw + _round_up(2 * r, 128)
@@ -328,6 +326,22 @@ def _interior_range(valid_hw, tile_hw, depth, grid_hw, block_off=(0, 0)):
     if i_lo > i_hi or j_lo > j_hi:
         return None
     return (i_lo, i_hi), (j_lo, j_hi)
+
+
+def fused_tile_grid(valid_hw, dtype, tile, sep=None):
+    """Static (th, tw, gh, gw) the fused launch uses for a block of valid
+    extent ``valid_hw``: the requested tile rounded to the dtype's
+    (sublane, 128) tiling and clamped to the block, and the resulting
+    tile-grid shape.  Shared between ``fused_iterate_pallas`` and the
+    geometry-prediction tooling (scripts/profile_flagship.py) so a
+    prediction can never drift from the real launch."""
+    h, w = valid_hw
+    if tile is None:
+        tile = _default_tile(sep)
+    sub = _sublane(dtype)
+    th = min(_round_up(tile[0], sub), _round_up(h, sub))
+    tw = min(_round_up(tile[1], 128), _round_up(w, 128))
+    return th, tw, -(-h // th), -(-w // tw)
 
 
 def axis_offset_classes(n_dev: int, block: int):
@@ -476,9 +490,7 @@ def fused_iterate_pallas(
     h, w = Hp - 2 * r * T, Wp - 2 * r * T
 
     sub = _sublane(padded.dtype)
-    th = min(_round_up(tile[0], sub), _round_up(h, sub))
-    tw = min(_round_up(tile[1], 128), _round_up(w, 128))
-    gh, gw = -(-h // th), -(-w // tw)
+    th, tw, gh, gw = fused_tile_grid((h, w), padded.dtype, tile, sep)
     ext_h = th + _round_up(2 * r * T, sub)
     ext_w = tw + _round_up(2 * r * T, 128)
     eh = (gh - 1) * th + ext_h - Hp

@@ -127,7 +127,7 @@ def bench_iterate(
     default) — passed explicitly because it is a static jit argument;
     monkeypatching the module defaults does NOT reach already-traced
     kernels.  ``interior_split`` benches the unmasked-interior launch
-    split (1x1 grids, fused Pallas backends only)."""
+    split (fused Pallas backends; any grid since round 5)."""
     if mesh is None:
         mesh = make_grid_mesh()
     reps = max(1, reps)  # reps=0 would leave the slope path's median empty

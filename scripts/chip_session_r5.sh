@@ -65,6 +65,9 @@ run_to evidence/validate_walls_r5.json python scripts/validate_walls.py
 
 # 6. Config-2 at its true size vs a working-set-matched size (same
 #    backend/fuse): the gap quantifies the cache-residency inflation.
+#    Matched means matched in BYTES to the 8192^2 grayscale bf16
+#    flagship (8192^2 x 2 B = 134 MB): config 2 is RGB, so
+#    4736^2 x 3ch x 2 B = 134.6 MB (4736 = 37 x 128, tile-friendly).
 run_to evidence/config2_matched_r5.jsonl python - <<'EOF'
 import json
 import jax
@@ -74,7 +77,7 @@ from parallel_convolution_tpu.utils import bench
 mesh = make_grid_mesh(jax.devices()[:1], (1, 1))
 filt = get_filter("blur3")
 for shape, tag in (((1920, 2520), "config2-true-size"),
-                   ((7680, 7680), "config2-working-set-matched")):
+                   ((4736, 4736), "config2-working-set-matched")):
     row = bench.bench_iterate(shape, filt, 100, mesh=mesh, channels=3,
                               backend="pallas_sep", storage="bf16",
                               fuse=16, reps=3)

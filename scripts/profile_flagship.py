@@ -19,8 +19,11 @@ slope wall), never confirmed by a device trace.  This script:
        (FMA = 1 op) → ``implied_vpu_gops`` compares against ~1 350,
 4. optionally (``--ab``) A/Bs the interior split, predicting its gain
    from the REAL tile geometry: interior_frac · (2 mask ops / 9), the
-   DESIGN.md "expected ≈ 0.66 · 2/9 ≈ 10% minus concat" formula — not a
-   100%-interior upper bound.
+   DESIGN.md formula (≈ 0.66 · 2/9 ≈ 15% ceiling at the flagship point,
+   before the ~2% concat cost) — not a 100%-interior upper bound.  The
+   tile geometry comes from ``pallas_stencil.fused_tile_grid`` — the
+   SAME helper the kernel launch uses — so the prediction cannot drift
+   from the real launch.
 
 Usage (chip session):
   python scripts/profile_flagship.py --size 8192 --fuse 32 --reps 3 --ab
@@ -80,8 +83,12 @@ def main() -> int:
         .integers(0, 256, size=(1, args.size, args.size))
         .astype(np.float32),
         mesh, filt.radius, args.storage)
+    # Keyword set matches bench_iterate's _build_iterate call exactly so
+    # the lru_cache key collides and the already-compiled runner is
+    # reused (a second 8192^2 Mosaic compile would waste tunnel minutes).
     fn = step_lib._build_iterate(mesh, filt, args.iters, True, valid_hw,
-                                 block_hw, args.backend, args.fuse, tile=tile)
+                                 block_hw, args.backend, args.fuse,
+                                 tile=tile, interior_split=False)
     out = bench.fence(fn(xs))  # compile + warm, outside the trace
     with device_trace(trace_dir):
         out = bench.fence(fn(out))
@@ -104,14 +111,12 @@ def main() -> int:
     if args.ab:
         # Predicted split gain from the REAL geometry: the masked 2 of
         # ops_px ops disappear on the interior fraction of tiles only.
+        # fused_tile_grid is the launch's own geometry helper.
         r, T = filt.radius, args.fuse
-        sub = pallas_stencil._sublane(
-            step_lib.STORAGE_DTYPES[args.storage])
-        th = min(pallas_stencil._round_up(tile[0], sub),
-                 pallas_stencil._round_up(args.size, sub))
-        tw = min(pallas_stencil._round_up(tile[1], 128),
-                 pallas_stencil._round_up(args.size, 128))
-        gh, gw = -(-args.size // th), -(-args.size // tw)
+        sep = pallas_stencil._sep_taps(filt, args.backend == "pallas_sep")
+        th, tw, gh, gw = pallas_stencil.fused_tile_grid(
+            (args.size, args.size), step_lib.STORAGE_DTYPES[args.storage],
+            tile, sep)
         split = pallas_stencil._interior_range(
             (args.size, args.size), (th, tw), r * T, (gh, gw))
         if split is None:

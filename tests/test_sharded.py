@@ -209,13 +209,16 @@ def test_mesh_interpret_resolves_from_mesh_devices():
     assert step._mesh_interpret(FakeMesh()) is False
 
 
-def test_converge_interior_split_bitexact():
-    # The convergence path's fused chunks accept the interior split too;
-    # iterate count and bytes must match the unsplit run exactly.
+@pytest.mark.parametrize("mshape", [(1, 1), (2, 2)])
+def test_converge_interior_split_bitexact(mshape):
+    # The convergence path's fused chunks accept the interior split too
+    # (any grid since round 5); iterate count and bytes must match the
+    # unsplit run exactly.
     img = imageio.generate_test_image(45, 300, "grey", seed=23)
     x = imageio.interleaved_to_planar(img).astype(np.float32)
     filt = filters.get_filter("jacobi3")
-    m = mesh_lib.make_grid_mesh(jax.devices()[:1], (1, 1))
+    m = mesh_lib.make_grid_mesh(
+        jax.devices()[: mshape[0] * mshape[1]], mshape)
     kw = dict(tol=0.05, max_iters=40, check_every=5, mesh=m,
               backend="pallas_sep", fuse=3, tile=(8, 128))
     out_a, it_a = step.sharded_converge(x, filt, **kw)
