@@ -215,6 +215,33 @@ def bench_iterate(
     }
 
 
+def halo_bench_rounds(mesh, grid, r: int, n: int, exchange: bool):
+    """The halo benchmark's chained round runner, at module scope so the
+    HLO regression test (`test_bench_halo_rounds_keep_collectives`)
+    compiles the SAME code `bench_halo_p50` times — not a private copy
+    that could drift while the real round regresses to an elided graph.
+
+    The exchange round carries forward the window STARTING at the ghost
+    corner — it consumes the ppermuted ghosts and rotates the data
+    across devices, which is what keeps the collective alive in the
+    compiled loop (see `bench_halo_p50`'s definition note).  The control
+    round moves the same bytes with a local roll and has no collective.
+    """
+
+    def body(v):
+        def one(_, b):
+            if exchange:
+                p = halo.halo_exchange(b, r, grid)
+                return p[:, : b.shape[1], : b.shape[2]]
+            return jnp.roll(b, (r, r), axis=(1, 2))
+
+        return jax.lax.fori_loop(0, n, one, v)
+
+    return jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=P(None, *AXES), out_specs=P(None, *AXES),
+    ))
+
+
 def bench_halo_p50(
     block_shape: tuple[int, int],
     r: int = 1,
@@ -228,15 +255,34 @@ def bench_halo_p50(
     latency is what bounds small-block scaling (SURVEY.md §3.2).
 
     DEFINITION (round 5, one procedure for every consumer): each trial
-    times ONE jitted span of ``chain_rounds`` on-device chained exchanges
-    and divides by the count; the row's p50/p90 are over trials.  A single
-    fenced round — the pre-round-5 procedure on standard backends — is
-    dominated by per-dispatch host scheduling noise (the CPU-mesh proxy's
-    p50 swung 1.4 → 16 ms, 10×, across otherwise identical driver runs);
-    amortizing over 256 rounds measures the steady-state per-exchange
-    cost, which is what the fuse=T collective saving is priced against.
-    On lying-fence tunnel platforms the slope scheme below (4096-round
-    chains minus a 1-round span) additionally cancels the fence constant.
+    times ONE jitted span of ``chain_rounds`` chained LIVE exchange
+    rounds and one span of equal-shape local-control rounds, and reports
+    their difference divided by the count; p50/p90 are over trials.
+
+    Two failure modes of earlier procedures, both caught this round:
+
+    * A single fenced round (pre-round-5) is dominated by per-dispatch
+      host noise — the proxy's p50 swung 1.4 → 16 ms, 10×, across
+      identical driver runs.
+    * Worse, a chained round built as ``slice(exchange(b))`` back to
+      ``b``'s own window is the IDENTITY: XLA cancels slice-of-concat
+      and emits ZERO collective-permutes (verified in HLO), so every
+      earlier proxy number — ms-scale and µs-scale alike — timed an
+      empty graph.  The fuse-delta cross-check
+      (``scripts/halo_cross_check.py``) exposed this: its derived
+      saving was 44× the "measured" cost.
+
+    The live round therefore consumes the ghosts: it carries forward the
+    (bh, bw) window that STARTS at the ghost corner, so the data rotates
+    across devices and neither slice-of-concat cancellation nor
+    loop-invariant hoisting can elide the ppermutes (asserted in HLO by
+    ``test_bench_halo_rounds_keep_collectives``).  The control round is
+    a local ``jnp.roll`` by the same shift — same consumer bytes, no
+    collective — so the differenced number isolates exchange cost (pad,
+    two-phase ppermute, stitch) from the consumer copy.
+    On lying-fence tunnel platforms each leg additionally uses the slope
+    scheme (k-round chain minus a 1-round span) to cancel the fence
+    constant.
     """
     if mesh is None:
         mesh = make_grid_mesh()
@@ -258,19 +304,8 @@ def bench_halo_p50(
         block_sharding(mesh),
     )
 
-    def rounds(n):
-        """n chained halo rounds on-device (pad → re-slice keeps shapes)."""
-
-        def body(v):
-            def one(_, b):
-                p = halo.halo_exchange(b, r, grid)
-                return p[:, r : r + b.shape[1], r : r + b.shape[2]]
-
-            return jax.lax.fori_loop(0, n, one, v)
-
-        return jax.jit(jax.shard_map(
-            body, mesh=mesh, in_specs=P(None, *AXES), out_specs=P(None, *AXES),
-        ))
+    def rounds(n, exchange):
+        return halo_bench_rounds(mesh, grid, r, n, exchange)
 
     # On tunnel platforms a single fenced call is dominated by the ~140 ms
     # (±40 ms jitter) device→host fence; a ~20 µs halo round is invisible
@@ -283,37 +318,42 @@ def bench_halo_p50(
     k = chain_rounds or (4096 if lying_fence else 256)
     if lying_fence:
         k = max(2, k)  # the slope below divides by k - 1
-    fnk = rounds(k)
-    fence(fnk(x))  # compile
+    fnx, fnc = rounds(k, True), rounds(k, False)
+    fence(fnx(x)), fence(fnc(x))  # compile
     times = []
     clamped = 0
+
+    def span(fn):
+        t0 = time.perf_counter()
+        fence(fn(x))
+        return time.perf_counter() - t0
+
     if not lying_fence:
-        # Amortized per-round cost: one fenced span of k on-device rounds
-        # per trial.  Dispatch + fence cost appears once per k rounds
-        # (<1% for k=256), so trial-to-trial spread reflects the exchange,
-        # not the host scheduler.
+        # Differenced amortized cost: per trial, one fenced span of k
+        # live-exchange rounds minus one span of k local-control rounds,
+        # over k.  Dispatch + fence cost cancels in the difference AND is
+        # amortized (<1% at k=256); pairing the legs inside one trial
+        # also cancels slow host-load drift.
         for _ in range(trials):
-            t0 = time.perf_counter()
-            fence(fnk(x))
-            times.append((time.perf_counter() - t0) / k)
+            d = (span(fnx) - span(fnc)) / k
+            if d <= 0:
+                clamped += 1  # noise swamped the exchange; never emit <0
+                d = 0.0
+            times.append(d)
     else:
-        fn1 = rounds(1)
-        fence(fn1(x))  # compile
+        fnx1, fnc1 = rounds(1, True), rounds(1, False)
+        fence(fnx1(x)), fence(fnc1(x))  # compile
         for _ in range(trials):
-            t0 = time.perf_counter()
-            fence(fn1(x))
-            t1 = time.perf_counter() - t0
-            t0 = time.perf_counter()
-            fence(fnk(x))
-            tk = time.perf_counter() - t0
-            slope = (tk - t1) / (k - 1)
-            if slope <= 0:
-                # Negative slope = fence jitter swamped 4096 chained
-                # rounds; count it instead of recording an impossible
-                # 0 µs latency as if it were a measurement.
+            slope_x = (span(fnx) - span(fnx1)) / (k - 1)
+            slope_c = (span(fnc) - span(fnc1)) / (k - 1)
+            d = slope_x - slope_c
+            if d <= 0:
+                # Negative = fence jitter swamped the chained rounds;
+                # count it instead of recording an impossible <= 0 µs
+                # latency as if it were a measurement.
                 clamped += 1
-                slope = 0.0
-            times.append(slope)
+                d = 0.0
+            times.append(d)
     times.sort()
     p50 = 1e6 * times[len(times) // 2]
     p90 = 1e6 * times[int(len(times) * 0.9)]
@@ -324,7 +364,8 @@ def bench_halo_p50(
         "p90_us": round(p90, 1),
         "trials": trials,
         "rounds_per_trial": k,
-        "timing": timing_mode() if lying_fence else f"amortized-{k}",
+        "timing": (timing_mode() if lying_fence
+                   else f"amortized-diff-{k}"),
     }
     if clamped:
         row["clamped_trials"] = clamped

@@ -42,10 +42,13 @@ def main() -> int:
     mesh = make_grid_mesh(devs)
 
     def one(block, r, trials=12):
-        # Each trial is already an amortized 256-round span (round-5
-        # bench_halo_p50 definition), so a dozen trials replace the old
-        # 60-deep median over single dispatches whose p50 swung 10×
-        # (1.4 → 16 ms) across identical-code driver runs.
+        # Each trial is already a DIFFERENCED amortized 256-round span —
+        # live ghost-consuming exchange rounds minus local-roll control
+        # rounds (final round-5 bench_halo_p50 definition; the first
+        # revision's un-differenced chained round was elided by XLA to
+        # zero collectives and is void) — so a dozen trials replace the
+        # old 60-deep median over single dispatches whose p50 swung 10×
+        # across identical-code driver runs.
         row = bench.bench_halo_p50(block, r=r, mesh=mesh, trials=trials)
         row["proxy"] = "cpu-mesh"
         row["devices"] = len(devs)

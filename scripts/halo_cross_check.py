@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Cross-validate the amortized halo-p50 metric against fuse wall deltas.
+
+Two independent procedures should agree on the order of the per-exchange
+cost (BASELINE.json "halo p50", round-5 definition):
+
+1. **Direct differenced measure** (`bench_halo_p50`): per trial, a
+   256-round chained LIVE exchange span (ghost-corner window carried
+   forward so nothing is elidable) minus a local-roll control span,
+   over 256 — what one exchange costs.
+2. **Derived from the fuse saving** (this script): the same workload run
+   with fuse=1 (N exchanges) and fuse=T (N/T deeper exchanges);
+   ``(wall_1 - wall_T) / (N - N/T)`` is the realized saving per skipped
+   exchange — what fuse=T actually buys.
+
+The derived number is a LOWER bound on the direct one: the fused run
+pays extra compute for the overlap rim and its surviving exchanges move
+T×-deeper slabs, both of which shrink the delta.  ``consistent`` is
+therefore strict: ``0 < derived <= 1.25 × direct`` (the 25% headroom is
+wall noise, nothing more) — a derived value meaningfully ABOVE the
+direct one falsifies a procedure.  It already did once: against the
+first round-5 revision of the metric (un-differenced chained rounds,
+which XLA cancelled to zero collective-permutes) this script read
+derived = 44× "direct", which is how the elision bug was caught.
+
+Runs anywhere with a multi-device mesh; on the 8-virtual-CPU mesh it is
+a mechanism cross-check (like the halo proxy itself), on a real pod it
+would be ICI.  Prints one JSON row.
+
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python scripts/halo_cross_check.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import _path  # noqa: F401  (repo root onto sys.path)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--block", type=int, default=512,
+                    help="per-device block edge (the halo-p50 workload)")
+    ap.add_argument("--iters", type=int, default=64)
+    ap.add_argument("--fuse", type=int, default=8)
+    ap.add_argument("--reps", type=int, default=5)
+    args = ap.parse_args()
+
+    import jax
+
+    from parallel_convolution_tpu.ops.filters import get_filter
+    from parallel_convolution_tpu.parallel.mesh import (
+        grid_shape, make_grid_mesh,
+    )
+    from parallel_convolution_tpu.utils import bench
+
+    mesh = make_grid_mesh(jax.devices())
+    grid = grid_shape(mesh)
+    if mesh.size < 2:
+        print(json.dumps({"error": "needs a multi-device mesh "
+                          "(1x1 has no exchange to price)"}))
+        return 1
+
+    filt = get_filter("blur3")
+    H = args.block * grid[0]
+    W = args.block * grid[1]
+    N, T = args.iters, args.fuse
+
+    def wall(fuse):
+        row = bench.bench_iterate((H, W), filt, N, mesh=mesh,
+                                  backend="shifted", storage="bf16",
+                                  fuse=fuse, reps=args.reps)
+        return row["wall_s"], row
+
+    w1, row1 = wall(1)
+    wT, rowT = wall(T)
+    skipped = N - N // T
+    derived_us = 1e6 * (w1 - wT) / skipped
+
+    direct = bench.bench_halo_p50((args.block, args.block), r=filt.radius,
+                                  mesh=mesh, trials=12)
+    p50 = direct.get("p50_us")
+    ratio = None if not p50 else round(derived_us / p50, 3)
+    row = {
+        "probe": "halo_cross_check",
+        "mesh": "x".join(str(s) for s in grid),
+        "block": f"{args.block}x{args.block}",
+        "iters": N,
+        "fuse": T,
+        "wall_fuse1_s": w1,
+        "wall_fuseT_s": wT,
+        "derived_saving_us_per_exchange": round(derived_us, 1),
+        "amortized_p50_us": p50,
+        "derived_over_direct": ratio,
+        "consistent": (None if ratio is None
+                       else bool(0.0 < ratio <= 1.25)),
+        "note": ("derived is a lower bound (rim recompute + deeper fused "
+                 "slabs shrink the delta; compute noise can push it below "
+                 "zero = inconsistent); consistent iff 0 < ratio <= 1.25"),
+    }
+    print(json.dumps(row), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
