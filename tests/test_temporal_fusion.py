@@ -304,3 +304,40 @@ def test_interior_split_requires_block_off():
         pallas_stencil.fused_iterate_pallas(
             p, jnp.zeros((2,), jnp.int32), filt, 3, (32, 134),
             tile=(8, 128), interior_split=True)
+
+
+def test_interior_split_geometry_fuzz():
+    # Seeded sweep: 8 grids x alternating radius x random fuse, block
+    # sizes, pad-rim shaves, and kernel tiles.  The class-based split
+    # must stay bit-identical to the unsplit fused run everywhere —
+    # including depth-vs-block edge cases, pad-rim devices, and
+    # geometries where some or all classes have no interior tiles.
+    # Guards the conservative middle-band box math beyond the
+    # hand-picked cases above.
+    rng = np.random.default_rng(1234)
+    filts = [filters.get_filter("blur3"), filters.get_filter("gaussian5")]
+    tiles = [(8, 128), (16, 128), (8, 256), (24, 128)]
+    for trial in range(8):
+        filt = filts[trial % 2]
+        r = filt.radius
+        grid = [(1, 2), (2, 1), (2, 2), (2, 4), (4, 2), (1, 4),
+                (4, 1), (2, 3)][trial]
+        tile = tiles[trial % 4]
+        fuse = int(rng.integers(2, 4))
+        depth = r * fuse
+        # Blocks must fit the fused halo; keep shapes small but awkward.
+        bh = depth + int(rng.integers(2, 40))
+        bw = depth + int(rng.integers(2, 170))
+        H = bh * grid[0] - int(rng.integers(0, min(bh - depth, 3) + 1))
+        W = bw * grid[1] - int(rng.integers(0, min(bw - depth, 3) + 1))
+        img = imageio.generate_test_image(H, W, "grey", seed=100 + trial)
+        x = imageio.interleaved_to_planar(img).astype(np.float32)
+        m = _mesh(grid)
+        kw = dict(quantize=True, backend="pallas", fuse=fuse, tile=tile)
+        base = step.sharded_iterate(x, filt, fuse * 2, mesh=m, **kw)
+        split = step.sharded_iterate(x, filt, fuse * 2, mesh=m,
+                                     interior_split=True, **kw)
+        np.testing.assert_array_equal(
+            np.asarray(base), np.asarray(split),
+            err_msg=f"trial {trial}: grid={grid} HxW={H}x{W} "
+                    f"filt={filt.name} fuse={fuse} tile={tile}")
