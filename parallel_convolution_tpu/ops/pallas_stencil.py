@@ -344,6 +344,29 @@ def fused_tile_grid(valid_hw, dtype, tile, sep=None):
     return th, tw, -(-h // th), -(-w // tw)
 
 
+def split_patches(split, grid_hw):
+    """The 9-patch launch plan for an interior box: a list of
+    ``((i0, i1), (j0, j1), (mask_rows, mask_cols))`` patches partitioning
+    the ``grid_hw`` tile grid (half-open band bounds), with per-patch
+    static mask axes — the middle×middle patch unmasked, pure-edge
+    patches keeping only the axis their tiles can cross, corners both.
+
+    Single source of truth shared by the ``fused_iterate_pallas`` launch
+    and the geometry-prediction tooling (scripts/profile_flagship.py), so
+    the op-ledger prediction cannot drift from the real launch (the
+    5-strip → 9-patch refinement did exactly that to the old inline
+    formula)."""
+    (i_lo, i_hi), (j_lo, j_hi) = split
+    gh, gw = grid_hw
+    row_bands = [(0, i_lo, True), (i_lo, i_hi + 1, False),
+                 (i_hi + 1, gh, True)]
+    col_bands = [(0, j_lo, True), (j_lo, j_hi + 1, False),
+                 (j_hi + 1, gw, True)]
+    return [((r0, r1), (c0, c1), (mr, mc))
+            for r0, r1, mr in row_bands if r1 > r0
+            for c0, c1, mc in col_bands if c1 > c0]
+
+
 def axis_offset_classes(n_dev: int, block: int):
     """Static block-offset classes along one grid axis, as (lo, hi) ranges.
 
@@ -367,7 +390,8 @@ def axis_offset_classes(n_dev: int, block: int):
 
 def _fused_kernel(off_ref, hbm_ref, out_ref, scratch, sems, *,
                   taps, sep, k, r, T, th, tw, ext_h, ext_w, valid_hw,
-                  quantize, convex, grid_off=(0, 0)):
+                  quantize, convex, grid_off=(0, 0),
+                  mask_rows=True, mask_cols=True):
     """T in-VMEM stencil levels on one (th + 2rT, tw + 2rT) window.
 
     The window shrinks by r per level; after each level, positions outside
@@ -375,6 +399,13 @@ def _fused_kernel(off_ref, hbm_ref, out_ref, scratch, sems, *,
     intermediate level) using the shard's global offset from SMEM.  One HBM
     read + one HBM write buy T iterations — the bandwidth analog of the
     fuse=T collective saving.
+
+    ``mask_rows`` / ``mask_cols`` statically drop one masking axis for
+    launches whose tiles provably cannot cross that pair of image edges
+    (the 9-patch interior split): a top-band middle tile needs only row
+    masking, a left-band middle only column masking.  Sound for the same
+    reason the fully-unmasked interior is: the skipped mask is the
+    identity there.
     """
     gi0, gj0 = grid_off
     i, j = pl.program_id(1) + gi0, pl.program_id(2) + gj0
@@ -395,41 +426,53 @@ def _fused_kernel(off_ref, hbm_ref, out_ref, scratch, sems, *,
     row0 = off_ref[0] - r * T + i * th
     col0 = off_ref[1] - r * T + j * tw
     cur = _to_f32(scratch[slot][: th + 2 * r * T, : tw + 2 * r * T])
-    if valid_hw is not None:
-        # Ghost-ring masking in two tiers (None = periodic torus: no ring):
+    mask_rows = mask_rows and valid_hw is not None
+    mask_cols = mask_cols and valid_hw is not None
+    if mask_rows or mask_cols:
+        # Ghost-ring masking in two tiers (no tier at all = periodic
+        # torus or a provably-interior launch):
         #
         # 1. ONE select on the level-0 window: out-of-image positions
         #    (halo beyond the image edge, pad rim) are forced to exactly 0,
         #    so any non-finite garbage the DMA may have carried dies here
         #    (a multiplicative mask alone would leak it: 0 * NaN = NaN).
+        #    Restricted to the statically-live axes: a skipped axis is
+        #    provably in-image, hence genuine finite data.
         # 2. Per level, the cheap rank-1 form: the out-of-image region of
         #    any level's window is a row band ⊗ column band, so re-zeroing
-        #    is two broadcast multiplies (~2 VPU ops/px).  Exact because
-        #    tier 1 guarantees every intermediate is finite.  Measured on
-        #    v5e: per-level 2D select instead cost ~20% throughput at
-        #    fuse=16 AND ~2× Mosaic compile time per doubling of T.
+        #    is one broadcast multiply per live axis (~1 VPU op/px each).
+        #    Exact because tier 1 guarantees every intermediate is finite.
+        #    Measured on v5e: per-level 2D select instead cost ~20%
+        #    throughput at fuse=16 AND ~2× Mosaic compile time per
+        #    doubling of T.
         #
         # Branching around the mask for interior tiles is NOT worth it:
         # one lax.cond per program measured 40% slower on Mosaic than
-        # unconditional masking (it stalls the DMA/compute pipeline).
+        # unconditional masking (it stalls the DMA/compute pipeline) —
+        # the launch split exists precisely to make this static.
         H, W = valid_hw
         w0h, w0w = th + 2 * r * T, tw + 2 * r * T
-        rows0 = row0 + jax.lax.broadcasted_iota(jnp.int32, (w0h, 1), 0)
-        cols0 = col0 + jax.lax.broadcasted_iota(jnp.int32, (1, w0w), 1)
-        ok0 = ((rows0 >= 0) & (rows0 < H)) & ((cols0 >= 0) & (cols0 < W))
+        ok0 = None
+        if mask_rows:
+            rows0 = row0 + jax.lax.broadcasted_iota(jnp.int32, (w0h, 1), 0)
+            ok0 = (rows0 >= 0) & (rows0 < H)
+        if mask_cols:
+            cols0 = col0 + jax.lax.broadcasted_iota(jnp.int32, (1, w0w), 1)
+            okc0 = (cols0 >= 0) & (cols0 < W)
+            ok0 = okc0 if ok0 is None else (ok0 & okc0)
         cur = jnp.where(ok0, cur, 0.0)
     for s in range(1, T + 1):
         ch, cw = th + 2 * r * (T - s), tw + 2 * r * (T - s)
         acc = _correlate_window(cur, taps, sep, k, ch, cw)
         if quantize:
             acc = _quantize_acc(acc, convex)
-        if valid_hw is not None:
-            # Level-s window starts r*s deeper; slice the hoisted iotas.
+        # Level-s window starts r*s deeper; slice the hoisted iotas.
+        if mask_rows:
             rows = rows0[r * s : r * s + ch, :]
+            acc = acc * ((rows >= 0) & (rows < H)).astype(jnp.float32)
+        if mask_cols:
             cols = cols0[:, r * s : r * s + cw]
-            okr = ((rows >= 0) & (rows < H)).astype(jnp.float32)
-            okc = ((cols >= 0) & (cols < W)).astype(jnp.float32)
-            acc = acc * okr * okc
+            acc = acc * ((cols >= 0) & (cols < W)).astype(jnp.float32)
         cur = acc
     out_ref[0] = _from_f32(cur, out_ref.dtype)
 
@@ -462,10 +505,13 @@ def fused_iterate_pallas(
     Bit-exact with T applications of the one-step kernel (same op order,
     intermediates at full f32 in VMEM).
 
-    ``interior_split=True`` splits the launch into an UNMASKED interior
-    call plus masked border-strip calls: tiles whose level-0 window
-    provably sits inside the image skip the per-level ghost-ring
-    multiplies (~2 of ~9 VPU ops/px/level) and the level-0 select.
+    ``interior_split=True`` splits the launch into a 9-patch: an UNMASKED
+    interior call, pure-edge band calls that statically keep only ONE
+    mask axis (a top-band middle tile can only cross the top edge, so
+    column masking is provably the identity there — rows-only; left/right
+    middles mirror it cols-only), and corner patches keeping both.
+    Interior tiles skip the per-level ghost-ring multiplies (~2 of ~9
+    VPU ops/px/level) and the level-0 select; pure-edge tiles skip one.
     It requires ``block_off`` — the STATIC global offset of this block,
     either exact ``(r0, c0)`` or per-component ``(lo, hi)`` ranges
     covering every offset one SPMD program may see (see
@@ -502,13 +548,15 @@ def fused_iterate_pallas(
     vma = getattr(jax.typeof(padded), "vma", frozenset())
     off32 = offsets.astype(jnp.int32)
 
-    def call(grid_hw, grid_off, masked):
+    def call(grid_hw, grid_off, mask_axes=(True, True)):
+        mr, mc = mask_axes
         kernel = functools.partial(
             _fused_kernel, taps=taps, sep=sep,
             k=k, r=r, T=T, th=th, tw=tw, ext_h=ext_h, ext_w=ext_w,
             valid_hw=(tuple(valid_hw)
-                      if masked and valid_hw is not None else None),
+                      if (mr or mc) and valid_hw is not None else None),
             quantize=quantize, convex=filt.convex, grid_off=grid_off,
+            mask_rows=mr, mask_cols=mc,
         )
         cgh, cgw = grid_hw
         return pl.pallas_call(
@@ -540,19 +588,22 @@ def fused_iterate_pallas(
         split = _interior_range(valid_hw, (th, tw), r * T, (gh, gw),
                                 block_off)
     if split is None:
-        return call((gh, gw), (0, 0), True)[:, :h, :w]
+        return call((gh, gw), (0, 0))[:, :h, :w]
 
-    (i_lo, i_hi), (j_lo, j_hi) = split
-    ih, iw = i_hi - i_lo + 1, j_hi - j_lo + 1
-    mid = [call((ih, iw), (i_lo, j_lo), False)]  # unmasked interior
-    if j_lo > 0:
-        mid.insert(0, call((ih, j_lo), (i_lo, 0), True))
-    if j_hi < gw - 1:
-        mid.append(call((ih, gw - 1 - j_hi), (i_lo, j_hi + 1), True))
-    bands = [jnp.concatenate(mid, axis=2) if len(mid) > 1 else mid[0]]
-    if i_lo > 0:
-        bands.insert(0, call((i_lo, gw), (0, 0), True))
-    if i_hi < gh - 1:
-        bands.append(call((gh - 1 - i_hi, gw), (i_hi + 1, 0), True))
+    # 9-patch launch (split_patches is the shared plan): the
+    # middle×middle patch runs fully unmasked; a pure-edge patch (middle
+    # on one axis) statically drops the other axis's mask — its tiles
+    # are interior along that axis by the box construction.
+    patches = split_patches(split, (gh, gw))
+    bands, row_calls, cur_row = [], [], None
+    for (r0b, r1b), (c0b, c1b), axes in patches:
+        if cur_row is not None and (r0b, r1b) != cur_row:
+            bands.append(jnp.concatenate(row_calls, axis=2)
+                         if len(row_calls) > 1 else row_calls[0])
+            row_calls = []
+        cur_row = (r0b, r1b)
+        row_calls.append(call((r1b - r0b, c1b - c0b), (r0b, c0b), axes))
+    bands.append(jnp.concatenate(row_calls, axis=2)
+                 if len(row_calls) > 1 else row_calls[0])
     out = jnp.concatenate(bands, axis=1) if len(bands) > 1 else bands[0]
     return out[:, :h, :w]

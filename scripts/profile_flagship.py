@@ -119,23 +119,31 @@ def main() -> int:
             tile, sep)
         split = pallas_stencil._interior_range(
             (args.size, args.size), (th, tw), r * T, (gh, gw))
-        if split is None:
-            frac = 0.0
-        else:
-            (i_lo, i_hi), (j_lo, j_hi) = split
-            frac = (i_hi - i_lo + 1) * (j_hi - j_lo + 1) / (gh * gw)
+        fi = fs = 0.0
+        if split is not None:
+            # Count tiles from the launch's OWN patch plan, so this
+            # ledger cannot drift from what actually runs.
+            for (r0b, r1b), (c0b, c1b), (mr, mc) in (
+                    pallas_stencil.split_patches(split, (gh, gw))):
+                n = (r1b - r0b) * (c1b - c0b) / (gh * gw)
+                if not mr and not mc:
+                    fi += n
+                elif not mr or not mc:
+                    fs += n
 
         row_b = bench.bench_iterate((args.size, args.size), filt, args.iters,
                                     **kw, interior_split=True)
-        row_b.update(isplit=True, interior_tile_frac=round(frac, 3))
+        row_b.update(isplit=True, interior_tile_frac=round(fi, 3),
+                     single_mask_tile_frac=round(fs, 3))
         print(json.dumps(row_b), flush=True)
         speedup = row_b["gpixels_per_s_per_chip"] / max(gpx, 1e-9)
-        predicted = 1.0 / (1.0 - frac * 2.0 / ops_px)
+        # 9-patch ledger: interior tiles drop 2 of ops_px mask ops,
+        # pure-edge tiles drop 1; a ceiling (concat cost ~2% not
+        # modeled), not a pass bar.
+        predicted = 1.0 / (1.0 - (2.0 * fi + fs) / ops_px)
         print(json.dumps({
             "ab": "interior_split",
             "speedup": round(speedup, 4),
-            # DESIGN.md formula (interior_frac * 2/9), before the ~2%
-            # concat cost it also names — a ceiling, not a pass bar.
             "ledger_predicts": round(predicted, 4),
         }), flush=True)
     return 0
