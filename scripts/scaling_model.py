@@ -24,8 +24,11 @@ the conservative end; XLA's async collectives can only do better.
 
 Defaults: ``--ici-gb-s 45`` (per-link-class aggregate for a v5e 2D
 torus neighbor exchange; an ASSUMPTION, not a measurement) and
-``--latency-us 5`` (per collective phase; bracketed by the CPU-mesh
-functional proxy's sub-ms p50 and typical ICI small-message latencies).
+``--latency-us 5`` (per collective phase; an ASSUMPTION in the range of
+typical ICI small-message latencies — the CPU-mesh halo proxy is NOT a
+bracket for it: it measures XLA:CPU pad/ppermute/stitch cost on host
+cores, ~ms for 512² blocks under the round-5 live-differenced
+definition, and says nothing about ICI).
 Sensitivity: pass different values; rows are cheap.
 """
 
