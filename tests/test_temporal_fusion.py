@@ -274,6 +274,24 @@ def test_interior_split_multichip_bitexact(mshape):
     np.testing.assert_array_equal(got, want)
 
 
+def test_interior_split_multichip_u8():
+    # u8 carries (sublane 32 -> coarser tile rounding) + the class split
+    # on a 2x2 grid; bit-exact vs unsplit and the oracle.
+    img = imageio.generate_test_image(90, 300, "grey", seed=31)
+    filt = filters.get_filter("blur3")
+    x = imageio.interleaved_to_planar(img).astype(np.float32)
+    m = _mesh((2, 2))
+    kw = dict(quantize=True, backend="pallas_sep", fuse=3, tile=(8, 128),
+              storage="u8")
+    base = step.sharded_iterate(x, filt, 6, mesh=m, **kw)
+    split = step.sharded_iterate(x, filt, 6, mesh=m, interior_split=True,
+                                 **kw)
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(split))
+    want = oracle.run_serial_u8(img, filt, 6)
+    got = imageio.planar_to_interleaved(np.asarray(split).astype(np.uint8))
+    np.testing.assert_array_equal(got, want)
+
+
 def test_interior_split_multichip_bf16_radius2():
     # Deep rings (radius-2, fuse=2 -> depth 4) + bf16 carries on a 2x2
     # grid; bit-exact vs the unsplit fused path and the oracle.
