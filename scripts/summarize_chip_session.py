@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Digest the round-5 chip-session evidence into doc-update suggestions.
+
+Run after ``scripts/chip_session_r5.sh`` lands (or partially lands):
+reads whatever evidence files exist, prints a compact report —
+
+* best flagship row per sweep file vs the standing BENCH_r03 headline
+  (123.0 Gpx/s/chip), with the tile/fuse that won,
+* the interior-split A/B speedup vs the geometry-ledger prediction,
+* the config-2 true-size vs working-set-matched gap,
+* tiled-RDMA / validate_walls outcomes (pass-through status lines),
+
+so the post-session doc updates (README headline ~line 59, BASELINE.md
+provenance table, DESIGN.md "to be measured" lines, SEP_TILE/fuse
+defaults) can be written from one screen.  Read-only; never edits docs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import _path  # noqa: F401
+
+HEADLINE_R03 = 123.0  # Gpx/s/chip, BENCH_r03.json (pallas_sep/bf16/fuse32)
+
+EV = os.path.join(os.path.dirname(__file__), "..", "evidence")
+
+
+def rows(name):
+    path = os.path.join(EV, name)
+    if not os.path.exists(path):
+        return None
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+    return out
+
+
+def best(rws):
+    scored = [r for r in (rws or []) if "gpixels_per_s_per_chip" in r]
+    return max(scored, key=lambda r: r["gpixels_per_s_per_chip"],
+               default=None)
+
+
+def main() -> int:
+    any_file = False
+
+    for name in ("tune_convex_r5.jsonl", "tune_convex_r5_u8.jsonl",
+                 "config2_matched_r5.jsonl"):
+        rws = rows(name)
+        if rws is None:
+            print(f"[absent] {name}")
+            continue
+        any_file = True
+        b = best(rws)
+        if b is None:
+            print(f"[empty/errors] {name}: {len(rws)} rows, none scored")
+            continue
+        gpx = b["gpixels_per_s_per_chip"]
+        line = (f"[{name}] best {gpx} Gpx/s/chip "
+                f"tile={b.get('tile')} fuse={b.get('fuse')} "
+                f"storage={b.get('storage')} timing={b.get('timing')}")
+        if "config2" not in name:
+            line += (f"  -> vs r03 headline {HEADLINE_R03}: "
+                     f"{gpx / HEADLINE_R03:.3f}x")
+        print(line)
+        if "config2" in name and len(rws) >= 2:
+            by_tag = {r.get("tag"): r for r in rws}
+            t = by_tag.get("config2-true-size")
+            m = by_tag.get("config2-working-set-matched")
+            if t and m:
+                print(f"  config2 cache-residency inflation: "
+                      f"{t['gpixels_per_s_per_chip']} (true size) vs "
+                      f"{m['gpixels_per_s_per_chip']} (matched) = "
+                      f"{t['gpixels_per_s_per_chip'] / max(m['gpixels_per_s_per_chip'], 1e-9):.2f}x")
+
+    ab = rows("profile_flagship_r5.jsonl")
+    if ab is None:
+        print("[absent] profile_flagship_r5.jsonl")
+    else:
+        any_file = True
+        for r in ab:
+            if r.get("ab") == "interior_split":
+                print(f"[isplit A/B] measured {r.get('speedup')}x vs "
+                      f"ledger ceiling {r.get('ledger_predicts')}x")
+            elif r.get("isplit"):
+                print(f"[isplit row] {r.get('gpixels_per_s_per_chip')} "
+                      f"Gpx/s/chip (interior {r.get('interior_tile_frac')}, "
+                      f"single-mask {r.get('single_mask_tile_frac')})")
+            elif "implied_vpu_gflops" in r:
+                print(f"[ceiling] {r.get('gpixels_per_s_per_chip')} "
+                      f"Gpx/s/chip -> {r.get('implied_vpu_gflops')} Gflop/s "
+                      f"(claim 1469.8) / {r.get('implied_vpu_gops')} Gops "
+                      f"(derived ~1350); trace: {r.get('trace_dir')}")
+
+    for name in ("rdma_silicon_r5.json", "tiled_repro_r5.jsonl",
+                 "validate_walls_r5.json"):
+        rws = rows(name)
+        if rws is None:
+            print(f"[absent] {name}")
+        elif not rws:
+            print(f"[empty/errors] {name}: no parseable rows")
+        else:
+            any_file = True
+            # Print EVERY row (the tiled-repro ladder's key result is the
+            # first FAILING rung, usually not row 0).
+            print(f"[{name}] {len(rws)} row(s):")
+            for r in rws:
+                print(f"  {json.dumps(r)[:220]}")
+
+    if not any_file:
+        print("no round-5 chip evidence found — session not landed yet")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
