@@ -124,6 +124,7 @@ def _mesh_from_flag(spec: str | None):
 
 
 def main(argv: list[str] | None = None) -> int:
+    from parallel_convolution_tpu.utils.config import BOUNDARIES
     from parallel_convolution_tpu.utils.platform import apply_platform_env
 
     apply_platform_env()
@@ -135,7 +136,7 @@ def main(argv: list[str] | None = None) -> int:
     run.add_argument("-o", "--output", required=True)
     _add_perf_args(run)
     run.add_argument("--boundary", default="zero",
-                     choices=["zero", "periodic"],
+                     choices=list(BOUNDARIES),
                      help="edge handling: zero ghost ring (the reference) "
                           "or periodic torus wrap")
     run.add_argument("--converge", type=float, default=None, metavar="TOL",
@@ -230,6 +231,9 @@ def main(argv: list[str] | None = None) -> int:
         import jax
         from parallel_convolution_tpu.ops.filters import FILTERS
         from parallel_convolution_tpu.parallel.mesh import dims_create
+        from parallel_convolution_tpu.utils.config import (
+            BACKENDS, BOUNDARIES, STORAGES,
+        )
 
         devs = jax.devices()
         print(f"backend: {jax.default_backend()}  devices: {len(devs)}")
@@ -237,6 +241,11 @@ def main(argv: list[str] | None = None) -> int:
             print(f"  {d}")
         print(f"default mesh: {dims_create(len(devs))}")
         print(f"filters: {', '.join(sorted(FILTERS))}")
+        print(f"backends: {', '.join(BACKENDS)}")
+        print(f"storages: {', '.join(STORAGES)}  "
+              f"boundaries: {', '.join(BOUNDARIES)}")
+        print("perf knobs: --fuse T, --tile TH,TW, --interior-split, "
+              "--fast (measured flagship preset)")
         return 0
 
     if args.cmd == "serial":

@@ -425,8 +425,10 @@ def fused_rdma_step(
     keep the all-VMEM kernel (lower latency, no per-window DMA).  ``tile``
     sets the tiled variant's output tile (default ``DEFAULT_TILE``).
     """
-    if boundary not in ("zero", "periodic"):
-        raise ValueError(f"boundary must be zero|periodic, got {boundary!r}")
+    from parallel_convolution_tpu.utils.config import BOUNDARIES
+
+    if boundary not in BOUNDARIES:
+        raise ValueError(f"boundary must be one of {BOUNDARIES}, got {boundary!r}")
     if interpret is None:
         interpret = not on_tpu()
     if interpret is True:

@@ -88,8 +88,10 @@ def halo_exchange(block: jnp.ndarray, r: int, grid: tuple[int, int],
     ``boundary``: 'zero' (the reference's ghost ring) or 'periodic' (torus
     wrap — ring-collective topology for simulation workloads).
     """
-    if boundary not in ("zero", "periodic"):
-        raise ValueError(f"boundary must be zero|periodic, got {boundary!r}")
+    from parallel_convolution_tpu.utils.config import BOUNDARIES
+
+    if boundary not in BOUNDARIES:
+        raise ValueError(f"boundary must be one of {BOUNDARIES}, got {boundary!r}")
     periodic = boundary == "periodic"
     R, C = grid
     padded = halo_pad_axis(block, r, "x", R, dim=1, periodic=periodic)

@@ -18,6 +18,7 @@ import json
 BACKENDS = ("shifted", "xla_conv", "pallas", "separable", "pallas_sep",
             "pallas_rdma")
 STORAGES = ("f32", "bf16", "u8")
+BOUNDARIES = ("zero", "periodic")
 
 
 @dataclasses.dataclass
@@ -50,8 +51,9 @@ class RunConfig:
                 f"storage must be one of {STORAGES}, got {self.storage!r}")
         if self.backend not in BACKENDS:
             raise ValueError(f"unknown backend {self.backend!r}")
-        if self.boundary not in ("zero", "periodic"):
-            raise ValueError(f"boundary must be zero|periodic, got {self.boundary!r}")
+        if self.boundary not in BOUNDARIES:
+            raise ValueError(
+                f"boundary must be one of {BOUNDARIES}, got {self.boundary!r}")
         if self.storage == "u8" and not self.quantize:
             # u8 carries can only hold the quantized integer states; a float
             # Jacobi iterate would be silently truncated every iteration.
