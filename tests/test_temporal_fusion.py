@@ -359,3 +359,51 @@ def test_interior_split_geometry_fuzz():
             np.asarray(base), np.asarray(split),
             err_msg=f"trial {trial}: grid={grid} HxW={H}x{W} "
                     f"filt={filt.name} fuse={fuse} tile={tile}")
+
+
+def test_interior_range_sound_over_offset_classes():
+    # Pure-math soundness fuzz, independent of the kernels: for ANY
+    # geometry and ANY concrete device offset inside a class's (lo, hi)
+    # range, every tile inside the box _interior_range returns must have
+    # its level-0 window fully inside the image — the property that makes
+    # skipping its ghost-ring masks an identity.  200 random points.
+    from parallel_convolution_tpu.ops.pallas_stencil import (
+        _interior_range, axis_offset_classes)
+
+    rng = np.random.default_rng(7)
+    boxes = 0
+    for _ in range(200):
+        th = 8 * int(rng.integers(1, 24))
+        tw = 128 * int(rng.integers(1, 6))
+        depth = int(rng.integers(1, 80))
+        n_r = int(rng.integers(1, 5))
+        n_c = int(rng.integers(1, 5))
+        bh = depth + int(rng.integers(1, 2048))
+        bw = depth + int(rng.integers(1, 2048))
+        H = bh * n_r - int(rng.integers(0, min(bh - depth, 64) + 1))
+        W = bw * n_c - int(rng.integers(0, min(bw - depth, 64) + 1))
+        gh, gw = -(-bh // th), -(-bw // tw)
+        for rcls in axis_offset_classes(n_r, bh):
+            for ccls in axis_offset_classes(n_c, bw):
+                box = _interior_range((H, W), (th, tw), depth, (gh, gw),
+                                      (rcls, ccls))
+                if box is None:
+                    continue
+                boxes += 1
+                (i_lo, i_hi), (j_lo, j_hi) = box
+                # Check the EXTREME offsets of the class range; interior-
+                # ness is monotone in the offset, so ends suffice — but
+                # test a midpoint too in case that assumption rots.
+                r_offs = {rcls[0], rcls[1], (rcls[0] + rcls[1]) // 2}
+                c_offs = {ccls[0], ccls[1], (ccls[0] + ccls[1]) // 2}
+                for r0 in r_offs:
+                    for i in (i_lo, i_hi):
+                        assert r0 + i * th - depth >= 0, (rcls, box)
+                        assert r0 + i * th + th + depth <= H, (rcls, box)
+                for c0 in c_offs:
+                    for j in (j_lo, j_hi):
+                        assert c0 + j * tw - depth >= 0, (ccls, box)
+                        assert c0 + j * tw + tw + depth <= W, (ccls, box)
+    # Anti-vacuity: a regression that collapses every box to None must
+    # fail here, not silently skip all 200 trials.
+    assert boxes > 50, f"only {boxes} non-None boxes across the sweep"
