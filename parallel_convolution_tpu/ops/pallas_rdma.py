@@ -399,7 +399,7 @@ def _rdma_tiled_kernel(in_ref, out_ref, pad, win, wsems, xsem, send_sem,
 @functools.partial(
     jax.jit,
     static_argnames=("filt", "grid", "boundary", "quantize", "out_dtype",
-                     "interpret", "tiled", "tile"),
+                     "interpret", "tiled", "tile", "pad_operand"),
 )
 def fused_rdma_step(
     block: jnp.ndarray,
@@ -411,6 +411,7 @@ def fused_rdma_step(
     interpret=None,
     tiled: bool | None = None,
     tile: tuple[int, int] | None = None,
+    pad_operand: bool | None = None,
 ) -> jnp.ndarray:
     """One halo-exchange + stencil iteration, entirely inside one kernel.
 
@@ -424,6 +425,20 @@ def fused_rdma_step(
     HBM-pad + windowed-DMA variant (``_rdma_tiled_kernel``); small blocks
     keep the all-VMEM kernel (lower latency, no per-window DMA).  ``tile``
     sets the tiled variant's output tile (default ``DEFAULT_TILE``).
+
+    ``pad_operand`` (tiled variant only) chooses how the HBM pad buffer
+    is provided.  ``False``: as an ``pltpu.MemorySpace.HBM``
+    ``scratch_shapes`` entry — the natural form, but the round-5 probe
+    ladder pinned THAT construct as what crashes this tunnel's chipless
+    remote compile helper (``scripts/tiled_repro_probe.py`` rung a vs
+    a0; ``evidence/tiled_repro_r5.jsonl``).  ``True``: as a second
+    ANY-space OUTPUT that the caller discards — allocated uninitialized
+    by XLA just like the scratch it replaces (no init cost), and
+    nothing the helper rejects is used.  ``None`` resolves to ``True``
+    when actually compiling for silicon (``interpret is False``),
+    ``False`` under the interpreter — so interpreter tests keep
+    covering the scratch form regardless of the process's global
+    backend.
     """
     from parallel_convolution_tpu.utils.config import BOUNDARIES
 
@@ -515,6 +530,45 @@ def fused_rdma_step(
         R=grid[0], Cc=grid[1], periodic=periodic, quantize=quantize,
         convex=filt.convex, th=th, tw=tw, sub_v=sub_v,
     )
+    vmem_scratch = [
+        pltpu.VMEM((2, ext_h, ext_w), block.dtype),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA(()),
+        pltpu.SemaphoreType.DMA((4,)),
+        pltpu.SemaphoreType.DMA((4,)),
+    ]
+    if pad_operand is None:
+        # Resolve from the EXECUTION mode already decided above, not the
+        # global backend: a TPU-default process driving a forced-CPU mesh
+        # passes interpret=True and must keep the scratch form covered.
+        pad_operand = interpret is False
+    if pad_operand:
+        # Operand-backed pad: identical kernel body, but the HBM buffer
+        # is a second OUTPUT (discarded) instead of a scratch entry (the
+        # construct the chipless compile helper rejects — probe rung a
+        # vs a0).  An output-only buffer is allocated uninitialized by
+        # XLA, exactly like the scratch it replaces — no zero-fill tax —
+        # and exactly as safe: the kernel overwrites the interior and
+        # every ghost band it reads, and masks everything else
+        # (the `ok` window mask).
+        # (inputs, outputs, scratch) positional order makes the operand
+        # form's ref list identical to the scratch form's signature —
+        # the same kernel serves both.
+        out, _ = pl.pallas_call(
+            kernel,
+            grid=(C, gh, gw),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=(pl.BlockSpec((1, th, tw), lambda c, i, j: (c, i, j)),
+                       pl.BlockSpec(memory_space=pl.ANY)),
+            out_shape=(jax.ShapeDtypeStruct((C, gh * th, gw * tw),
+                                            out_dtype, vma=vma),
+                       jax.ShapeDtypeStruct((C, h_pad, w_pad),
+                                            block.dtype, vma=vma)),
+            scratch_shapes=vmem_scratch,
+            compiler_params=cparams,
+            interpret=interpret,
+        )(block)
+        return out[:, :h, :w]
     out = pl.pallas_call(
         kernel,
         grid=(C, gh, gw),
@@ -522,14 +576,8 @@ def fused_rdma_step(
         out_specs=pl.BlockSpec((1, th, tw), lambda c, i, j: (c, i, j)),
         out_shape=jax.ShapeDtypeStruct((C, gh * th, gw * tw), out_dtype,
                                        vma=vma),
-        scratch_shapes=[
-            pltpu.MemorySpace.HBM((C, h_pad, w_pad), block.dtype),
-            pltpu.VMEM((2, ext_h, ext_w), block.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA(()),
-            pltpu.SemaphoreType.DMA((4,)),
-            pltpu.SemaphoreType.DMA((4,)),
-        ],
+        scratch_shapes=[pltpu.MemorySpace.HBM((C, h_pad, w_pad),
+                                              block.dtype)] + vmem_scratch,
         compiler_params=cparams,
         interpret=interpret,
     )(block)

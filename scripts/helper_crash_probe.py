@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Attribute the remote-compile-helper HTTP 500 to a failure CLASS (TPU).
+
+Round-5 finding: the `tpu_compile_helper subprocess exit code 1` /
+HTTP 500 rejection first seen on the tiled RDMA kernel
+(`evidence/rdma_silicon.json`) is NOT RDMA-specific — the PLAIN fused
+stencil kernel (no scratch, no semaphores, no remote copies) hits the
+identical rejection at 1536x512 tiles while 1024x512 compiles and runs
+(`evidence/tune_convex_r5_recovered.jsonl`).  The obvious difference is
+VMEM footprint: the fused kernel double-buffers padded f32 tiles, so
+1536-row tiles cross the ~16 MB/core VMEM budget where 1024-row tiles
+fit.
+
+Hypothesis: on this tunnel, a Mosaic VMEM-exhaustion diagnostic (which
+should surface as a clean RESOURCE_EXHAUSTED) instead kills the remote
+compile helper subprocess, and the HTTP 500 is the tunnel's framing of
+ANY such compile-stage death.  If true, the six-construct RDMA ladder
+(`scripts/tiled_repro_probe.py`) cannot isolate a guilty construct —
+the guilt is a resource class plus an infrastructure masking bug.
+
+Test: compile a TRIVIAL kernel (elementwise add of a VMEM scratch it
+zeroes itself — no DMA constructs, no windowing, nothing from the RDMA
+kernel) at scratch sizes stepping across the VMEM budget, and record
+the failure FORM at each step:
+
+  4 MB   well inside        -> expect compile + run
+  12 MB  inside             -> expect compile + run
+  20 MB  past ~16 MB budget -> failure expected; FORM is the finding
+  32 MB  far past           -> same
+
+One JSON row per step.  `error_class` distinguishes a clean Mosaic
+resource error (`clean_resource_error`) from the helper crash
+(`helper_http500`) by substring, so the evidence row states the
+attribution directly.  Exit 0 iff every step produced a row.  Off-TPU
+this exits 1: the interpreter/CPU path has no VMEM budget and the
+remote helper does not exist, so there is nothing to learn.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import _path  # noqa: F401
+
+# Scratch shapes chosen as (rows, 512) f32 -> bytes = rows*512*4.
+STEPS_MB = (4, 12, 20, 32)
+
+
+def classify(msg: str) -> str:
+    if "tpu_compile_helper" in msg or "HTTP 500" in msg:
+        return "helper_http500"
+    if "RESOURCE_EXHAUSTED" in msg or "VMEM" in msg or "vmem" in msg:
+        return "clean_resource_error"
+    return "other"
+
+
+def main() -> int:
+    from parallel_convolution_tpu.utils.platform import on_tpu
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if not on_tpu():
+        print(json.dumps({"error": "not on TPU; helper does not exist"}))
+        return 1
+
+    H, W = 256, 512
+    x = np.arange(H * W, dtype=np.float32).reshape(H, W) % 251.0
+    want = x + 1.0
+
+    ok = True
+    for mb in STEPS_MB:
+        rows = (mb * 1024 * 1024) // (512 * 4)
+
+        def kernel(in_ref, out_ref, scratch):
+            # Touch one lane of the scratch so it cannot be elided, but
+            # keep the compute trivial: out = in + 1.
+            scratch[0, 0] = in_ref[0, 0]
+            out_ref[...] = in_ref[...] + 1.0 + (scratch[0, 0] * 0.0)
+
+        fn = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((H, W), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((rows, 512), jnp.float32)],
+        )
+        row = {"scratch_mb": mb, "scratch_shape": [int(rows), 512]}
+        try:
+            got = np.asarray(jax.jit(fn)(jnp.asarray(x)))
+            row.update(compiled=True, correct=bool(np.array_equal(got, want)))
+        except Exception as e:
+            msg = repr(e)
+            if len(msg) > 3000:
+                msg = msg[:1500] + " ...[elided]... " + msg[-1500:]
+            row.update(compiled=False, error_class=classify(msg), error=msg)
+        print(json.dumps(row), flush=True)
+        if "error" in row and row.get("error_class") == "other":
+            ok = False
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,14 @@ The probes form an additive ladder: each adds EXACTLY ONE construct on
 top of the previous probe, so the first failing row's own delta names
 the offender:
 
+  a0_any_operands_only   Δ: unblocked (memory_space=ANY) in/out specs —
+                            compute goes in→VMEM→out, no HBM scratch.
+                            (The round-5 run showed rung a's claim that
+                            the monolithic kernel proves this path was
+                            wrong: the monolithic call uses DEFAULT
+                            blocked VMEM specs, so without this rung the
+                            a-failure is ambiguous between ANY operands
+                            and the HBM scratch.)
   a_unused_hbm_scratch   Δ: an HBM scratch buffer is allocated (never
                             touched; compute goes in→VMEM→out)
   b_hbm_roundtrip        Δ: DMA into and out of the HBM scratch
@@ -25,7 +33,7 @@ the offender:
 
 Emits one JSON row per probe (failures are IN the record); exit 0 iff
 every probe produced a row.  Off-TPU it exits 1 — the interpreter
-accepts all six, there is nothing to learn from it here.
+accepts every rung, there is nothing to learn from it here.
 """
 
 from __future__ import annotations
@@ -76,8 +84,23 @@ def main() -> int:
         out_shape=jax.ShapeDtypeStruct((H, W), jnp.float32),
     )
 
-    # a. HBM scratch allocated but never touched; data moves via VMEM
-    #    (the ANY→VMEM path the monolithic kernel already proves).
+    # a0. ANY-space operands alone: in → VMEM → out, no HBM scratch.
+    def k_a0(in_ref, out_ref, vmem, sem):
+        cp = pltpu.make_async_copy(in_ref, vmem, sem)
+        cp.start()
+        cp.wait()
+        cp2 = pltpu.make_async_copy(vmem, out_ref, sem)
+        cp2.start()
+        cp2.wait()
+
+    run("a0_any_operands_only", lambda v: pl.pallas_call(
+        k_a0, **ANY_IO,
+        scratch_shapes=[pltpu.VMEM((H, W), jnp.float32),
+                        pltpu.SemaphoreType.DMA(())],
+    )(v), x)
+
+    # a. + HBM scratch allocated but never touched; data still moves
+    #    via VMEM exactly as in a0.
     def k_a(in_ref, out_ref, hbm, vmem, sem):
         cp = pltpu.make_async_copy(in_ref, vmem, sem)
         cp.start()

@@ -148,7 +148,7 @@ def test_collective_id_registry():
 
 
 def _run_rdma_tiled(img, filt, iters, mesh_shape, tile=None, tiled=True,
-                    boundary="zero"):
+                    boundary="zero", pad_operand=None):
     from jax.sharding import PartitionSpec as P
 
     from parallel_convolution_tpu.ops import pallas_rdma
@@ -161,7 +161,7 @@ def _run_rdma_tiled(img, filt, iters, mesh_shape, tile=None, tiled=True,
         def one(_, cur):
             return pallas_rdma.fused_rdma_step(
                 cur, filt, mesh_shape, boundary, quantize=True,
-                tiled=tiled, tile=tile)
+                tiled=tiled, tile=tile, pad_operand=pad_operand)
         import jax.lax as lax
 
         return lax.fori_loop(0, iters, one, v)
@@ -183,6 +183,33 @@ def test_rdma_tiled_bitexact_corners():
     img = imageio.generate_test_image(64, 256, "grey", seed=21)
     got = _run_rdma_tiled(img, filt, 2, (2, 2), tile=(16, 128))
     want = oracle.run_serial_u8(img, filt, 2)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_rdma_tiled_pad_operand_bitexact():
+    """Operand-backed HBM pad (discarded-second-output workaround for
+    the chipless compile helper's HBM-scratch rejection, round-5 probe
+    ladder): same bytes as the scratch form and as the oracle, through
+    chained iterations with corner propagation."""
+    filt = filters.get_filter("blur3")
+    img = imageio.generate_test_image(64, 256, "grey", seed=23)
+    got = _run_rdma_tiled(img, filt, 2, (2, 2), tile=(16, 128),
+                          pad_operand=True)
+    want = oracle.run_serial_u8(img, filt, 2)
+    np.testing.assert_array_equal(got, want)
+    scratch_form = _run_rdma_tiled(img, filt, 2, (2, 2), tile=(16, 128),
+                                   pad_operand=False)
+    np.testing.assert_array_equal(got, scratch_form)
+
+
+def test_rdma_tiled_pad_operand_periodic():
+    """Operand mode under the torus: self-wrap axes fill ghosts by local
+    aligned copies; the zero-filled operand must not leak through."""
+    filt = filters.get_filter("blur3")
+    img = imageio.generate_test_image(32, 256, "grey", seed=24)
+    got = _run_rdma_tiled(img, filt, 2, (1, 2), tile=(16, 128),
+                          boundary="periodic", pad_operand=True)
+    want = oracle.run_serial_u8(img, filt, 2, boundary="periodic")
     np.testing.assert_array_equal(got, want)
 
 
