@@ -1,27 +1,31 @@
 #!/bin/sh
-# Round-5 follow-up chip session: re-run what chip_session_r5.sh leg 1
-# lost and extend past its sweep edge.  Context: leg 1 timed out after
-# the third 1536x512 point hung (two prior 1536x512 points crashed the
-# remote compile helper with the SAME HTTP 500 / tpu_compile_helper
-# subprocess crash that blocks the tiled RDMA kernel — a key
-# attribution datapoint: the crash is large-tile-related, not
-# RDMA-specific), and run_to's cleanup deleted the partial .jsonl.tmp
-# holding three good rows (recovered with labeled provenance in
-# evidence/tune_convex_r5_recovered.jsonl).
+# Round-5 follow-up chip session.  First run (2026-07-31 ~05:57 UTC)
+# got through the bf16 fuse-40/48 rows (preserved in
+# evidence/tune_convex_r5b.jsonl.partial: 122.1 / 125.7 Gpx/s — the
+# fuse curve has plateaued) before the tunnel died mid-compile; this
+# revision reorders the remaining legs by value so the next window
+# lands the proofs before any sweep:
 #
-# Differences from r5 leg 1:
-#   - drops the 1536x512 / 2048x512 tiles (attributed crashers); keeps
-#     1024x512 (measured good) and adds 1024x768,
-#   - extends fuses past the 40 edge (fuse=40 was the best measured row),
-#   - run_to_keep preserves a timed-out leg's partial rows as
-#     "$out.partial" instead of deleting them.
+#   1. tiled_repro_r5b  — the ladder WITH rung a0 (ANY operands alone),
+#      completing the HBM-scratch attribution
+#   2. rdma_silicon_r5b — monolithic re-proof + the tiled kernel via the
+#      operand-backed pad: the bit-exactness-on-silicon record
+#   3. helper_crash_probe — failure-class test (clean VMEM error vs
+#      helper HTTP 500) motivated by the plain stencil crashing the
+#      helper at 1536x512 tiles
+#   4. fill-in tuner points (plateau region; lowest value)
+#
+# run_to_keep preserves a timed-out leg's partial rows as
+# "$out.partial" instead of deleting them (the r5 runner lost real chip
+# rows to its own cleanup).
 set -x
 cd "$(dirname "$0")/.."
 
+# Dead-tunnel guard: a dead tunnel makes jax HANG on backend init.
 timeout 60 python -c "import jax; print(jax.devices())" \
   || { echo "tunnel dead; aborting chip session" >&2; exit 1; }
 
-LEG_TIMEOUT="${LEG_TIMEOUT:-2400}"
+LEG_TIMEOUT="${LEG_TIMEOUT:-1800}"
 
 run_to_keep() {
   out="$1"; shift
@@ -29,11 +33,11 @@ run_to_keep() {
        > "$out.tmp" 2> "/tmp/$(basename "$out").err"; then
     mv "$out.tmp" "$out" && echo "$out OK"
   else
-    # A timed-out tuner leg still printed real chip rows; keep them
-    # under a name that cannot be mistaken for a completed record.
     if [ -s "$out.tmp" ]; then
-      mv "$out.tmp" "$out.partial"
-      echo "$out FAILED; partial rows kept at $out.partial" >&2
+      # APPEND to any existing partial — a re-armed retry that dies
+      # early must not clobber rows a longer earlier attempt saved.
+      cat "$out.tmp" >> "$out.partial" && rm -f "$out.tmp"
+      echo "$out FAILED; partial rows appended to $out.partial" >&2
     else
       rm -f "$out.tmp"
       echo "$out FAILED (stderr: /tmp/$(basename "$out").err)" >&2
@@ -41,38 +45,17 @@ run_to_keep() {
   fi
 }
 
-# 1. Focused flagship re-tune: surviving tile + fuse sweep past the edge.
-run_to_keep evidence/tune_convex_r5b.jsonl \
-  python scripts/tune_pallas.py --backend pallas_sep --storage bf16 \
-    --iters 100 --tiles 1024x512,1024x768 --fuses 40,48,56,64
+[ -e evidence/tiled_repro_r5b.jsonl ] || \
+  run_to_keep evidence/tiled_repro_r5b.jsonl python scripts/tiled_repro_probe.py
+[ -e evidence/rdma_silicon_r5b.json ] || \
+  run_to_keep evidence/rdma_silicon_r5b.json python scripts/rdma_on_silicon.py
+[ -e evidence/helper_crash_probe_r5.jsonl ] || \
+  run_to_keep evidence/helper_crash_probe_r5.jsonl \
+    python scripts/helper_crash_probe.py
 
-# 2. Re-run any r5 leg that failed (each guarded by [ -e ] so a leg that
-#    landed in the main session is not repeated).
-[ -e evidence/profile_flagship_r5.jsonl ] || \
-  run_to_keep evidence/profile_flagship_r5.jsonl \
-    python scripts/profile_flagship.py --size 8192 --fuse 32 --reps 3 --ab
-[ -e evidence/tune_convex_r5_u8.jsonl ] || \
-  run_to_keep evidence/tune_convex_r5_u8.jsonl \
-    python scripts/tune_pallas.py --backend pallas_sep --storage u8 \
-      --iters 100 --tiles 1024x512,2048x512 --fuses 32,40
-[ -e evidence/rdma_silicon_r5.json ] || \
-  run_to_keep evidence/rdma_silicon_r5.json python scripts/rdma_on_silicon.py
-[ -e evidence/tiled_repro_r5.jsonl ] || \
-  run_to_keep evidence/tiled_repro_r5.jsonl python scripts/tiled_repro_probe.py
-[ -e evidence/validate_walls_r5.json ] || \
-  run_to_keep evidence/validate_walls_r5.json python scripts/validate_walls.py
-
-# 3. Failure-class attribution: is the helper HTTP 500 just a masked
-#    VMEM resource error?  (Motivated by the plain stencil kernel
-#    crashing the helper at 1536x512 tiles in the r5 leg-1 sweep.)
-run_to_keep evidence/helper_crash_probe_r5.jsonl \
-  python scripts/helper_crash_probe.py
-
-# 4. Tiled-RDMA closure (VERDICT r4 item 2): the r5 ladder pinned the
-#    crash to rung a (HBM scratch + ANY operands together); the ladder
-#    now carries rung a0 (ANY operands alone) to split that ambiguity,
-#    and fused_rdma_step gained the operand-backed pad workaround which
-#    rdma_on_silicon picks up by default on silicon.  Fresh names: the
-#    r5 records exist and stay as the pre-workaround baseline.
-run_to_keep evidence/tiled_repro_r5b.jsonl python scripts/tiled_repro_probe.py
-run_to_keep evidence/rdma_silicon_r5b.json python scripts/rdma_on_silicon.py
+# Fill-in tuner points past the measured plateau (1024x512 fuse 40/48
+# already recorded in the .partial).
+[ -e evidence/tune_convex_r5b_fill.jsonl ] || \
+  run_to_keep evidence/tune_convex_r5b_fill.jsonl \
+    python scripts/tune_pallas.py --backend pallas_sep --storage bf16 \
+      --iters 100 --tiles 1024x512 --fuses 56

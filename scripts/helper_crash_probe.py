@@ -31,9 +31,11 @@ the failure FORM at each step:
 One JSON row per step.  `error_class` distinguishes a clean Mosaic
 resource error (`clean_resource_error`) from the helper crash
 (`helper_http500`) by substring, so the evidence row states the
-attribution directly.  Exit 0 iff every step produced a row.  Off-TPU
-this exits 1: the interpreter/CPU path has no VMEM budget and the
-remote helper does not exist, so there is nothing to learn.
+attribution directly.  Exit 0 iff every step produced a row — an
+`other` classification is still a complete answer (discovering the
+unknown failure form is the probe's purpose), not a failed run.
+Off-TPU this exits 1: the interpreter/CPU path has no VMEM budget and
+the remote helper does not exist, so there is nothing to learn.
 """
 
 from __future__ import annotations
@@ -56,7 +58,12 @@ def classify(msg: str) -> str:
 
 
 def main() -> int:
-    from parallel_convolution_tpu.utils.platform import on_tpu
+    from parallel_convolution_tpu.utils.platform import (
+        apply_platform_env, enable_compile_cache, on_tpu,
+    )
+
+    apply_platform_env()
+    enable_compile_cache()
 
     import jax
     import jax.numpy as jnp
@@ -72,7 +79,6 @@ def main() -> int:
     x = np.arange(H * W, dtype=np.float32).reshape(H, W) % 251.0
     want = x + 1.0
 
-    ok = True
     for mb in STEPS_MB:
         rows = (mb * 1024 * 1024) // (512 * 4)
 
@@ -97,9 +103,7 @@ def main() -> int:
                 msg = msg[:1500] + " ...[elided]... " + msg[-1500:]
             row.update(compiled=False, error_class=classify(msg), error=msg)
         print(json.dumps(row), flush=True)
-        if "error" in row and row.get("error_class") == "other":
-            ok = False
-    return 0 if ok else 1
+    return 0
 
 
 if __name__ == "__main__":
