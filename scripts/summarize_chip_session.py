@@ -54,7 +54,10 @@ def best(rws):
 def main() -> int:
     any_file = False
 
-    for name in ("tune_convex_r5.jsonl", "tune_convex_r5_u8.jsonl",
+    for name in ("tune_convex_r5.jsonl", "tune_convex_r5_recovered.jsonl",
+                 "tune_convex_r5_u8.jsonl",
+                 "tune_convex_r5b.jsonl", "tune_convex_r5b.jsonl.partial",
+                 "tune_convex_r5b_fill.jsonl",
                  "config2_matched_r5.jsonl"):
         rws = rows(name)
         if rws is None:
@@ -103,6 +106,10 @@ def main() -> int:
                       f"(derived ~1350); trace: {r.get('trace_dir')}")
 
     for name in ("rdma_silicon_r5.json", "tiled_repro_r5.jsonl",
+                 "rdma_silicon_r5b.json", "rdma_silicon_r5b.json.partial",
+                 "tiled_repro_r5b.jsonl", "tiled_repro_r5b.jsonl.partial",
+                 "helper_crash_probe_r5.jsonl",
+                 "helper_crash_probe_r5.jsonl.partial",
                  "validate_walls_r5.json"):
         rws = rows(name)
         if rws is None:

@@ -29,7 +29,12 @@ the offender:
   e_when_step0           Δ: the refill copy moves under the one-shot
                             @pl.when(step == 0) guard
   f_collective_params    Δ: CompilerParams(collective_id,
-                            has_side_effects) as the real kernel passes
+                            has_side_effects) as the real kernel passes,
+                            plus the step-0-guarded degenerate neighbor
+                            barrier (get_barrier_semaphore + zero-count
+                            wait) that makes collective_id legal — two
+                            constructs in one rung, so a failure here
+                            names the pair, not CompilerParams alone
 
 Emits one JSON row per probe (failures are IN the record); exit 0 iff
 every probe produced a row.  Off-TPU it exits 1 — the interpreter
@@ -191,9 +196,27 @@ def main() -> int:
     run("e_when_step0", lambda v: pl.pallas_call(
         make_k_win(True), **GRID_IO, scratch_shapes=SCRATCH)(v), x)
 
-    # f. + the collective compiler params the real kernel passes.
+    # f. + the collective compiler params the real kernel passes.  The
+    #    r5 run showed bare CompilerParams(collective_id) is rejected at
+    #    TRACE time ("collective_id has to be unspecified or None when
+    #    not using a custom barrier") — the rung never reached the
+    #    helper.  Include the degenerate 1x1 form of the real kernel's
+    #    neighbor barrier (get_barrier_semaphore + zero-count wait) so
+    #    the construct under test is the one the helper actually sees.
+    def k_f(in_ref, out_ref, hbm, win, sems, xsem):
+        i, j = pl.program_id(0), pl.program_id(1)
+
+        @pl.when(jnp.logical_and(i == 0, j == 0))
+        def _barrier():
+            # Same placement as the real kernel: the barrier runs inside
+            # the one-shot step-0 guard (pallas_rdma._rdma_tiled_kernel).
+            bsem = pltpu.get_barrier_semaphore()
+            pltpu.semaphore_wait(bsem, jnp.int32(0))
+
+        make_k_win(True)(in_ref, out_ref, hbm, win, sems, xsem)
+
     run("f_collective_params", lambda v: pl.pallas_call(
-        make_k_win(True), **GRID_IO, scratch_shapes=SCRATCH,
+        k_f, **GRID_IO, scratch_shapes=SCRATCH,
         compiler_params=pltpu.CompilerParams(collective_id=1,
                                              has_side_effects=True),
     )(v), x)
