@@ -37,8 +37,11 @@ multi-device CPU mesh under TPU interpret mode (which simulates remote
 DMAs, semaphores, and the barrier).  On the one real chip available here
 the monolithic kernel compiles via Mosaic and runs in its degenerate 1×1
 local form, bit-exact vs the oracle (recorded in BASELINE.md "RDMA on
-silicon"); multi-chip ICI perf remains unvalidated — no such hardware
-exists in this environment.
+silicon"), and since round 5 the tiled variant runs on silicon too via
+the operand-backed pad (``pad_operand``; the HBM *scratch* form is what
+crashes this tunnel's chipless compile helper — see fused_rdma_step's
+docstring and BASELINE.md "Round-5 chip session"); multi-chip ICI perf
+remains unvalidated — no such hardware exists in this environment.
 
 VMEM budget: the monolithic kernel holds the whole (C, h+2r, w+2r) f32
 padded block plus the (C, h, w) output in VMEM (~16 MB limit ≈ 1400²
