@@ -49,7 +49,17 @@ def correlate_once(img_f32: np.ndarray, filt: Filter,
     """One padded cross-correlation step in float32 (no quantization).
 
     ``img_f32``: (H, W) or (H, W, C) float32.  Returns same shape float32.
-    The accumulation is the normative fixed-order shifted multiply-add.
+    The accumulation is the normative fixed-order shifted multiply-add,
+    where "multiply-add" means numpy's TWO-rounding form: ``tap * win``
+    rounds to f32, then ``+=`` rounds again (the C++ serial tier pins the
+    same form with ``-ffp-contract=off``).  The accelerator tiers contract
+    each tap into a single-rounding FMA (the VPU's native op; verified on
+    XLA:CPU — round-5 soak find, DESIGN.md "bit-exactness" note).  The two
+    forms are bit-identical wherever every product and partial sum is
+    exactly representable — which the u8 quantize-mode semantics guarantee
+    at every level, so the byte-compare contract is unaffected — but f32
+    float-mode runs diverge by ulps once intermediate mantissas fill
+    (observed at iteration >= 3 of gaussian5 on u8-valued inputs).
     ``boundary``: 'zero' (the reference's ghost ring) or 'periodic' (torus
     wrap, the simulation-style ring topology).
     """
