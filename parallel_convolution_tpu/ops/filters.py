@@ -189,7 +189,17 @@ def make_filter(name: str, taps: np.ndarray, divisor: float | None = None) -> Fi
 
 
 def gaussian(size: int, sigma: float) -> Filter:
-    """Sampled normalized Gaussian of odd ``size`` (non-dyadic in general)."""
+    """Sampled normalized Gaussian of odd ``size`` (non-dyadic in general).
+
+    Byte-parity caveat: taps with no integer divisor (these, or any
+    ``make_filter`` taps without one) lose the rint-margin theorem, so
+    quantize-mode outputs can differ from the two-rounding NumPy/C++
+    oracle at isolated pixels (the FMA rint-straddle — DESIGN.md
+    "bit-exactness" precision classes; measured ±1 at sigma=0.7).
+    Compiled backends remain bit-identical to each other; every
+    registry filter carries an integer divisor and keeps full byte
+    equality.
+    """
     if size % 2 == 0:
         raise ValueError("size must be odd")
     r = size // 2

@@ -91,3 +91,40 @@ def test_float_mode_fma_contract():
         x[None], filt, 3, mesh=mesh, quantize=True,
         backend="pallas")).astype(np.uint8)[0]
     np.testing.assert_array_equal(got_u8, want_u8)
+
+
+def test_quantize_nonmargin_gaussian_contract():
+    """DESIGN.md precision class 3, pinned: arbitrary-sigma Gaussian taps
+    have no integer divisor, so quantize mode carries no rint-margin
+    theorem — the contract narrows to cross-backend bit-identity plus at
+    most one quantum of deviation from the two-rounding oracle.  (Classes
+    1-2 — every registry filter — keep full byte equality; the 400-config
+    soak and the whole suite pin that.)"""
+    import jax
+
+    from parallel_convolution_tpu.parallel import mesh as mesh_lib
+    from parallel_convolution_tpu.parallel import step
+
+    filt = filters.gaussian(5, 0.7)
+    img = imageio.generate_test_image(96, 128, "grey", seed=0)
+    want = oracle.run_serial_u8(img, filt, 5)
+    x = imageio.interleaved_to_planar(img).astype(np.float32)
+    mesh = mesh_lib.make_grid_mesh(jax.devices()[:1], (1, 1))
+
+    outs = {}
+    for backend in ("shifted", "pallas"):
+        out = step.sharded_iterate(x, filt, 5, mesh=mesh, quantize=True,
+                                   backend=backend)
+        outs[backend] = imageio.planar_to_interleaved(
+            np.asarray(out).astype(np.uint8))
+
+    # One rounding discipline across compiled backends: bit-identical.
+    np.testing.assert_array_equal(outs["shifted"], outs["pallas"])
+    # Vs the oracle: this config measures a single quantum at isolated
+    # pixels (the straddle is real, not hypothetical).  The <=1 here
+    # pins THIS config's measured behavior, not a theorem — flipped
+    # bytes feed later levels' re-quantization, so no general bound
+    # exists; if an XLA change moves this, the pin flags it for
+    # re-measurement rather than guaranteeing the old number.
+    diff = np.abs(outs["pallas"].astype(int) - want.astype(int))
+    assert int(diff.max()) <= 1
