@@ -68,7 +68,7 @@ from parallel_convolution_tpu.ops.collective_ids import collective_id
 from parallel_convolution_tpu.ops.filters import Filter
 from parallel_convolution_tpu.ops.pallas_stencil import (
     DEFAULT_TILE, _correlate_window, _from_f32, _prefetch_window,
-    _quantize_acc, _round_up, _sublane, _to_f32, on_tpu,
+    _quantize_acc, _round_mode_for, _round_up, _sublane, _to_f32, on_tpu,
 )
 
 # Semaphore slots: one (send, recv) pair per direction.
@@ -140,7 +140,7 @@ def _topology(R, Cc, periodic):
 
 def _rdma_kernel(in_ref, out_ref, pad, send_sem, recv_sem, *,
                  taps, sep, k, r, C, h, w, R, Cc, periodic, quantize,
-                 convex):
+                 convex, round_mode):
     """One device's program: exchange ghosts in-kernel, then stencil.
 
     ``pad`` is the (C, h+2r, w+2r) f32 working buffer; interior = my block,
@@ -234,7 +234,7 @@ def _rdma_kernel(in_ref, out_ref, pad, send_sem, recv_sem, *,
     for c in range(C):
         acc = _correlate_window(pad[c], taps, sep, k, h, w)
         if quantize:
-            acc = _quantize_acc(acc, convex)
+            acc = _quantize_acc(acc, convex, round_mode)
         out_ref[c] = _from_f32(acc, out_ref.dtype)
 
 
@@ -283,7 +283,7 @@ _TILED_VMEM_BYTES = 10 * 2**20  # monolithic-kernel budget before auto-tiling
 
 def _rdma_tiled_kernel(in_ref, out_ref, pad, win, wsems, xsem, send_sem,
                        recv_sem, *, taps, sep, k, r, C, h, w, R, Cc,
-                       periodic, quantize, convex, th, tw, sub_v):
+                       periodic, quantize, convex, th, tw, sub_v, round_mode):
     LANE = 128
     ext_h, ext_w = th + 2 * sub_v, tw + 2 * LANE
     c, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
@@ -395,7 +395,7 @@ def _rdma_tiled_kernel(in_ref, out_ref, pad, win, wsems, xsem, send_sem,
 
     acc = _correlate_window(cur, taps, sep, k, th, tw)
     if quantize:
-        acc = _quantize_acc(acc, convex)
+        acc = _quantize_acc(acc, convex, round_mode)
     out_ref[0] = _from_f32(acc, out_ref.dtype)
 
 
@@ -483,11 +483,14 @@ def fused_rdma_step(
                 f"{min(sub_v, 128)} (got {r}) and blocks >= "
                 f"({sub_v}, 128); use a finer or differently-shaped mesh")
 
+    # interpret here is False (silicon) or InterpretParams — the barrier
+    # form is needed exactly when XLA (not Mosaic) executes the kernel.
+    round_mode = _round_mode_for(taps, interpret is not False)
     if not tiled:
         kernel = functools.partial(
             _rdma_kernel, taps=taps, sep=sep, k=k, r=r, C=C, h=h, w=w,
             R=grid[0], Cc=grid[1], periodic=periodic, quantize=quantize,
-            convex=filt.convex,
+            convex=filt.convex, round_mode=round_mode,
         )
         return pl.pallas_call(
             kernel,
@@ -532,6 +535,7 @@ def fused_rdma_step(
         _rdma_tiled_kernel, taps=taps, sep=sep, k=k, r=r, C=C, h=h, w=w,
         R=grid[0], Cc=grid[1], periodic=periodic, quantize=quantize,
         convex=filt.convex, th=th, tw=tw, sub_v=sub_v,
+        round_mode=round_mode,
     )
     vmem_scratch = [
         pltpu.VMEM((2, ext_h, ext_w), block.dtype),

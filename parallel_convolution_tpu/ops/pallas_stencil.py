@@ -158,18 +158,58 @@ def _prefetch_window(window_copy):
     return slot
 
 
-def _quantize_acc(acc, convex):
+# 1.5 * 2**23: adding it to an f32 x with |x| < 2**22 forces the mantissa
+# to integer precision (ulp = 1), i.e. the hardware rounds x to the nearest
+# integer half-to-even; subtracting recovers that integer losslessly.  Two
+# f32 adds == jnp.rint, bit for bit, on the whole quantize-mode range.
+_MAGIC = 12582912.0
+
+
+def _round_mode_for(taps, interpret) -> str:
+    """Pick the rint implementation for a kernel build.
+
+    Mosaic lowers ``jnp.rint`` to a multi-op sequence; replacing it with
+    the two-add magic-number form measured **+15.6% on the u8 flagship /
+    +12.6% bf16** on real v5e silicon, byte-identical
+    (``evidence/round_mode_ab_r5.jsonl``, 2026-07-31).  Exactness needs
+    |acc| < 2**22; every quantize-mode accumulator is bounded by
+    255 * L1(taps), so filters with L1 < 2**21/255 (every shipped filter
+    by orders of magnitude) qualify — anything larger falls back to
+    ``rint``.
+
+    Interpret-mode kernels run through XLA:CPU, whose algebraic
+    simplifier FOLDS ``(x + C) - C`` to ``x`` (measured: the round
+    disappears entirely) — there the adds are pinned with
+    ``lax.optimization_barrier``.  Mosaic neither folds (the silicon
+    byte-proof above) nor implements the barrier primitive, so compiled
+    kernels use the bare form.
+    """
+    l1 = sum(abs(float(t)) for t in taps)
+    if 255.0 * l1 >= 2.0**21:  # 2x safety margin under the 2**22 bound
+        return "rint"
+    return "magic_barrier" if interpret else "magic"
+
+
+def _quantize_acc(acc, convex, round_mode):
     """In-kernel u8 store-back on an f32 acc: rint, then clip — except the
     clip is elided for convex filters, where it is provably the identity
-    (``Filter.convex``); results are bit-identical either way."""
-    acc = jnp.rint(acc)
+    (``Filter.convex``); results are bit-identical either way.
+
+    ``round_mode`` selects the rint implementation (see
+    ``_round_mode_for``); all three compute the same function."""
+    if round_mode == "magic":
+        acc = (acc + _MAGIC) - _MAGIC
+    elif round_mode == "magic_barrier":
+        acc = jax.lax.optimization_barrier(acc + _MAGIC) - _MAGIC
+    else:
+        acc = jnp.rint(acc)
     if not convex:
         acc = jnp.clip(acc, 0.0, 255.0)
     return acc
 
 
 def _stencil_kernel(hbm_ref, out_ref, scratch, sems, *, taps, sep, k, r, th,
-                    tw, ext_h, ext_w, quantize, convex):
+                    tw, ext_h, ext_w, quantize, convex, round_mode):
     """One grid program: DMA window c,i,j → VMEM, stencil it, emit tile.
 
     ``scratch`` holds two (ext_h, ext_w) slots — the (th+2r, tw+2r)
@@ -190,7 +230,7 @@ def _stencil_kernel(hbm_ref, out_ref, scratch, sems, *, taps, sep, k, r, th,
     if quantize:
         # Fused u8 store-back: saves one full HBM round trip per iteration
         # vs quantizing in a separate XLA fusion after the kernel.
-        acc = _quantize_acc(acc, convex)
+        acc = _quantize_acc(acc, convex, round_mode)
     out_ref[0] = _from_f32(acc, out_ref.dtype)
 
 
@@ -254,7 +294,7 @@ def correlate_padded_pallas(
     kernel = functools.partial(
         _stencil_kernel, taps=taps, sep=sep,
         k=k, r=r, th=th, tw=tw, ext_h=ext_h, ext_w=ext_w, quantize=quantize,
-        convex=filt.convex,
+        convex=filt.convex, round_mode=_round_mode_for(taps, interpret),
     )
     # Propagate varying-mesh-axes so the kernel composes under shard_map
     # (check_vma needs the out type to declare what it varies over).
@@ -390,7 +430,7 @@ def axis_offset_classes(n_dev: int, block: int):
 
 def _fused_kernel(off_ref, hbm_ref, out_ref, scratch, sems, *,
                   taps, sep, k, r, T, th, tw, ext_h, ext_w, valid_hw,
-                  quantize, convex, grid_off=(0, 0),
+                  quantize, convex, round_mode, grid_off=(0, 0),
                   mask_rows=True, mask_cols=True):
     """T in-VMEM stencil levels on one (th + 2rT, tw + 2rT) window.
 
@@ -465,7 +505,7 @@ def _fused_kernel(off_ref, hbm_ref, out_ref, scratch, sems, *,
         ch, cw = th + 2 * r * (T - s), tw + 2 * r * (T - s)
         acc = _correlate_window(cur, taps, sep, k, ch, cw)
         if quantize:
-            acc = _quantize_acc(acc, convex)
+            acc = _quantize_acc(acc, convex, round_mode)
         # Level-s window starts r*s deeper; slice the hoisted iotas.
         if mask_rows:
             rows = rows0[r * s : r * s + ch, :]
@@ -555,7 +595,8 @@ def fused_iterate_pallas(
             k=k, r=r, T=T, th=th, tw=tw, ext_h=ext_h, ext_w=ext_w,
             valid_hw=(tuple(valid_hw)
                       if (mr or mc) and valid_hw is not None else None),
-            quantize=quantize, convex=filt.convex, grid_off=grid_off,
+            quantize=quantize, convex=filt.convex,
+            round_mode=_round_mode_for(taps, interpret), grid_off=grid_off,
             mask_rows=mr, mask_cols=mc,
         )
         cgh, cgw = grid_hw

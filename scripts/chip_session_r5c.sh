@@ -1,0 +1,71 @@
+#!/bin/sh
+# Round-5 third-window chip queue, re-armed by tunnel_watch.sh after the
+# FOURTH tunnel outage (died ~11:45 UTC 2026-07-31, mid-way through the
+# magic-round fuse re-sweep; rows landed so far are preserved in
+# evidence/fuse_sweep_magic_r5.jsonl.partial).
+#
+# The fuse-56 fill-in from r5b is DROPPED deliberately: it wedged a
+# 30-minute compile twice and fuse 40-48 is both the measured plateau
+# and the practical compile frontier (BASELINE.md round-5b section).
+#
+# Legs, ordered by value:
+#   1. bench.py sanity with the magic-round default -> the row the
+#      driver's end-of-round bench should reproduce (~146 u8/fuse32)
+#   2. profile_flagship: fresh trace + workload-differencing cross-check
+#      of the magic-round kernel (the 8-slot-floor claim)
+#   3. remaining fuse points (u8 32/40, bf16 32) for the re-sweep record
+set -x
+cd "$(dirname "$0")/.."
+
+timeout 60 python -c "import jax; print(jax.devices())" \
+  || { echo "tunnel dead; aborting chip session" >&2; exit 1; }
+
+LEG_TIMEOUT="${LEG_TIMEOUT:-1800}"
+
+# Unlike r5b's append-on-failure (whose legs emitted rows exactly once),
+# these legs recompute every row per attempt and the watcher refires
+# every 4 minutes — appending would duplicate rows in the evidence
+# ledger.  Keep whichever single attempt got furthest, and drop the
+# stale .partial once the full leg lands.
+run_to_keep() {
+  out="$1"; shift
+  if timeout "$LEG_TIMEOUT" "$@" \
+       > "$out.tmp" 2> "/tmp/$(basename "$out").err"; then
+    mv "$out.tmp" "$out" && rm -f "$out.partial" && echo "$out OK"
+  else
+    old=0
+    [ -e "$out.partial" ] && old=$(wc -c < "$out.partial")
+    if [ -s "$out.tmp" ] && [ "$(wc -c < "$out.tmp")" -gt "$old" ]; then
+      mv "$out.tmp" "$out.partial"
+      echo "$out FAILED; best attempt kept in $out.partial" >&2
+    else
+      rm -f "$out.tmp"
+      echo "$out FAILED (stderr: /tmp/$(basename "$out").err)" >&2
+    fi
+  fi
+}
+
+[ -e evidence/bench_r5c_sanity.json ] || \
+  run_to_keep evidence/bench_r5c_sanity.json python bench.py
+
+[ -e evidence/profile_flagship_magic_r5.jsonl ] || \
+  run_to_keep evidence/profile_flagship_magic_r5.jsonl \
+    python scripts/profile_flagship.py --size 8192 --fuse 32 --reps 3
+
+[ -e evidence/fuse_sweep_magic_r5.jsonl ] || \
+  run_to_keep evidence/fuse_sweep_magic_r5.jsonl python - <<'EOF'
+from parallel_convolution_tpu.utils.platform import (
+    apply_platform_env, enable_compile_cache)
+apply_platform_env(); enable_compile_cache()
+import json
+from parallel_convolution_tpu.ops.filters import get_filter
+from parallel_convolution_tpu.parallel.mesh import make_grid_mesh
+from parallel_convolution_tpu.utils import bench
+mesh = make_grid_mesh(); filt = get_filter("blur3")
+for storage, fuse in (("u8", 32), ("u8", 40), ("bf16", 32)):
+    row = bench.bench_iterate((8192, 8192), filt, 100, mesh=mesh,
+                              backend="pallas_sep", storage=storage,
+                              fuse=fuse, reps=3)
+    row["round_mode"] = "magic"
+    print(json.dumps(row), flush=True)
+EOF

@@ -52,6 +52,11 @@ STEPS_MB = (4, 12, 20, 32)
 def classify(msg: str) -> str:
     if "tpu_compile_helper" in msg or "HTTP 500" in msg:
         return "helper_http500"
+    # Trace-time rejections (Pallas refuses the kernel before any
+    # compile) must not masquerade as the compile-stage resource error
+    # this probe is hunting — the first run mislabeled exactly this.
+    if "Cannot store scalars" in msg or "TracerError" in msg:
+        return "probe_bug_trace_error"
     if "RESOURCE_EXHAUSTED" in msg or "VMEM" in msg or "vmem" in msg:
         return "clean_resource_error"
     return "other"
@@ -83,10 +88,14 @@ def main() -> int:
         rows = (mb * 1024 * 1024) // (512 * 4)
 
         def kernel(in_ref, out_ref, scratch):
-            # Touch one lane of the scratch so it cannot be elided, but
-            # keep the compute trivial: out = in + 1.
-            scratch[0, 0] = in_ref[0, 0]
-            out_ref[...] = in_ref[...] + 1.0 + (scratch[0, 0] * 0.0)
+            # Touch one row of the scratch so it cannot be elided, but
+            # keep the compute trivial: out = in + 1.  (A scalar store
+            # like scratch[0, 0] = ... is rejected by Pallas at TRACE
+            # time — "Cannot store scalars to VMEM" — which the first
+            # run of this probe hit on every rung, so no rung ever
+            # reached the compile stage.  Vector-shaped accesses only.)
+            scratch[0:1, :] = in_ref[0:1, :]
+            out_ref[...] = in_ref[...] + 1.0 + (scratch[0:1, 0:1] * 0.0)
 
         fn = pl.pallas_call(
             kernel,

@@ -1,28 +1,31 @@
 #!/bin/sh
-# Probe the TPU tunnel every 4 minutes; whenever it answers, fire
-# chip_session_r5b.sh (idempotent: [ -e ] guards skip landed legs).
-# Keeps looping until every guarded output exists — a mid-session
-# tunnel death (the recurring failure mode) re-arms instead of
-# abandoning the remaining legs.  Log: /tmp/tunnel_status.log.
+# Probe the TPU tunnel every 4 minutes; whenever it answers, fire the
+# current chip-session queue (idempotent: [ -e ] guards skip landed
+# legs).  Keeps looping until every guarded output exists — a
+# mid-session tunnel death (the recurring failure mode) re-arms instead
+# of abandoning the remaining legs.  Log: /tmp/tunnel_status.log.
+#
+# Round-5 third window: points at chip_session_r5c.sh (r5b's own legs
+# all landed 2026-07-31 ~10:13-10:45 UTC except the fuse-56 fill-in,
+# which wedged its compile twice and is dropped for cause).
 cd "$(dirname "$0")/.."
 
 all_landed() {
-  [ -e evidence/tiled_repro_r5b.jsonl ] \
-    && [ -e evidence/rdma_silicon_r5b.json ] \
-    && [ -e evidence/helper_crash_probe_r5.jsonl ] \
-    && [ -e evidence/tune_convex_r5b_fill.jsonl ]
+  [ -e evidence/bench_r5c_sanity.json ] \
+    && [ -e evidence/profile_flagship_magic_r5.jsonl ] \
+    && [ -e evidence/fuse_sweep_magic_r5.jsonl ]
 }
 
 while :; do
   if all_landed; then
-    echo "$(date -u) all r5b outputs landed — watcher exiting" >> /tmp/tunnel_status.log
+    echo "$(date -u) all r5c outputs landed — watcher exiting" >> /tmp/tunnel_status.log
     exit 0
   fi
   if timeout 60 python -c "import jax; print(jax.devices())" \
        >> /tmp/tunnel_status.log 2>&1; then
-    echo "$(date -u) tunnel UP — firing chip_session_r5b" >> /tmp/tunnel_status.log
-    sh scripts/chip_session_r5b.sh > /tmp/chip_session_r5b.log 2>&1
-    echo "$(date -u) chip_session_r5b pass finished" >> /tmp/tunnel_status.log
+    echo "$(date -u) tunnel UP — firing chip_session_r5c" >> /tmp/tunnel_status.log
+    sh scripts/chip_session_r5c.sh > /tmp/chip_session_r5c.log 2>&1
+    echo "$(date -u) chip_session_r5c pass finished rc=$?" >> /tmp/tunnel_status.log
   else
     echo "$(date -u) tunnel down" >> /tmp/tunnel_status.log
   fi

@@ -70,3 +70,54 @@ def test_sharded_pallas_backend(grey_odd):
     out = step.sharded_iterate(x, filt, 3, mesh=m, backend="pallas")
     got = imageio.planar_to_interleaved(np.asarray(out).astype(np.uint8))
     np.testing.assert_array_equal(got, want)
+
+
+def test_magic_round_identity_dense():
+    # The magic-number round ((x + 1.5*2^23) - 1.5*2^23) must equal
+    # np.rint (half-to-even) on a dense grid covering the quantize-mode
+    # range, INCLUDING exact .5 ties — under XLA, where the naive form
+    # would be algebraically folded away (measured on XLA:CPU: the round
+    # vanished entirely); the optimization_barrier form must survive.
+    import jax
+    import jax.numpy as jnp
+
+    xs = np.arange(-4.0 * 16, 260.0 * 16, dtype=np.float32) / 16.0  # .0625 grid
+    ties = np.arange(-4.0, 260.0, dtype=np.float32) + 0.5            # all ties
+    for v in (xs, ties):
+        got = np.asarray(jax.jit(
+            lambda x: jax.lax.optimization_barrier(
+                x + pallas_stencil._MAGIC) - pallas_stencil._MAGIC
+        )(jnp.asarray(v)))
+        np.testing.assert_array_equal(got, np.rint(v))
+
+
+def test_round_mode_selection():
+    blur_taps = (0.0625, 0.125, 0.0625, 0.125, 0.25, 0.125,
+                 0.0625, 0.125, 0.0625)
+    assert pallas_stencil._round_mode_for(blur_taps, interpret=True) == \
+        "magic_barrier"
+    assert pallas_stencil._round_mode_for(blur_taps, interpret=False) == \
+        "magic"
+    # A filter whose accumulator bound 255*L1 could leave the magic
+    # form's exact range falls back to rint.
+    huge = (9000.0,) * 9
+    assert pallas_stencil._round_mode_for(huge, interpret=False) == "rint"
+    assert pallas_stencil._round_mode_for(huge, interpret=True) == "rint"
+
+
+def test_quantize_acc_modes_agree():
+    # All three round modes compute the same function on quantize-range
+    # accs (interpret/XLA path uses the barrier form, Mosaic the bare
+    # form; silicon agreement is recorded in evidence/round_mode_ab_r5).
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    acc = rng.uniform(-2.0, 258.0, 4096).astype(np.float32)
+    acc[:512] = np.arange(512, dtype=np.float32) * 0.5  # exact ties
+    outs = {}
+    for mode in ("rint", "magic_barrier"):
+        outs[mode] = np.asarray(jax.jit(
+            lambda a, m=mode: pallas_stencil._quantize_acc(a, False, m)
+        )(jnp.asarray(acc)))
+    np.testing.assert_array_equal(outs["rint"], outs["magic_barrier"])
