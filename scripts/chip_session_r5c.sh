@@ -14,6 +14,11 @@
 #   2. profile_flagship: fresh trace + workload-differencing cross-check
 #      of the magic-round kernel (the 8-slot-floor claim)
 #   3. remaining fuse points (u8 32/40, bf16 32) for the re-sweep record
+#   4. silicon soak: the randomized byte-compare campaign (CPU record:
+#      520/520 across soak_r5/soak_converge_r5/soak_magic_r5) run on the
+#      real chip — random geometry/filter/storage configs Mosaic-compiled
+#      and byte-compared vs the oracle, magic round active (n=20:
+#      remote compiles dominate the wall)
 set -x
 cd "$(dirname "$0")/.."
 
@@ -69,3 +74,29 @@ for storage, fuse in (("u8", 32), ("u8", 40), ("bf16", 32)):
     row["round_mode"] = "magic"
     print(json.dumps(row), flush=True)
 EOF
+
+# Silicon soak, last (compile-heavy, lowest marginal value).  The soak's
+# exit code counts per-config failures, and on silicon a failed config
+# is itself a finding (its row records the error) — so the leg is
+# complete iff the terminal summary row exists, regardless of rc.
+# timeout kills python directly (no wrapper: an interposed shell would
+# orphan the workload on timeout); a crash/timeout before the summary
+# row keeps the best partial for the next watcher pass.
+if [ ! -e evidence/soak_silicon_r5.jsonl ]; then
+  out=evidence/soak_silicon_r5.jsonl
+  timeout "$LEG_TIMEOUT" python scripts/soak.py --n 20 --seed 21 \
+    > "$out.tmp" 2> /tmp/soak_silicon_r5.err
+  if grep -q '"summary"' "$out.tmp" 2>/dev/null; then
+    mv "$out.tmp" "$out" && rm -f "$out.partial" && echo "$out OK"
+  else
+    old=0
+    [ -e "$out.partial" ] && old=$(wc -c < "$out.partial")
+    if [ -s "$out.tmp" ] && [ "$(wc -c < "$out.tmp")" -gt "$old" ]; then
+      mv "$out.tmp" "$out.partial"
+      echo "$out incomplete; best attempt kept in $out.partial" >&2
+    else
+      rm -f "$out.tmp"
+      echo "$out incomplete (stderr: /tmp/soak_silicon_r5.err)" >&2
+    fi
+  fi
+fi

@@ -167,6 +167,13 @@ def main() -> int:
     from parallel_convolution_tpu.parallel import mesh as mesh_lib
     from parallel_convolution_tpu.parallel import step
     from parallel_convolution_tpu.utils import imageio
+    from parallel_convolution_tpu.utils.platform import enable_compile_cache
+
+    # On the real chip the wall is dominated by remote Mosaic compiles
+    # (one per sampled config); the persistent cache lets a timed-out
+    # campaign's retry resume instead of recompiling the same seed's
+    # configs from scratch.  No-op on the CPU mesh.
+    enable_compile_cache()
 
     rng = random.Random(args.seed)
     n_dev = len(jax.devices())
