@@ -32,30 +32,41 @@ LEG_TIMEOUT="${LEG_TIMEOUT:-1800}"
 # every 4 minutes — appending would duplicate rows in the evidence
 # ledger.  Keep whichever single attempt got furthest, and drop the
 # stale .partial once the full leg lands.
+# keep_best: after a failed/incomplete attempt, keep whichever single
+# attempt got furthest as $out.partial (shared by run_to_keep and the
+# summary-gated soak leg below so the heuristic cannot diverge).
+keep_best() {
+  out="$1"
+  old=0
+  [ -e "$out.partial" ] && old=$(wc -c < "$out.partial")
+  if [ -s "$out.tmp" ] && [ "$(wc -c < "$out.tmp")" -gt "$old" ]; then
+    mv "$out.tmp" "$out.partial"
+    echo "$out incomplete; best attempt kept in $out.partial" >&2
+  else
+    rm -f "$out.tmp"
+    echo "$out incomplete (stderr: /tmp/$(basename "$out").err)" >&2
+  fi
+}
+
 run_to_keep() {
   out="$1"; shift
   if timeout "$LEG_TIMEOUT" "$@" \
        > "$out.tmp" 2> "/tmp/$(basename "$out").err"; then
     mv "$out.tmp" "$out" && rm -f "$out.partial" && echo "$out OK"
   else
-    old=0
-    [ -e "$out.partial" ] && old=$(wc -c < "$out.partial")
-    if [ -s "$out.tmp" ] && [ "$(wc -c < "$out.tmp")" -gt "$old" ]; then
-      mv "$out.tmp" "$out.partial"
-      echo "$out FAILED; best attempt kept in $out.partial" >&2
-    else
-      rm -f "$out.tmp"
-      echo "$out FAILED (stderr: /tmp/$(basename "$out").err)" >&2
-    fi
+    keep_best "$out"
   fi
 }
 
 [ -e evidence/bench_r5c_sanity.json ] || \
   run_to_keep evidence/bench_r5c_sanity.json python bench.py
 
+# --ab re-asks the interior-split question under the magic round: the
+# rint removal changed the per-level op mix (8-slot floor), so the
+# round-5 null (1.004x) deserves one re-measure under the new kernel.
 [ -e evidence/profile_flagship_magic_r5.jsonl ] || \
   run_to_keep evidence/profile_flagship_magic_r5.jsonl \
-    python scripts/profile_flagship.py --size 8192 --fuse 32 --reps 3
+    python scripts/profile_flagship.py --size 8192 --fuse 32 --reps 3 --ab
 
 [ -e evidence/fuse_sweep_magic_r5.jsonl ] || \
   run_to_keep evidence/fuse_sweep_magic_r5.jsonl python - <<'EOF'
@@ -85,18 +96,10 @@ EOF
 if [ ! -e evidence/soak_silicon_r5.jsonl ]; then
   out=evidence/soak_silicon_r5.jsonl
   timeout "$LEG_TIMEOUT" python scripts/soak.py --n 20 --seed 21 \
-    > "$out.tmp" 2> /tmp/soak_silicon_r5.err
+    > "$out.tmp" 2> "/tmp/$(basename "$out").err"
   if grep -q '"summary"' "$out.tmp" 2>/dev/null; then
     mv "$out.tmp" "$out" && rm -f "$out.partial" && echo "$out OK"
   else
-    old=0
-    [ -e "$out.partial" ] && old=$(wc -c < "$out.partial")
-    if [ -s "$out.tmp" ] && [ "$(wc -c < "$out.tmp")" -gt "$old" ]; then
-      mv "$out.tmp" "$out.partial"
-      echo "$out incomplete; best attempt kept in $out.partial" >&2
-    else
-      rm -f "$out.tmp"
-      echo "$out incomplete (stderr: /tmp/soak_silicon_r5.err)" >&2
-    fi
+    keep_best "$out"
   fi
 fi
