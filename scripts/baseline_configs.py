@@ -64,8 +64,18 @@ def main() -> int:
 
     rows = []
 
+    # Provenance: which rint implementation the Pallas kernels resolve
+    # for the canonical blur3 taps on THIS platform — stamped on every
+    # row so the evidence file states which kernel produced it even if
+    # the library default changes later.
+    from parallel_convolution_tpu.ops.filters import get_filter as _gf
+    from parallel_convolution_tpu.ops.pallas_stencil import _round_mode_for
+
+    _blur_taps = tuple(float(t) for t in _gf("blur3").taps.reshape(-1))
+    round_mode = _round_mode_for(_blur_taps, interpret=not on_tpu())
+
     def emit(name, row):
-        row = {"config": name, **row}
+        row = {"config": name, "round_mode": round_mode, **row}
         rows.append(row)
         print(json.dumps(row), flush=True)
 

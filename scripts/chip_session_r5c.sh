@@ -11,14 +11,17 @@
 # Legs, ordered by value:
 #   1. bench.py sanity with the magic-round default -> the row the
 #      driver's end-of-round bench should reproduce (~146 u8/fuse32)
-#   2. profile_flagship: fresh trace + workload-differencing cross-check
-#      of the magic-round kernel (the 8-slot-floor claim)
-#   3. remaining fuse points (u8 32/40, bf16 32) for the re-sweep record
-#   4. silicon soak: the randomized byte-compare campaign (CPU record:
-#      520/520 across soak_r5/soak_converge_r5/soak_magic_r5) run on the
-#      real chip — random geometry/filter/storage configs Mosaic-compiled
-#      and byte-compared vs the oracle, magic round active (n=20:
-#      remote compiles dominate the wall)
+#   2. profile_flagship --ab: fresh trace + workload-differencing
+#      cross-check of the magic-round kernel (the 8-slot-floor claim)
+#      and the interior-split re-ask under the new op mix
+#   3. baseline_configs: refresh the five BASELINE config rows under
+#      the magic-round default (recorded rows predate the change)
+#   4. remaining fuse points (u8 32/40, bf16 32) for the re-sweep record
+#   5. silicon soak: the randomized byte-compare campaign (CPU record:
+#      1,120/1,120 across the recorded campaigns) run on the real chip —
+#      random geometry/filter/storage configs Mosaic-compiled and
+#      byte-compared vs the oracle, magic round active (n=20: remote
+#      compiles dominate the wall)
 set -x
 cd "$(dirname "$0")/.."
 
@@ -67,6 +70,23 @@ run_to_keep() {
 [ -e evidence/profile_flagship_magic_r5.jsonl ] || \
   run_to_keep evidence/profile_flagship_magic_r5.jsonl \
     python scripts/profile_flagship.py --size 8192 --fuse 32 --reps 3 --ab
+
+# Refresh the five BASELINE configs under the magic-round default — the
+# recorded config rows (evidence/baseline_tpu.json) predate the kernel
+# change.  Complete iff the LAST config's row exists (same
+# completion-gate pattern as the soak: a timed-out attempt keeps its
+# best partial, and the compile cache makes the retry resume warm
+# instead of recompiling configs it already passed).
+if [ ! -e evidence/baseline_configs_magic_r5.jsonl ]; then
+  out=evidence/baseline_configs_magic_r5.jsonl
+  timeout "$LEG_TIMEOUT" python scripts/baseline_configs.py \
+    > "$out.tmp" 2> "/tmp/$(basename "$out").err"
+  if grep -q '"config": "5:' "$out.tmp" 2>/dev/null; then
+    mv "$out.tmp" "$out" && rm -f "$out.partial" && echo "$out OK"
+  else
+    keep_best "$out"
+  fi
+fi
 
 [ -e evidence/fuse_sweep_magic_r5.jsonl ] || \
   run_to_keep evidence/fuse_sweep_magic_r5.jsonl python - <<'EOF'

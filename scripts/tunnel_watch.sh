@@ -13,6 +13,7 @@ cd "$(dirname "$0")/.."
 all_landed() {
   [ -e evidence/bench_r5c_sanity.json ] \
     && [ -e evidence/profile_flagship_magic_r5.jsonl ] \
+    && [ -e evidence/baseline_configs_magic_r5.jsonl ] \
     && [ -e evidence/soak_silicon_r5.jsonl ] \
     && [ -e evidence/fuse_sweep_magic_r5.jsonl ]
 }
