@@ -76,6 +76,30 @@ def main() -> int:
                   "diverge from the oracle — Mosaic may have started "
                   "folding the two-add round; see _round_mode_for",
                   file=sys.stderr)
+        elif pallas_stencil._MAGIC_GUARD.get("ok") is False:
+            if pallas_stencil._MAGIC_GUARD.get("cause") == "mismatch":
+                # The library-level probe caught the fold FIRST and
+                # already flipped every compiled kernel to rint — so the
+                # bytes above compare clean.  The fold event itself is
+                # still the terminal condition this guard exists to
+                # surface (a silent ~14% perf regression plus an
+                # unverified-compiler state), so report it as MISMATCH
+                # rather than letting the self-heal hide it.
+                magic_guard = "MISMATCH"
+                print("# MAGIC-ROUND GUARD: library probe detected the "
+                      "fold and fell back to rint — published bytes are "
+                      "correct, but the magic-round assumption is broken "
+                      "on this jax/Mosaic; see _compiled_magic_ok",
+                      file=sys.stderr)
+            else:
+                # The probe itself crashed (tunnel blip, OOM): kernels run
+                # rint conservatively and bytes are verified correct above
+                # — a RETRYABLE condition, distinct from a detected fold,
+                # so it must not trip the terminal-MISMATCH automation.
+                magic_guard = "library-probe-failed"
+                print("# MAGIC-ROUND GUARD: library probe errored (not a "
+                      "fold); kernels fell back to rint — transient, "
+                      "retryable", file=sys.stderr)
 
     # Size the workload to the hardware: big enough to saturate a TPU chip
     # (detected via device_kind — experimental proxy platforms report a
@@ -105,9 +129,13 @@ def main() -> int:
         ("pallas_sep+isplit", "bf16", 32, shape),
         # RDMA tier at a tiled-kernel-sized block: degenerate (no remote
         # partner) on a 1x1 mesh, but every driver round re-proves the
-        # kernel + barrier compile and run on real silicon (fuse=1 by
-        # design: the exchange lives inside the kernel).
+        # kernel + barrier compile and run on real silicon.  fuse=4 adds
+        # the in-kernel temporal fusion row (T*r-deep exchange + T levels
+        # per launch) — the tier's reason-to-exist lever; the RDMA-vs-
+        # ppermute small-block A/B lives in scripts/rdma_fuse_ab.py.
         ("pallas_rdma", "f32", 1,
+         (min(shape[0], 2048), min(shape[1], 2048))),
+        ("pallas_rdma", "f32", 4,
          (min(shape[0], 2048), min(shape[1], 2048))),
     ]
     candidates = {}

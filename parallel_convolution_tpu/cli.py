@@ -47,7 +47,9 @@ def _add_perf_args(p: argparse.ArgumentParser) -> None:
                         "bit-exact for u8 images")
     p.add_argument("--fuse", type=int, default=None, metavar="T",
                    help="iterations per halo exchange (temporal fusion; "
-                        "default 1)")
+                        "default 1).  All backends, pallas_rdma included: "
+                        "there the T*r-deep exchange AND the T levels run "
+                        "inside one kernel (needs blocks >= radius*T)")
     p.add_argument("--tile", default=None, metavar="TH,TW",
                    help="Pallas kernel output-tile override, e.g. "
                         "1024,512 (default: per-kernel tuned value; "

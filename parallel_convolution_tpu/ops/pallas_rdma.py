@@ -13,6 +13,17 @@ optimization: ONE kernel per device per iteration that
 2. computes the stencil level in the same program once its own ghosts
    arrive — no HBM round trip between exchange and compute.
 
+Temporal fusion (``fuse=T``): both kernels also amortize the exchange
+itself — the ghost transfers widen to depth ``T*r`` and T level-shrinking
+stencil iterations run in-kernel (the shared
+``pallas_stencil._iterate_levels`` loop, so quantize/round/tap threading
+is identical to the ppermute fused path).  One barrier + one exchange +
+one launch per T iterations is the persistent/partitioned-communication
+recipe for latency-bound stencils (PAPERS.md: persistent MPI stencils;
+the Cerebras wafer-scale in-fabric neighbor transfer), i.e. this tier's
+reason to exist at small blocks.  See DESIGN.md "RDMA temporal fusion"
+for the band-depth math and the win/retire decision rule.
+
 Corner propagation uses the same two-phase trick as halo.py: column slabs
 are sent at full padded height *after* the row-ghost receive semaphores
 fire, so corners take two hops and no diagonal messages exist.  Ghost
@@ -67,12 +78,39 @@ from jax.experimental.pallas import tpu as pltpu
 from parallel_convolution_tpu.ops.collective_ids import collective_id
 from parallel_convolution_tpu.ops.filters import Filter
 from parallel_convolution_tpu.ops.pallas_stencil import (
-    DEFAULT_TILE, _correlate_window, _from_f32, _prefetch_window,
-    _quantize_acc, _round_mode_for, _round_up, _sublane, _to_f32, on_tpu,
+    DEFAULT_TILE, _from_f32, _iterate_levels, _prefetch_window,
+    _round_mode_for, _round_up, _sublane, _to_f32, on_tpu,
+)
+from parallel_convolution_tpu.utils.jax_compat import (
+    hbm_scratch, shape_struct, tpu_compiler_params, tpu_interpret_params,
+    vma_of,
 )
 
 # Semaphore slots: one (send, recv) pair per direction.
 _UP, _DOWN, _LEFT, _RIGHT = 0, 1, 2, 3
+
+
+def _when(pred):
+    """``pl.when`` that statically elides python-bool predicates.
+
+    ``_topology`` reports extent-1 axes as python ``False`` (and periodic
+    multi-device axes as python ``True``); resolving those here keeps dead
+    guarded ops — remote-copy starts/waits that can never run — out of
+    the program entirely instead of emitting always-false branches.  The
+    degenerate single-device grid then contains no RDMA constructs at
+    all, which is also what lets it run under interpreters that lack the
+    remote-DMA/semaphore simulation.
+    """
+    if isinstance(pred, bool):
+        return (lambda f: f()) if pred else (lambda f: None)
+    return pl.when(pred)
+
+
+def _unless(pred):
+    """``pl.when(not pred)`` with the same static-bool elision."""
+    if isinstance(pred, bool):
+        return (lambda f: None) if pred else (lambda f: f())
+    return pl.when(jnp.logical_not(pred))
 
 
 def _neighbor_barrier(up_in, down_in, left_in, right_in, nbr):
@@ -96,6 +134,12 @@ def _neighbor_barrier(up_in, down_in, left_in, right_in, nbr):
     one.  Leftover signals (a neighbor already in N+2's barrier) simply
     pre-credit the next wait; counts stay balanced.
     """
+    if all(isinstance(e, bool) and not e
+           for e in (up_in, down_in, left_in, right_in)):
+        # No RDMA partner exists at all (single-device grid, or a torus
+        # of pure self-wrap axes): the rendezvous is vacuous — emit no
+        # barrier-semaphore traffic.
+        return
     dirs = [(up_in, nbr(-1, 0)), (down_in, nbr(+1, 0)),
             (left_in, nbr(0, -1)), (right_in, nbr(0, +1))]
     bsem = pltpu.get_barrier_semaphore()
@@ -125,10 +169,17 @@ def _topology(R, Cc, periodic):
     """
     x = lax.axis_index("x")
     y = lax.axis_index("y")
-    up_in = (x > 0) if not periodic else (R > 1)
-    down_in = (x < R - 1) if not periodic else (R > 1)
-    left_in = (y > 0) if not periodic else (Cc > 1)
-    right_in = (y < Cc - 1) if not periodic else (Cc > 1)
+    if periodic:
+        up_in = down_in = R > 1
+        left_in = right_in = Cc > 1
+    else:
+        # Extent-1 axes have statically no neighbor: report python False
+        # (not the always-false traced `x > 0`) so `_when` can elide the
+        # dead exchange ops entirely.
+        up_in = (x > 0) if R > 1 else False
+        down_in = (x < R - 1) if R > 1 else False
+        left_in = (y > 0) if Cc > 1 else False
+        right_in = (y < Cc - 1) if Cc > 1 else False
 
     def nbr(dx, dy):
         if periodic:
@@ -139,36 +190,45 @@ def _topology(R, Cc, periodic):
 
 
 def _rdma_kernel(in_ref, out_ref, pad, send_sem, recv_sem, *,
-                 taps, sep, k, r, C, h, w, R, Cc, periodic, quantize,
-                 convex, round_mode):
-    """One device's program: exchange ghosts in-kernel, then stencil.
+                 taps, sep, k, r, T, C, h, w, R, Cc, periodic, quantize,
+                 convex, round_mode, valid_hw):
+    """One device's program: exchange T·r-deep ghosts in-kernel, then run
+    T stencil levels (temporal fusion — ONE exchange buys T iterations).
 
-    ``pad`` is the (C, h+2r, w+2r) f32 working buffer; interior = my block,
-    ghost ring = RDMA'd from neighbors (or zeros at a non-periodic image
-    boundary).  All slab math mirrors halo.halo_exchange exactly.
+    ``pad`` is the (C, h+2d, w+2d) f32 working buffer, d = r*T; interior =
+    my block, ghost ring = RDMA'd from neighbors (or zeros at a
+    non-periodic image boundary).  All slab math mirrors
+    halo.halo_exchange at depth d.  The compute is the shared
+    level-shrinking loop (``pallas_stencil._iterate_levels``): for T > 1,
+    ``valid_hw`` re-zeroes out-of-image positions after every level — the
+    oracle's ghost ring at each intermediate — so results stay bit-exact
+    with T single-exchange steps.  ``valid_hw=None`` (fuse=1, or the
+    periodic torus) statically drops the masks: the validated
+    single-level protocol is byte-identical to before.
     """
+    d = r * T
     # Interior + boundary-ghost initialization.  Inbound RDMA targets are
     # exactly the ghost regions owned by an existing neighbor, so local
     # writes below never overlap a remote write (no ordering needed).
-    pad[:, r : r + h, r : r + w] = _to_f32(in_ref[...])
+    pad[:, d : d + h, d : d + w] = _to_f32(in_ref[...])
 
     up_in, down_in, left_in, right_in, nbr = _topology(R, Cc, periodic)
 
-    zero_row = jnp.zeros((C, r, w), jnp.float32)
-    zero_col = jnp.zeros((C, h + 2 * r, r), jnp.float32)
+    zero_row = jnp.zeros((C, d, w), jnp.float32)
+    zero_col = jnp.zeros((C, h + 2 * d, d), jnp.float32)
 
-    @pl.when(jnp.logical_not(up_in))
+    @_unless(up_in)
     def _():
-        pad[:, 0:r, r : r + w] = zero_row
+        pad[:, 0:d, d : d + w] = zero_row
 
-    @pl.when(jnp.logical_not(down_in))
+    @_unless(down_in)
     def _():
-        pad[:, h + r : h + 2 * r, r : r + w] = zero_row
+        pad[:, h + d : h + 2 * d, d : d + w] = zero_row
 
     if periodic and R == 1:
         # Torus of height 1: my own opposite edge wraps to me (static).
-        pad[:, 0:r, r : r + w] = pad[:, h : h + r, r : r + w]
-        pad[:, h + r : h + 2 * r, r : r + w] = pad[:, r : 2 * r, r : r + w]
+        pad[:, 0:d, d : d + w] = pad[:, h : h + d, d : d + w]
+        pad[:, h + d : h + 2 * d, d : d + w] = pad[:, d : 2 * d, d : d + w]
 
     # Cross-invocation safety: no remote copy may be issued until every
     # RDMA partner has entered THIS invocation (see _neighbor_barrier).
@@ -176,65 +236,78 @@ def _rdma_kernel(in_ref, out_ref, pad, send_sem, recv_sem, *,
     # and drop out statically.
     _neighbor_barrier(up_in, down_in, left_in, right_in, nbr)
 
-    # --- Phase 1: rows.  My top interior rows -> upper neighbor's bottom
-    # ghost; my bottom interior rows -> lower neighbor's top ghost.
+    # --- Phase 1: rows.  My top d interior rows -> upper neighbor's
+    # bottom ghost; my bottom d interior rows -> lower neighbor's top
+    # ghost (d <= h, enforced at the launch).
     send_up = pltpu.make_async_remote_copy(
-        pad.at[:, r : 2 * r, r : r + w],
-        pad.at[:, h + r : h + 2 * r, r : r + w],
+        pad.at[:, d : 2 * d, d : d + w],
+        pad.at[:, h + d : h + 2 * d, d : d + w],
         send_sem.at[_UP], recv_sem.at[_UP], device_id=nbr(-1, 0),
     )
     send_down = pltpu.make_async_remote_copy(
-        pad.at[:, h : h + r, r : r + w],
-        pad.at[:, 0:r, r : r + w],
+        pad.at[:, h : h + d, d : d + w],
+        pad.at[:, 0:d, d : d + w],
         send_sem.at[_DOWN], recv_sem.at[_DOWN], device_id=nbr(+1, 0),
     )
     if not (periodic and R == 1):
-        pl.when(up_in)(send_up.start)
-        pl.when(down_in)(send_down.start)
-        pl.when(up_in)(send_up.wait_send)
-        pl.when(down_in)(send_down.wait_send)
+        _when(up_in)(send_up.start)
+        _when(down_in)(send_down.start)
+        _when(up_in)(send_up.wait_send)
+        _when(down_in)(send_down.wait_send)
         # My bottom ghost is written by my lower neighbor's send_up copy,
         # which signals MY recv_sem[_UP] (SPMD symmetry), and vice versa.
-        pl.when(down_in)(send_up.wait_recv)
-        pl.when(up_in)(send_down.wait_recv)
+        _when(down_in)(send_up.wait_recv)
+        _when(up_in)(send_down.wait_recv)
 
     # --- Phase 2: columns at FULL padded height (includes the row ghosts
     # that just arrived -> corners propagate in two hops, halo.py §order).
     if periodic and Cc == 1:
-        pad[:, :, 0:r] = pad[:, :, w : w + r]
-        pad[:, :, w + r : w + 2 * r] = pad[:, :, r : 2 * r]
+        pad[:, :, 0:d] = pad[:, :, w : w + d]
+        pad[:, :, w + d : w + 2 * d] = pad[:, :, d : 2 * d]
     else:
 
-        @pl.when(jnp.logical_not(left_in))
+        @_unless(left_in)
         def _():
-            pad[:, :, 0:r] = zero_col
+            pad[:, :, 0:d] = zero_col
 
-        @pl.when(jnp.logical_not(right_in))
+        @_unless(right_in)
         def _():
-            pad[:, :, w + r : w + 2 * r] = zero_col
+            pad[:, :, w + d : w + 2 * d] = zero_col
 
         send_left = pltpu.make_async_remote_copy(
-            pad.at[:, :, r : 2 * r],
-            pad.at[:, :, w + r : w + 2 * r],
+            pad.at[:, :, d : 2 * d],
+            pad.at[:, :, w + d : w + 2 * d],
             send_sem.at[_LEFT], recv_sem.at[_LEFT], device_id=nbr(0, -1),
         )
         send_right = pltpu.make_async_remote_copy(
-            pad.at[:, :, w : w + r],
-            pad.at[:, :, 0:r],
+            pad.at[:, :, w : w + d],
+            pad.at[:, :, 0:d],
             send_sem.at[_RIGHT], recv_sem.at[_RIGHT], device_id=nbr(0, +1),
         )
-        pl.when(left_in)(send_left.start)
-        pl.when(right_in)(send_right.start)
-        pl.when(left_in)(send_left.wait_send)
-        pl.when(right_in)(send_right.wait_send)
-        pl.when(right_in)(send_left.wait_recv)
-        pl.when(left_in)(send_right.wait_recv)
+        _when(left_in)(send_left.start)
+        _when(right_in)(send_right.start)
+        _when(left_in)(send_left.wait_send)
+        _when(right_in)(send_right.wait_send)
+        _when(right_in)(send_left.wait_recv)
+        _when(left_in)(send_right.wait_recv)
 
-    # --- Compute: one stencil level on the fully-padded block.
+    # --- Compute: T stencil levels on the fully-padded block (shared
+    # level loop — identical op order / quantize / tap threading to the
+    # ppermute fused path).  Level-0 out-of-image positions are already
+    # exact zeros (boundary ghosts zeroed above; the pad-to-multiple rim
+    # is zero by the iterate's masking invariant), so no level-0 select
+    # tier is needed — only the per-level rank-1 re-zeroing.
+    rows0 = cols0 = None
+    if valid_hw is not None:
+        rows0 = (lax.axis_index("x") * h - d
+                 + lax.broadcasted_iota(jnp.int32, (h + 2 * d, 1), 0))
+        cols0 = (lax.axis_index("y") * w - d
+                 + lax.broadcasted_iota(jnp.int32, (1, w + 2 * d), 1))
     for c in range(C):
-        acc = _correlate_window(pad[c], taps, sep, k, h, w)
-        if quantize:
-            acc = _quantize_acc(acc, convex, round_mode)
+        acc = _iterate_levels(
+            pad[c], taps=taps, sep=sep, k=k, r=r, T=T, out_hw=(h, w),
+            quantize=quantize, convex=convex, round_mode=round_mode,
+            rows0=rows0, cols0=cols0, valid_hw=valid_hw)
         out_ref[c] = _from_f32(acc, out_ref.dtype)
 
 
@@ -250,11 +323,13 @@ def _rdma_kernel(in_ref, out_ref, pad, send_sem, recv_sem, *,
 # points keep HBM DMA *starts* tiling-aligned (Mosaic requires aligned
 # slice starts; interpret mode does not check — see ``_sublane``):
 #
-# 1. **Aligned-band transfers.**  Ghost slabs are r wide, which is never
-#    aligned.  Instead each transfer moves a full (sublane, 128)-aligned
-#    band — ``sub_v`` rows / 128 cols of interior — whose LAST (first) r
-#    rows/cols land exactly on the receiver's ghost positions; the rest of
-#    the band falls on never-read buffer and is masked at compute.
+# 1. **Aligned-band transfers.**  Ghost slabs are r*T wide (T = temporal
+#    fusion depth), which is never aligned.  Instead each transfer moves
+#    a full (sublane, 128)-aligned band — ``sub_v`` rows / 128 cols of
+#    interior — whose LAST (first) r*T rows/cols land exactly on the
+#    receiver's ghost positions (hence the r*T <= min(sub_v, 128)
+#    constraint); the rest of the band falls on never-read buffer and is
+#    masked at compute.
 # 2. **No ghost zeroing.**  Image-boundary ghosts stay uninitialized in
 #    HBM; every compute window applies one select against the block's
 #    valid [row_lo, row_hi) × [col_lo, col_hi) box (which also kills any
@@ -282,9 +357,11 @@ _TILED_VMEM_BYTES = 10 * 2**20  # monolithic-kernel budget before auto-tiling
 
 
 def _rdma_tiled_kernel(in_ref, out_ref, pad, win, wsems, xsem, send_sem,
-                       recv_sem, *, taps, sep, k, r, C, h, w, R, Cc,
-                       periodic, quantize, convex, th, tw, sub_v, round_mode):
+                       recv_sem, *, taps, sep, k, r, T, C, h, w, R, Cc,
+                       periodic, quantize, convex, th, tw, sub_v, round_mode,
+                       valid_hw):
     LANE = 128
+    d = r * T  # ghost depth; <= min(sub_v, LANE) so one band carries it
     ext_h, ext_w = th + 2 * sub_v, tw + 2 * LANE
     c, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     ni, nj = pl.num_programs(1), pl.num_programs(2)
@@ -305,12 +382,12 @@ def _rdma_tiled_kernel(in_ref, out_ref, pad, win, wsems, xsem, send_sem,
         # Phase 1: row bands (interior cols only; ghost cols not yet live).
         if periodic and R == 1:
             # Torus of height 1: own opposite edge, local aligned copies.
-            for s, d, sl in (((sub_v, 2 * sub_v), (h + sub_v, h + 2 * sub_v),
-                              _UP),
-                             ((h, h + sub_v), (0, sub_v), _DOWN)):
+            for src, dst, sl in (((sub_v, 2 * sub_v),
+                                  (h + sub_v, h + 2 * sub_v), _UP),
+                                 ((h, h + sub_v), (0, sub_v), _DOWN)):
                 cp = pltpu.make_async_copy(
-                    pad.at[:, s[0] : s[1], LANE : LANE + w],
-                    pad.at[:, d[0] : d[1], LANE : LANE + w],
+                    pad.at[:, src[0] : src[1], LANE : LANE + w],
+                    pad.at[:, dst[0] : dst[1], LANE : LANE + w],
                     send_sem.at[sl])
                 cp.start()
                 cp.wait()
@@ -325,22 +402,23 @@ def _rdma_tiled_kernel(in_ref, out_ref, pad, win, wsems, xsem, send_sem,
                 pad.at[:, 0:sub_v, LANE : LANE + w],
                 send_sem.at[_DOWN], recv_sem.at[_DOWN], device_id=nbr(+1, 0),
             )
-            pl.when(up_in)(send_up.start)
-            pl.when(down_in)(send_down.start)
-            pl.when(up_in)(send_up.wait_send)
-            pl.when(down_in)(send_down.wait_send)
-            pl.when(down_in)(send_up.wait_recv)
-            pl.when(up_in)(send_down.wait_recv)
+            _when(up_in)(send_up.start)
+            _when(down_in)(send_down.start)
+            _when(up_in)(send_up.wait_send)
+            _when(down_in)(send_down.wait_send)
+            _when(down_in)(send_up.wait_recv)
+            _when(up_in)(send_down.wait_recv)
 
         # Phase 2: column bands at FULL padded height — the transferred
         # bands carry the just-arrived row ghosts, so corners propagate in
         # two hops exactly as in halo.py / the monolithic kernel.
         if periodic and Cc == 1:
-            for s, d, sl in (((LANE, 2 * LANE), (w + LANE, w + 2 * LANE),
-                              _LEFT),
-                             ((w, w + LANE), (0, LANE), _RIGHT)):
+            for src, dst, sl in (((LANE, 2 * LANE),
+                                  (w + LANE, w + 2 * LANE), _LEFT),
+                                 ((w, w + LANE), (0, LANE), _RIGHT)):
                 cp = pltpu.make_async_copy(
-                    pad.at[:, :, s[0] : s[1]], pad.at[:, :, d[0] : d[1]],
+                    pad.at[:, :, src[0] : src[1]],
+                    pad.at[:, :, dst[0] : dst[1]],
                     send_sem.at[sl])
                 cp.start()
                 cp.wait()
@@ -355,12 +433,12 @@ def _rdma_tiled_kernel(in_ref, out_ref, pad, win, wsems, xsem, send_sem,
                 pad.at[:, :, 0:LANE],
                 send_sem.at[_RIGHT], recv_sem.at[_RIGHT], device_id=nbr(0, +1),
             )
-            pl.when(left_in)(send_left.start)
-            pl.when(right_in)(send_right.start)
-            pl.when(left_in)(send_left.wait_send)
-            pl.when(right_in)(send_right.wait_send)
-            pl.when(right_in)(send_left.wait_recv)
-            pl.when(left_in)(send_right.wait_recv)
+            _when(left_in)(send_left.start)
+            _when(right_in)(send_right.start)
+            _when(left_in)(send_left.wait_send)
+            _when(right_in)(send_right.wait_send)
+            _when(right_in)(send_left.wait_recv)
+            _when(left_in)(send_right.wait_recv)
 
     # --- Compute: the _stencil_kernel windowed-DMA grid over the HBM pad.
     def window_copy(cc, ii, jj, s):
@@ -370,39 +448,52 @@ def _rdma_tiled_kernel(in_ref, out_ref, pad, win, wsems, xsem, send_sem,
 
     slot = _prefetch_window(window_copy)
 
-    # Valid box of the block in padded coords; outside it live
-    # image-boundary ghosts (zero semantics) and never-written buffer.
-    # Periodic: EVERY ghost is valid (filled by wrap or remote band) even
-    # on a self-wrap axis, where the exchange predicate is False.
+    # Valid box of the block in padded coords (ghost ring d deep); outside
+    # it live image-boundary ghosts (zero semantics) and never-written
+    # buffer.  Periodic: EVERY ghost is valid (filled by wrap or remote
+    # band) even on a self-wrap axis, where the exchange predicate is
+    # False.
     def _i32(p):
         return jnp.int32(p) if isinstance(p, bool) else p.astype(jnp.int32)
 
-    row_lo = sub_v - (r if periodic else r * _i32(up_in))
-    row_hi = sub_v + h + (r if periodic else r * _i32(down_in))
-    col_lo = LANE - (r if periodic else r * _i32(left_in))
-    col_hi = LANE + w + (r if periodic else r * _i32(right_in))
+    row_lo = sub_v - (d if periodic else d * _i32(up_in))
+    row_hi = sub_v + h + (d if periodic else d * _i32(down_in))
+    col_lo = LANE - (d if periodic else d * _i32(left_in))
+    col_hi = LANE + w + (d if periodic else d * _i32(right_in))
 
-    w0h, w0w = th + 2 * r, tw + 2 * r
-    rows = (i * th + (sub_v - r)
+    w0h, w0w = th + 2 * d, tw + 2 * d
+    rows = (i * th + (sub_v - d)
             + lax.broadcasted_iota(jnp.int32, (w0h, 1), 0))
-    cols = (j * tw + (LANE - r)
+    cols = (j * tw + (LANE - d)
             + lax.broadcasted_iota(jnp.int32, (1, w0w), 1))
     ok = (((rows >= row_lo) & (rows < row_hi))
           & ((cols >= col_lo) & (cols < col_hi)))
-    cur = _to_f32(win[slot][sub_v - r : sub_v + r + th,
-                           LANE - r : LANE + r + tw])
+    cur = _to_f32(win[slot][sub_v - d : sub_v + d + th,
+                           LANE - d : LANE + d + tw])
     cur = jnp.where(ok, cur, 0.0)
 
-    acc = _correlate_window(cur, taps, sep, k, th, tw)
-    if quantize:
-        acc = _quantize_acc(acc, convex, round_mode)
+    # T in-VMEM levels (shared level loop).  For T > 1 the per-level
+    # re-zeroing needs GLOBAL image coordinates (the pad-to-multiple rim
+    # is in-block but out-of-image); pad row p maps to global row
+    # x*h + p - sub_v, so shift the hoisted pad-coordinate iotas.  The
+    # tier-1 select above already killed every non-finite DMA garbage
+    # value, so the rank-1 multiplies are exact.
+    rows0 = cols0 = None
+    if valid_hw is not None:
+        rows0 = rows + (lax.axis_index("x") * h - sub_v)
+        cols0 = cols + (lax.axis_index("y") * w - LANE)
+    acc = _iterate_levels(cur, taps=taps, sep=sep, k=k, r=r, T=T,
+                          out_hw=(th, tw), quantize=quantize, convex=convex,
+                          round_mode=round_mode, rows0=rows0, cols0=cols0,
+                          valid_hw=valid_hw)
     out_ref[0] = _from_f32(acc, out_ref.dtype)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("filt", "grid", "boundary", "quantize", "out_dtype",
-                     "interpret", "tiled", "tile", "pad_operand"),
+                     "interpret", "tiled", "tile", "pad_operand", "fuse",
+                     "valid_hw"),
 )
 def fused_rdma_step(
     block: jnp.ndarray,
@@ -415,13 +506,30 @@ def fused_rdma_step(
     tiled: bool | None = None,
     tile: tuple[int, int] | None = None,
     pad_operand: bool | None = None,
+    fuse: int = 1,
+    valid_hw: tuple[int, int] | None = None,
 ) -> jnp.ndarray:
-    """One halo-exchange + stencil iteration, entirely inside one kernel.
+    """``fuse`` halo-fused stencil iterations, entirely inside one kernel.
 
     Must be called inside ``shard_map`` over the ('x','y') mesh; ``block``
-    is the local (C, h, w) tile.  Semantically identical to
-    ``halo.halo_exchange`` followed by the one-step correlate (+ optional
-    u8 quantization) — see tests/test_rdma.py for the bit-exactness proof.
+    is the local (C, h, w) tile.  Semantically identical to a depth
+    ``r*fuse`` ``halo.halo_exchange`` followed by ``fuse`` level-shrinking
+    correlates (+ optional u8 quantization per level) — see
+    tests/test_rdma.py for the bit-exactness proof.
+
+    ``fuse=T>1`` is temporal fusion INSIDE the RDMA tier: the ghost
+    transfers widen to depth T·r and the kernel runs T stencil levels
+    before returning to HBM — one exchange setup, one neighbor barrier,
+    one kernel launch per T iterations, which is exactly the lever the
+    latency-bound small-block regime this tier exists for needs
+    (DESIGN.md "RDMA temporal fusion").  It requires ``valid_hw`` — the
+    global (H, W) image extent — for zero boundaries, because each
+    intermediate level must re-zero out-of-image positions (the oracle's
+    ghost ring); the caller (``parallel/step.py``) threads it
+    automatically.  Constraints: ``min(h, w) >= r*fuse`` (monolithic slab
+    depth), and for the tiled variant ``r*fuse <= min(sublane, 128)`` so
+    the one-tile-deep aligned transfer bands still carry every live ghost
+    row/col.
 
     ``tiled=None`` auto-selects: blocks whose monolithic VMEM footprint
     (f32 padded buffer + output) exceeds ``_TILED_VMEM_BYTES`` use the
@@ -452,51 +560,71 @@ def fused_rdma_step(
     if interpret is True:
         # Plain-bool callers (the step builder resolves interpret from the
         # MESH platform) get the DMA-faithful interpreter configuration.
-        interpret = pltpu.InterpretParams(dma_execution_mode="on_wait")
+        interpret = tpu_interpret_params(dma_execution_mode="on_wait")
     if out_dtype is None:
         out_dtype = block.dtype
     C, h, w = block.shape
     r, k = filt.radius, filt.size
-    if min(h, w) < r:
-        raise ValueError(f"block {(h, w)} smaller than filter radius {r}")
+    T = int(fuse)
+    if T < 1:
+        raise ValueError(f"fuse must be >= 1, got {fuse}")
+    d = r * T
+    if min(h, w) < d:
+        raise ValueError(
+            f"block {(h, w)} smaller than the ghost depth r*fuse = {d} "
+            f"(radius {r} x fuse {T}); use a smaller fuse or coarser mesh")
+    periodic = boundary == "periodic"
+    if T > 1 and not periodic and valid_hw is None:
+        raise ValueError(
+            "fuse > 1 with a zero boundary needs valid_hw — the global "
+            "(H, W) image extent — so every intermediate level can re-zero "
+            "its out-of-image positions (the oracle's ghost ring)")
+    # Normalized static mask key for the kernels: None statically drops
+    # per-level masking (single level, or the torus where every position
+    # is valid).
+    kern_valid = (None if (T == 1 or periodic)
+                  else (int(valid_hw[0]), int(valid_hw[1])))
     sep = None  # rank-1 split saves little at one level; keep 2D order
     taps = tuple(float(t) for t in filt.taps.reshape(-1))
-    periodic = boundary == "periodic"
-    vma = getattr(jax.typeof(block), "vma", frozenset())
-    cparams = pltpu.CompilerParams(
+    vma = vma_of(block)
+    cparams = tpu_compiler_params(
         collective_id=collective_id("rdma_halo_stencil"),
         has_side_effects=True,
     )
 
     sub_v = _sublane(block.dtype)
     if tiled is None:
-        mono_bytes = (C * (h + 2 * r) * (w + 2 * r) * 4
+        mono_bytes = (C * (h + 2 * d) * (w + 2 * d) * 4
                       + C * h * w * jnp.dtype(out_dtype).itemsize)
         tiled = mono_bytes > _TILED_VMEM_BYTES
-        if tiled and (r > min(sub_v, 128) or h < sub_v or w < 128):
+        if tiled and (d > min(sub_v, 128) or h < sub_v or w < 128):
             # Silently falling back to the monolithic kernel here would
             # trade this clear error for an opaque Mosaic VMEM failure.
             raise ValueError(
                 f"block {(C, h, w)} needs ~{mono_bytes >> 20} MB of VMEM "
                 f"(over the {_TILED_VMEM_BYTES >> 20} MB monolithic "
-                f"budget) but the tiled kernel requires radius <= "
-                f"{min(sub_v, 128)} (got {r}) and blocks >= "
-                f"({sub_v}, 128); use a finer or differently-shaped mesh")
+                f"budget) but the tiled kernel requires ghost depth "
+                f"r*fuse <= {min(sub_v, 128)} (got {d}) and blocks >= "
+                f"({sub_v}, 128); use a finer or differently-shaped mesh, "
+                "or a shallower fuse")
 
     # interpret here is False (silicon) or InterpretParams — the barrier
     # form is needed exactly when XLA (not Mosaic) executes the kernel.
-    round_mode = _round_mode_for(taps, interpret is not False)
+    # round_mode is dead when not quantizing: skip the selector (and the
+    # compiled-probe guard it consults on silicon) entirely.
+    round_mode = (_round_mode_for(taps, interpret is not False)
+                  if quantize else "rint")
     if not tiled:
         kernel = functools.partial(
-            _rdma_kernel, taps=taps, sep=sep, k=k, r=r, C=C, h=h, w=w,
+            _rdma_kernel, taps=taps, sep=sep, k=k, r=r, T=T, C=C, h=h, w=w,
             R=grid[0], Cc=grid[1], periodic=periodic, quantize=quantize,
-            convex=filt.convex, round_mode=round_mode,
+            convex=filt.convex, round_mode=round_mode, valid_hw=kern_valid,
         )
         return pl.pallas_call(
             kernel,
-            out_shape=jax.ShapeDtypeStruct((C, h, w), out_dtype, vma=vma),
+            out_shape=shape_struct((C, h, w), out_dtype, vma),
             scratch_shapes=[
-                pltpu.VMEM((C, h + 2 * r, w + 2 * r), jnp.float32),
+                pltpu.VMEM((C, h + 2 * d, w + 2 * d), jnp.float32),
                 pltpu.SemaphoreType.DMA((4,)),
                 pltpu.SemaphoreType.DMA((4,)),
             ],
@@ -505,10 +633,12 @@ def fused_rdma_step(
         )(block)
 
     # ---- tiled variant ----
-    if r > min(sub_v, 128):
+    if d > min(sub_v, 128):
         raise ValueError(
-            f"tiled RDMA kernel needs radius <= {min(sub_v, 128)} "
-            f"(aligned-band ghost transfers), got {r}")
+            f"tiled RDMA kernel needs ghost depth r*fuse <= "
+            f"{min(sub_v, 128)} (the aligned transfer bands are one "
+            f"({sub_v}, 128) tile deep and their trailing/leading r*fuse "
+            f"rows/cols must all be live ghosts), got r*fuse = {d}")
     if h < sub_v or w < 128:
         # A band narrower than the block would make src/dst of the band
         # copies overlap (undefined for real DMA engines even though the
@@ -532,10 +662,10 @@ def fused_rdma_step(
     w_pad = max((gw - 1) * tw + ext_w, w + 2 * LANE)
 
     kernel = functools.partial(
-        _rdma_tiled_kernel, taps=taps, sep=sep, k=k, r=r, C=C, h=h, w=w,
-        R=grid[0], Cc=grid[1], periodic=periodic, quantize=quantize,
+        _rdma_tiled_kernel, taps=taps, sep=sep, k=k, r=r, T=T, C=C, h=h,
+        w=w, R=grid[0], Cc=grid[1], periodic=periodic, quantize=quantize,
         convex=filt.convex, th=th, tw=tw, sub_v=sub_v,
-        round_mode=round_mode,
+        round_mode=round_mode, valid_hw=kern_valid,
     )
     vmem_scratch = [
         pltpu.VMEM((2, ext_h, ext_w), block.dtype),
@@ -567,10 +697,8 @@ def fused_rdma_step(
             in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
             out_specs=(pl.BlockSpec((1, th, tw), lambda c, i, j: (c, i, j)),
                        pl.BlockSpec(memory_space=pl.ANY)),
-            out_shape=(jax.ShapeDtypeStruct((C, gh * th, gw * tw),
-                                            out_dtype, vma=vma),
-                       jax.ShapeDtypeStruct((C, h_pad, w_pad),
-                                            block.dtype, vma=vma)),
+            out_shape=(shape_struct((C, gh * th, gw * tw), out_dtype, vma),
+                       shape_struct((C, h_pad, w_pad), block.dtype, vma)),
             scratch_shapes=vmem_scratch,
             compiler_params=cparams,
             interpret=interpret,
@@ -581,10 +709,9 @@ def fused_rdma_step(
         grid=(C, gh, gw),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec((1, th, tw), lambda c, i, j: (c, i, j)),
-        out_shape=jax.ShapeDtypeStruct((C, gh * th, gw * tw), out_dtype,
-                                       vma=vma),
-        scratch_shapes=[pltpu.MemorySpace.HBM((C, h_pad, w_pad),
-                                              block.dtype)] + vmem_scratch,
+        out_shape=shape_struct((C, gh * th, gw * tw), out_dtype, vma),
+        scratch_shapes=[hbm_scratch((C, h_pad, w_pad),
+                                    block.dtype)] + vmem_scratch,
         compiler_params=cparams,
         interpret=interpret,
     )(block)

@@ -23,6 +23,7 @@ kernel drops into the sharded step as a backend with no semantic change.
 from __future__ import annotations
 
 import functools
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -30,6 +31,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from parallel_convolution_tpu.ops.filters import Filter
+from parallel_convolution_tpu.utils.jax_compat import shape_struct, vma_of
 
 # Default output-tile shapes: multiples of the f32 (8, 128) VMEM tile.
 # Two defaults because Mosaic's scoped-VMEM stack scales differently per
@@ -183,11 +185,108 @@ def _round_mode_for(taps, interpret) -> str:
     ``lax.optimization_barrier``.  Mosaic neither folds (the silicon
     byte-proof above) nor implements the barrier primitive, so compiled
     kernels use the bare form.
+
+    Because "Mosaic never folds" has no semantic guarantee, the first
+    compiled (non-interpret) quantized build in a process runs a one-time
+    byte-guard — a tiny compiled kernel vs the NumPy oracle
+    (``_compiled_magic_ok``, ADVICE r5).  On mismatch every compiled
+    kernel falls back to ``rint`` with a loud warning, so CLI/library
+    users on a future jax/Mosaic upgrade lose ~14% throughput, never
+    correct bytes.
     """
     l1 = sum(abs(float(t)) for t in taps)
     if 255.0 * l1 >= 2.0**21:  # 2x safety margin under the 2**22 bound
         return "rint"
-    return "magic_barrier" if interpret else "magic"
+    if interpret:
+        return "magic_barrier"
+    return "magic" if _compiled_magic_ok() else "rint"
+
+
+# Process-wide magic-round guard state: ``ok`` None = not yet probed;
+# ``probing`` breaks the probe's own recursion into _round_mode_for (the
+# probe kernel must build the very form under test); ``cause`` records
+# WHY ok went False — "mismatch" (the compiler really folds the round; a
+# terminal condition for automation) vs "probe-error" (the probe itself
+# crashed; retryable — same conservative rint fallback, different verdict).
+_MAGIC_GUARD = {"ok": None, "probing": False, "cause": None}
+
+
+def _probe_magic_round() -> bool:
+    """Byte-compare ONE tiny compiled quantized kernel vs the NumPy oracle.
+
+    Two chained quantized blur3 steps on a deterministic 16×128 grey
+    plane — enough to catch a compiler that folds the two-add round (the
+    rounding then vanishes and bytes diverge on the first store-back).
+    Runs exactly once per process, on the first compiled quantized kernel
+    build (sub-second next to any real workload's compile).
+    """
+    import numpy as np
+
+    from parallel_convolution_tpu.ops import oracle
+    from parallel_convolution_tpu.ops.filters import get_filter
+
+    filt = get_filter("blur3")
+    rng = np.random.default_rng(12)
+    img = rng.integers(0, 256, size=(16, 128)).astype(np.uint8)
+    want = oracle.run_serial_u8(img, filt, 2)
+    # The selector — and hence this probe — is reached from INSIDE the
+    # caller's jit trace (every quantized entry point is @jax.jit), where
+    # np.asarray(got) would see a tracer and kill the probe on every
+    # compiled build (reproduced: TracerArrayConversionError -> permanent
+    # rint fallback).  jax trace state is thread-local, so a worker
+    # thread starts from the eval trace — escaping the ambient trace
+    # while keeping the probe's own inner jit/pallas compile intact
+    # (ensure_compile_time_eval would instead disable the inner jit and
+    # eval the pallas_call eagerly, which has no eval rules).
+    import concurrent.futures
+
+    def run():
+        got = jnp.asarray(img[None], jnp.float32)
+        for _ in range(2):
+            got = correlate_shifted_pallas(got, filt, quantize=True,
+                                           interpret=False)
+        return np.asarray(got)
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=1) as ex:
+        got = ex.submit(run).result()
+    return bool(np.array_equal(got[0].astype(np.uint8), want))
+
+
+def _compiled_magic_ok() -> bool:
+    """One-time compiled-magic-round byte-guard, cached per process.
+
+    True → compiled kernels keep the two-add magic round.  False (byte
+    mismatch, or the probe itself failed) → fall back to ``jnp.rint``
+    with a RuntimeWarning: correctness must not hinge on an unverified
+    compiler non-folding guarantee.  The driver bench's end-to-end guard
+    (bench.py ``magic_round_guard``) stays as the independent second
+    layer; this one protects CLI/library entry points too.
+    """
+    st = _MAGIC_GUARD
+    if st["probing"]:
+        return True  # the probe's own kernel builds the form under test
+    if st["ok"] is None:
+        st["probing"] = True
+        try:
+            st["ok"] = _probe_magic_round()
+            if not st["ok"]:
+                st["cause"] = "mismatch"
+                warnings.warn(
+                    "magic-round byte-guard MISMATCH: a compiled quantized "
+                    "kernel diverged from the oracle (the compiler may now "
+                    "fold the two-add round) — falling back to jnp.rint "
+                    "for all compiled kernels this process",
+                    RuntimeWarning, stacklevel=3)
+        except Exception as e:  # probe failure: bytes unverified
+            st["ok"] = False
+            st["cause"] = "probe-error"
+            warnings.warn(
+                f"magic-round byte-guard probe failed ({e!r}) — falling "
+                "back to jnp.rint for all compiled kernels this process",
+                RuntimeWarning, stacklevel=3)
+        finally:
+            st["probing"] = False
+    return st["ok"]
 
 
 def _quantize_acc(acc, convex, round_mode):
@@ -294,18 +393,21 @@ def correlate_padded_pallas(
     kernel = functools.partial(
         _stencil_kernel, taps=taps, sep=sep,
         k=k, r=r, th=th, tw=tw, ext_h=ext_h, ext_w=ext_w, quantize=quantize,
-        convex=filt.convex, round_mode=_round_mode_for(taps, interpret),
+        convex=filt.convex,
+        round_mode=(_round_mode_for(taps, interpret) if quantize
+                    else "rint"),  # unused when not quantizing: skip the
+                                   # compiled-probe guard a float build
+                                   # would otherwise pay for nothing
     )
     # Propagate varying-mesh-axes so the kernel composes under shard_map
     # (check_vma needs the out type to declare what it varies over).
-    vma = getattr(jax.typeof(padded), "vma", frozenset())
+    vma = vma_of(padded)
     out = pl.pallas_call(
         kernel,
         grid=(C, gh, gw),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec((1, th, tw), lambda c, i, j: (c, i, j)),
-        out_shape=jax.ShapeDtypeStruct((C, gh * th, gw * tw), out_dtype,
-                                       vma=vma),
+        out_shape=shape_struct((C, gh * th, gw * tw), out_dtype, vma),
         scratch_shapes=[
             pltpu.VMEM((2, ext_h, ext_w), padded.dtype),
             pltpu.SemaphoreType.DMA((2,)),
@@ -428,6 +530,43 @@ def axis_offset_classes(n_dev: int, block: int):
     return [(0, 0), (block, last - block), (last, last)]
 
 
+def _iterate_levels(cur, *, taps, sep, k, r, T, out_hw, quantize, convex,
+                    round_mode, rows0=None, cols0=None, valid_hw=None):
+    """T level-shrinking stencil levels: (oh + 2rT, ow + 2rT) f32 → (oh, ow).
+
+    The single source of the temporal-fusion compute shape, shared by the
+    ppermute fused kernel (``_fused_kernel``) and both RDMA fuse>1 kernels
+    (``ops/pallas_rdma.py``) so the quantize path — magic round included —
+    and the tap chain (2D or separable ``sep``) are threaded identically
+    everywhere.
+
+    Per level the window shrinks by r; ``rows0``/``cols0`` are the hoisted
+    GLOBAL-coordinate iotas of the level-0 window ((w0h, 1) / (1, w0w));
+    when present, out-of-``valid_hw`` positions of every level are
+    re-zeroed with the cheap rank-1 broadcast multiplies — exactly the
+    oracle's ghost ring at every intermediate level.  ``None`` statically
+    drops that mask axis (periodic torus, or a provably-interior launch).
+    Every level-0 value must already be finite (the caller's select tier)
+    — a multiplicative mask would leak NaN otherwise.
+    """
+    oh, ow = out_hw
+    H, W = valid_hw if valid_hw is not None else (None, None)
+    for s in range(1, T + 1):
+        ch, cw = oh + 2 * r * (T - s), ow + 2 * r * (T - s)
+        acc = _correlate_window(cur, taps, sep, k, ch, cw)
+        if quantize:
+            acc = _quantize_acc(acc, convex, round_mode)
+        # Level-s window starts r*s deeper; slice the hoisted iotas.
+        if rows0 is not None:
+            rows = rows0[r * s : r * s + ch, :]
+            acc = acc * ((rows >= 0) & (rows < H)).astype(jnp.float32)
+        if cols0 is not None:
+            cols = cols0[:, r * s : r * s + cw]
+            acc = acc * ((cols >= 0) & (cols < W)).astype(jnp.float32)
+        cur = acc
+    return cur
+
+
 def _fused_kernel(off_ref, hbm_ref, out_ref, scratch, sems, *,
                   taps, sep, k, r, T, th, tw, ext_h, ext_w, valid_hw,
                   quantize, convex, round_mode, grid_off=(0, 0),
@@ -468,6 +607,7 @@ def _fused_kernel(off_ref, hbm_ref, out_ref, scratch, sems, *,
     cur = _to_f32(scratch[slot][: th + 2 * r * T, : tw + 2 * r * T])
     mask_rows = mask_rows and valid_hw is not None
     mask_cols = mask_cols and valid_hw is not None
+    rows0 = cols0 = None
     if mask_rows or mask_cols:
         # Ghost-ring masking in two tiers (no tier at all = periodic
         # torus or a provably-interior launch):
@@ -501,19 +641,10 @@ def _fused_kernel(off_ref, hbm_ref, out_ref, scratch, sems, *,
             okc0 = (cols0 >= 0) & (cols0 < W)
             ok0 = okc0 if ok0 is None else (ok0 & okc0)
         cur = jnp.where(ok0, cur, 0.0)
-    for s in range(1, T + 1):
-        ch, cw = th + 2 * r * (T - s), tw + 2 * r * (T - s)
-        acc = _correlate_window(cur, taps, sep, k, ch, cw)
-        if quantize:
-            acc = _quantize_acc(acc, convex, round_mode)
-        # Level-s window starts r*s deeper; slice the hoisted iotas.
-        if mask_rows:
-            rows = rows0[r * s : r * s + ch, :]
-            acc = acc * ((rows >= 0) & (rows < H)).astype(jnp.float32)
-        if mask_cols:
-            cols = cols0[:, r * s : r * s + cw]
-            acc = acc * ((cols >= 0) & (cols < W)).astype(jnp.float32)
-        cur = acc
+    cur = _iterate_levels(cur, taps=taps, sep=sep, k=k, r=r, T=T,
+                          out_hw=(th, tw), quantize=quantize, convex=convex,
+                          round_mode=round_mode, rows0=rows0, cols0=cols0,
+                          valid_hw=valid_hw)
     out_ref[0] = _from_f32(cur, out_ref.dtype)
 
 
@@ -585,7 +716,7 @@ def fused_iterate_pallas(
         padded = jnp.pad(padded, ((0, 0), (0, max(eh, 0)), (0, max(ew, 0))))
 
     taps = tuple(float(t) for t in filt.taps.reshape(-1))
-    vma = getattr(jax.typeof(padded), "vma", frozenset())
+    vma = vma_of(padded)
     off32 = offsets.astype(jnp.int32)
 
     def call(grid_hw, grid_off, mask_axes=(True, True)):
@@ -596,7 +727,9 @@ def fused_iterate_pallas(
             valid_hw=(tuple(valid_hw)
                       if (mr or mc) and valid_hw is not None else None),
             quantize=quantize, convex=filt.convex,
-            round_mode=_round_mode_for(taps, interpret), grid_off=grid_off,
+            round_mode=(_round_mode_for(taps, interpret) if quantize
+                        else "rint"),  # dead when not quantizing
+            grid_off=grid_off,
             mask_rows=mr, mask_cols=mc,
         )
         cgh, cgw = grid_hw
@@ -608,8 +741,7 @@ def fused_iterate_pallas(
                 pl.BlockSpec(memory_space=pl.ANY),
             ],
             out_specs=pl.BlockSpec((1, th, tw), lambda c, i, j: (c, i, j)),
-            out_shape=jax.ShapeDtypeStruct((C, cgh * th, cgw * tw),
-                                           out_dtype, vma=vma),
+            out_shape=shape_struct((C, cgh * th, cgw * tw), out_dtype, vma),
             scratch_shapes=[
                 pltpu.VMEM((2, ext_h, ext_w), padded.dtype),
                 pltpu.SemaphoreType.DMA((2,)),

@@ -41,6 +41,7 @@ from parallel_convolution_tpu.parallel.mesh import (
     padded_extent,
 )
 from parallel_convolution_tpu.utils.config import BACKENDS  # canonical list
+from parallel_convolution_tpu.utils.jax_compat import shard_map
 
 __all__ = ["BACKENDS", "STORAGE_DTYPES", "sharded_iterate", "sharded_converge",
            "iterate_prepared"]
@@ -108,9 +109,6 @@ def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
     r = filt.radius
 
     rdma = backend == "pallas_rdma"
-    if rdma and fuse != 1:
-        raise ValueError("backend 'pallas_rdma' supports fuse=1 only "
-                         "(the exchange lives inside the kernel)")
     pallas_like = backend in ("pallas", "pallas_sep")
     sep = backend == "pallas_sep"
 
@@ -131,13 +129,18 @@ def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
         if rdma:
             # Exchange + stencil fused in ONE kernel (remote DMA over ICI
             # instead of collective-permute + concatenate + re-read).
+            # fuse=T>1 widens the in-kernel exchange to T*r-deep ghosts
+            # and runs T levels before returning — the kernel re-zeroes
+            # out-of-image positions per level against valid_hw, so the
+            # outer mask is only needed on the single-level path.
             from parallel_convolution_tpu.ops import pallas_rdma
 
             p = pallas_rdma.fused_rdma_step(
                 v, filt, grid, boundary, quantize=quantize,
                 out_dtype=v.dtype, tile=tile, interpret=interpret,
+                fuse=fuse, valid_hw=None if periodic else tuple(valid_hw),
             )
-            if needs_mask:
+            if needs_mask and fuse == 1:
                 p = p * _valid_mask(valid_hw, block_hw).astype(p.dtype)
             return p
         depth = r * fuse
@@ -243,7 +246,7 @@ def _build_iterate(mesh: Mesh, filt: Filter, iters: int, quantize: bool,
             block = tail(block)
         return block
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         body, mesh=mesh, in_specs=P(None, *AXES), out_specs=P(None, *AXES),
         check_vma=False,  # pallas interpret-mode slices trip the vma checker
     )
@@ -321,7 +324,7 @@ def _build_converge(mesh: Mesh, filt: Filter, tol: float, max_iters: int,
         cur, done, _ = lax.while_loop(cond, chunk, init)
         return cur, lax.pmax(done, AXES)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         body, mesh=mesh, in_specs=P(None, *AXES),
         out_specs=(P(None, *AXES), P()),
         check_vma=False,  # pallas interpret-mode slices trip the vma checker

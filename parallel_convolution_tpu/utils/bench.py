@@ -28,6 +28,7 @@ from parallel_convolution_tpu.parallel import halo, step as step_lib
 from parallel_convolution_tpu.parallel.mesh import (
     AXES, block_sharding, grid_shape, make_grid_mesh,
 )
+from parallel_convolution_tpu.utils.jax_compat import shard_map
 from parallel_convolution_tpu.utils.platform import (
     needs_readback_fence as _needs_readback_fence,
     timing_mode,
@@ -237,7 +238,7 @@ def halo_bench_rounds(mesh, grid, r: int, n: int, exchange: bool):
 
         return jax.lax.fori_loop(0, n, one, v)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         body, mesh=mesh, in_specs=P(None, *AXES), out_specs=P(None, *AXES),
     ))
 

@@ -1,0 +1,111 @@
+"""Version-adaptive aliases for jax APIs that moved after 0.4.x.
+
+The framework is written against current jax — ``jax.shard_map``,
+varying-mesh-axes (``vma``) out-types, ``pltpu.CompilerParams`` /
+``InterpretParams`` / ``MemorySpace.HBM`` — but must also run on a stock
+jax 0.4.x install (no tunnel, no site hooks), where those APIs either
+live under older names (``jax.experimental.shard_map``,
+``TPUCompilerParams``, ``TPUMemorySpace.ANY``) or do not exist at all
+(the DMA-faithful TPU interpreter with ``dma_execution_mode`` /
+``detect_races``).  Every alias here resolves the NEW api first, so on
+current jax this module is a pure pass-through and behavior is
+byte-identical; on old jax it degrades to the nearest equivalent.
+
+The one capability that cannot be bridged is the faithful TPU
+interpreter: 0.4.x's generic Pallas interpreter has no lowering for
+barrier semaphores or remote DMA, so the RDMA kernels (and their
+CPU-mesh protocol tests) need either current jax or real silicon.
+``HAS_TPU_INTERPRET`` gates those paths: tests skip with an explicit
+reason instead of failing on a missing lowering.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+from jax.experimental.pallas import tpu as pltpu
+
+# True when the DMA-faithful TPU interpreter (semaphores, remote copies,
+# race detector on the virtual CPU mesh) exists in this jax.
+HAS_TPU_INTERPRET = hasattr(pltpu, "InterpretParams")
+
+# True on current jax (top-level ``jax.shard_map``).  A few tests pin
+# behaviors of the CURRENT stack that old jax/jaxlib genuinely lack —
+# the shard_map lowering's exact collective-permute shapes, XLA:CPU FMA
+# contraction discipline, CPU multiprocess collectives — and skip (not
+# fail) where those capabilities are absent.
+IS_MODERN_JAX = hasattr(jax, "shard_map")
+
+
+if hasattr(jax, "shard_map"):
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+        # 0.4.x keyword: check_rep (replication checker, the vma
+        # checker's ancestor) — same "off" escape hatch semantics.
+        return _shard_map_old(f, mesh, in_specs, out_specs,
+                              check_rep=check_vma)
+
+
+def vma_of(x):
+    """Varying-mesh-axes of ``x``'s type, or None where jax predates vma.
+
+    Callers thread the result straight into :func:`shape_struct`; None
+    means "don't declare vma" (old jax has no checker to satisfy).
+    """
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return None
+    return getattr(typeof(x), "vma", frozenset())
+
+
+def shape_struct(shape, dtype, vma=None):
+    """``jax.ShapeDtypeStruct`` with ``vma`` only where supported."""
+    if vma is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams(**kwargs)`` under either API generation.
+
+    Old jax calls the class ``TPUCompilerParams`` and lacks some fields
+    (e.g. ``has_side_effects``); unsupported kwargs are dropped — they
+    only matter to Mosaic compiles, which old-jax environments (no
+    faithful interpreter, CPU-only) never reach.
+    """
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+        allowed = inspect.signature(cls).parameters
+        kwargs = {k: v for k, v in kwargs.items() if k in allowed}
+    return cls(**kwargs)
+
+
+def tpu_interpret_params(**kwargs):
+    """The DMA-faithful interpreter config, or plain ``True`` without it.
+
+    Current jax: ``pltpu.InterpretParams(**kwargs)`` (simulated remote
+    DMAs, semaphores, optional race detector).  Old jax: the generic
+    interpreter bool — enough for single-device windowed-DMA kernels,
+    NOT for the RDMA protocol (see ``HAS_TPU_INTERPRET``).
+    """
+    cls = getattr(pltpu, "InterpretParams", None)
+    if cls is None:
+        return True
+    return cls(**kwargs)
+
+
+def hbm_scratch(shape, dtype):
+    """An HBM scratch entry: ``MemorySpace.HBM`` or old ``ANY`` space."""
+    ms = getattr(pltpu, "MemorySpace", None)
+    if ms is not None and hasattr(ms, "HBM"):
+        return ms.HBM(shape, dtype)
+    return pltpu.TPUMemorySpace.ANY(shape, dtype)

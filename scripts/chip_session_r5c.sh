@@ -61,8 +61,32 @@ run_to_keep() {
   fi
 }
 
-[ -e evidence/bench_r5c_sanity.json ] || \
-  run_to_keep evidence/bench_r5c_sanity.json python bench.py
+# Leg 1 gates completion on a RESULT ROW being present, not on exit
+# code: bench.py deliberately exits 1 on a magic-guard MISMATCH while
+# still printing the full labeled row, and run_to_keep's rc-based gate
+# would park that row in .partial and let the watcher refire the whole
+# session every 4 minutes forever — an unbounded chip-burning retry on a
+# condition retrying cannot heal.  A MISMATCH is TERMINAL: preserve the
+# evidence, drop the halt sentinel tunnel_watch.sh checks, and stop.
+if [ ! -e evidence/bench_r5c_sanity.json ]; then
+  out=evidence/bench_r5c_sanity.json
+  timeout "$LEG_TIMEOUT" python bench.py \
+    > "$out.tmp" 2> "/tmp/$(basename "$out").err"
+  if grep -q '"magic_round_guard": "MISMATCH"' "$out.tmp" 2>/dev/null; then
+    mv "$out.tmp" "$out.MISMATCH"
+    touch evidence/HALT_r5c
+    echo "magic_round_guard=MISMATCH — terminal failure; row preserved" \
+         "in $out.MISMATCH, HALT_r5c dropped for the watcher" >&2
+    exit 2
+  elif grep -q '"best_backend"' "$out.tmp" 2>/dev/null; then
+    # "best_backend" only appears in a real result row; the
+    # all-backends-failed error row also carries "metric" and must stay
+    # retryable (transients heal), not land as final evidence.
+    mv "$out.tmp" "$out" && rm -f "$out.partial" && echo "$out OK"
+  else
+    keep_best "$out"
+  fi
+fi
 
 # --ab re-asks the interior-split question under the magic round: the
 # rint removal changed the per-level op mix (8-slot floor), so the

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""A/B: the RDMA tier vs the ppermute path across temporal-fusion depths.
+
+VERDICT item 3: "give the RDMA tier a reason to exist, or retire it."
+The tier was built for the latency-bound small-block regime, where the
+per-iteration cost is dominated by exchange setup — exactly what
+temporal fusion amortizes (fuse=T: one T*r-deep exchange, T in-kernel
+levels).  This harness prices both paths on the SAME small-block
+workload across fuse ∈ {1,2,4,8} and byte-checks every configuration
+against the serial oracle, emitting JSONL rows for the evidence ledger:
+
+* one row per (path, fuse): the standard bench_iterate row plus
+  ``oracle_bytes_ok`` (bit-exactness of a deterministic run) and an
+  ``interpret`` flag (off-TPU rows time the interpreter/XLA:CPU — a
+  mechanism proof, NOT a perf claim; the decision row needs silicon);
+* one summary row with the per-fuse rdma/ppermute speedup ratios and
+  the win/retire reading DESIGN.md asks for.
+
+Runnable today on the CPU mesh (interpret mode); re-run unchanged on
+silicon at the next tunnel window for the decision numbers.
+
+Usage:
+  python scripts/rdma_fuse_ab.py                       # CPU mesh (8 virt.)
+  python scripts/rdma_fuse_ab.py --size 1024 --iters 64  # silicon regime
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import _path  # noqa: F401  (repo root onto sys.path)
+
+
+def _byte_check(backend, fuse, mesh, filt, iters):
+    """Bit-exactness of a deterministic small run vs the serial oracle."""
+    import numpy as np
+
+    from parallel_convolution_tpu.ops import oracle
+    from parallel_convolution_tpu.parallel import step
+    from parallel_convolution_tpu.utils import imageio
+
+    img = imageio.generate_test_image(64, 64, "grey", seed=9)
+    want = oracle.run_serial_u8(img, filt, iters)
+    x = imageio.interleaved_to_planar(img).astype(np.float32)
+    out = step.sharded_iterate(x, filt, iters, mesh=mesh, quantize=True,
+                               backend=backend, fuse=fuse)
+    got = imageio.planar_to_interleaved(np.asarray(out).astype(np.uint8))
+    return bool(np.array_equal(got, want))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=256,
+                    help="square image size; small by design — the "
+                         "latency-bound regime the RDMA tier targets")
+    ap.add_argument("--iters", type=int, default=16)
+    ap.add_argument("--reps", type=int, default=2)
+    ap.add_argument("--fuse", default="1,2,4,8",
+                    help="comma-separated fusion depths")
+    ap.add_argument("--mesh", default=None, help="RxC grid (default: all)")
+    ap.add_argument("--platform", default=None,
+                    help="force jax platform (e.g. cpu)")
+    args = ap.parse_args()
+
+    from parallel_convolution_tpu.utils.platform import (
+        apply_platform_env, enable_compile_cache, force_platform, on_tpu,
+    )
+
+    if args.platform:
+        force_platform(args.platform, warn=True)
+    else:
+        apply_platform_env()
+    enable_compile_cache()
+
+    import jax
+
+    from parallel_convolution_tpu.ops.filters import get_filter
+    from parallel_convolution_tpu.parallel.mesh import make_grid_mesh
+    from parallel_convolution_tpu.utils import bench
+
+    if args.mesh:
+        r, c = (int(v) for v in args.mesh.lower().split("x"))
+        mesh = make_grid_mesh(jax.devices()[: r * c], (r, c))
+    else:
+        mesh = make_grid_mesh()
+    filt = get_filter("blur3")
+    fuses = [int(v) for v in args.fuse.split(",")]
+    interp = not on_tpu()
+
+    # "ppermute" = the standard tier at the same workload: halo.py
+    # collective-permute exchange + the Pallas stencil kernel (fused
+    # T-level variant for fuse>1) — the path the RDMA kernel must beat.
+    rows = []
+    for fuse in fuses:
+        for label, backend in (("rdma", "pallas_rdma"), ("ppermute", "pallas")):
+            try:
+                row = bench.bench_iterate(
+                    (args.size, args.size), filt, args.iters, mesh=mesh,
+                    backend=backend, fuse=fuse, reps=args.reps)
+                row["oracle_bytes_ok"] = _byte_check(
+                    backend, fuse, mesh, filt, iters=2 * fuse)
+            except Exception as e:
+                row = {"backend": backend, "fuse": fuse,
+                       "error": repr(e)[:200]}
+            row["ab"] = "rdma_fuse"
+            row["path"] = label
+            row["interpret"] = interp
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+
+    by_fuse = {}
+    for r_ in rows:
+        if "error" in r_:
+            continue
+        by_fuse.setdefault(r_["fuse"], {})[r_["path"]] = r_
+    summary = {
+        "probe": "rdma_fuse_ab",
+        "workload": f"blur3 {args.size}x{args.size} {args.iters} iters, "
+                    f"mesh {'x'.join(str(s) for s in mesh.shape.values())}",
+        "interpret": interp,
+        # interpret rows prove bytes, never speed — only silicon rows may
+        # feed the win/retire decision
+        "perf_claim": not interp,
+        # False when every configuration errored: an A/B with zero
+        # completed rows has proven nothing and must not read as a pass.
+        "bytes_ok_all": bool(by_fuse) and all(
+            r_.get("oracle_bytes_ok", False)
+            for r_ in rows if "error" not in r_),
+    }
+    for fuse, d in sorted(by_fuse.items()):
+        if "rdma" in d and "ppermute" in d and d["rdma"]["wall_s"]:
+            summary[f"rdma_vs_ppermute[fuse{fuse}]"] = round(
+                d["ppermute"]["wall_s"] / d["rdma"]["wall_s"], 4)
+    errors = [r_ for r_ in rows if "error" in r_]
+    if errors:
+        summary["error_rows"] = len(errors)
+    print(json.dumps(summary), flush=True)
+    return 0 if summary["bytes_ok_all"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -93,6 +93,7 @@ def main() -> int:
 
     from parallel_convolution_tpu.ops import pallas_rdma
     from parallel_convolution_tpu.parallel.mesh import AXES
+    from parallel_convolution_tpu.utils.jax_compat import shard_map
 
     # Two sizes: a small block (fits the monolithic budget, still forced
     # through the tiled code path) and a block beyond the monolithic VMEM
@@ -102,7 +103,7 @@ def main() -> int:
                             ("tiled_variant", (2048, 2048))):
         timg = imageio.generate_test_image(th_, tw_, "grey", seed=14)
         xt = imageio.interleaved_to_planar(timg).astype(np.float32)
-        body = jax.shard_map(
+        body = shard_map(
             partial(pallas_rdma.fused_rdma_step, filt=filt, grid=(1, 1),
                     boundary="zero", quantize=True, tiled=True),
             mesh=mesh, in_specs=P(None, *AXES), out_specs=P(None, *AXES),

@@ -100,10 +100,14 @@ def main() -> int:
     if len(sys.argv) >= 2 and sys.argv[1] == "--child":
         return child(sys.argv[2], sys.argv[3])
 
+    import tempfile
+
     import numpy as np
 
-    outdir = "/tmp/round_mode_ab"
-    os.makedirs(outdir, exist_ok=True)
+    # Fresh per-invocation dir: a fixed path let a child that died before
+    # np.save silently byte-compare a STALE proof from an earlier run
+    # (spurious bitexact=true), or crash the parent on first use.
+    outdir = tempfile.mkdtemp(prefix="round_mode_ab_")
     rows = []
     for mode in ("rint", "magic"):
         p = subprocess.run(
@@ -123,12 +127,25 @@ def main() -> int:
                 rows.append(json.loads(line))
                 print(line, flush=True)
 
-    a = np.load(os.path.join(outdir, "proof_rint.npy"))
-    b = np.load(os.path.join(outdir, "proof_magic.npy"))
-    bitexact = bool(np.array_equal(a, b))
+    proofs, missing = {}, []
+    for mode in ("rint", "magic"):
+        path = os.path.join(outdir, f"proof_{mode}.npy")
+        if os.path.exists(path):
+            proofs[mode] = np.load(path)
+        else:
+            missing.append(mode)
     verdict = {"probe": "round_mode_ab byte-proof",
-               "workload": "blur3 512x640 u8 10 iters fused fuse=5",
-               "bitexact_rint_vs_magic": bitexact}
+               "workload": "blur3 512x640 u8 10 iters fused fuse=5"}
+    if missing:
+        # A child died before writing its proof: there is no comparison —
+        # say so (null verdict + the missing arms) instead of crashing or,
+        # worse, comparing leftovers.
+        bitexact = False
+        verdict["bitexact_rint_vs_magic"] = None
+        verdict["proof_missing"] = missing
+    else:
+        bitexact = bool(np.array_equal(proofs["rint"], proofs["magic"]))
+        verdict["bitexact_rint_vs_magic"] = bitexact
     by = {}
     for r in rows:
         key = f'{r["backend"]}/{r["storage"]}/fuse{r["fuse"]}'

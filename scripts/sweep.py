@@ -51,7 +51,10 @@ def main() -> int:
     for shape in mesh_shapes:
         ndev = shape[0] * shape[1]
         mesh = make_grid_mesh(jax.devices()[:ndev], shape)
-        for backend in ("shifted", "pallas", "xla_conv"):
+        # pallas_rdma sweeps the same fuse grid since the in-kernel
+        # temporal fusion landed; configs its guards reject (ghost depth
+        # vs block/band) land as labeled error rows like any other.
+        for backend in ("shifted", "pallas", "xla_conv", "pallas_rdma"):
             for storage in ("f32", "bf16"):
                 for fuse in (1, 4):
                     try:

@@ -19,6 +19,12 @@ all_landed() {
 }
 
 while :; do
+  if [ -e evidence/HALT_r5c ]; then
+    # Terminal failure (e.g. magic_round_guard=MISMATCH): retrying cannot
+    # heal it — stop instead of refiring the session every 4 minutes.
+    echo "$(date -u) HALT_r5c present (terminal failure) — watcher exiting" >> /tmp/tunnel_status.log
+    exit 1
+  fi
   if all_landed; then
     echo "$(date -u) all r5c outputs landed — watcher exiting" >> /tmp/tunnel_status.log
     exit 0

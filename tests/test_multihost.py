@@ -18,6 +18,8 @@ from pathlib import Path
 
 import pytest
 
+from parallel_convolution_tpu.utils.jax_compat import IS_MODERN_JAX
+
 _WORKER = Path(__file__).with_name("_multihost_worker.py")
 
 
@@ -27,6 +29,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.skipif(not IS_MODERN_JAX, reason="CPU multiprocess collectives unimplemented in old jaxlib")
 def test_two_process_distributed(tmp_path):
     from parallel_convolution_tpu.utils.platform import child_env_cpu
 

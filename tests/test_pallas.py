@@ -91,18 +91,88 @@ def test_magic_round_identity_dense():
         np.testing.assert_array_equal(got, np.rint(v))
 
 
-def test_round_mode_selection():
-    blur_taps = (0.0625, 0.125, 0.0625, 0.125, 0.25, 0.125,
-                 0.0625, 0.125, 0.0625)
-    assert pallas_stencil._round_mode_for(blur_taps, interpret=True) == \
+BLUR_TAPS = (0.0625, 0.125, 0.0625, 0.125, 0.25, 0.125,
+             0.0625, 0.125, 0.0625)
+
+
+def test_round_mode_selection(monkeypatch):
+    # Seed the compiled-magic byte-guard as passed: this test pins the
+    # SELECTOR logic; the guard itself (which would launch a real compiled
+    # probe kernel here) has its own tests below.
+    monkeypatch.setattr(pallas_stencil, "_MAGIC_GUARD",
+                        {"ok": True, "probing": False})
+    assert pallas_stencil._round_mode_for(BLUR_TAPS, interpret=True) == \
         "magic_barrier"
-    assert pallas_stencil._round_mode_for(blur_taps, interpret=False) == \
+    assert pallas_stencil._round_mode_for(BLUR_TAPS, interpret=False) == \
         "magic"
     # A filter whose accumulator bound 255*L1 could leave the magic
     # form's exact range falls back to rint.
     huge = (9000.0,) * 9
     assert pallas_stencil._round_mode_for(huge, interpret=False) == "rint"
     assert pallas_stencil._round_mode_for(huge, interpret=True) == "rint"
+
+
+def test_magic_guard_mismatch_falls_back(monkeypatch):
+    """Library-level magic-round byte-guard (ADVICE r5): a forced probe
+    MISMATCH must flip every compiled build to rint, warn loudly, and
+    cache the verdict so the probe runs once per process."""
+    calls = []
+
+    def probe():
+        calls.append(1)
+        return False
+
+    monkeypatch.setattr(pallas_stencil, "_probe_magic_round", probe)
+    monkeypatch.setattr(pallas_stencil, "_MAGIC_GUARD",
+                        {"ok": None, "probing": False})
+    with pytest.warns(RuntimeWarning, match="magic-round byte-guard"):
+        assert pallas_stencil._round_mode_for(
+            BLUR_TAPS, interpret=False) == "rint"
+    # A real byte mismatch is recorded as such — the terminal condition
+    # automation (bench.py magic_round_guard) keys on this cause.
+    assert pallas_stencil._MAGIC_GUARD["cause"] == "mismatch"
+    # Cached per process: the second compiled build must not re-probe.
+    assert pallas_stencil._round_mode_for(
+        BLUR_TAPS, interpret=False) == "rint"
+    assert len(calls) == 1
+    # Interpret-mode kernels use the barrier form and never consult the
+    # compiled guard.
+    assert pallas_stencil._round_mode_for(
+        BLUR_TAPS, interpret=True) == "magic_barrier"
+
+
+def test_magic_guard_probe_failure_falls_back(monkeypatch):
+    """A probe that ERRORS (not just mismatches) leaves bytes unverified:
+    same conservative rint fallback, same warning channel."""
+
+    def probe():
+        raise RuntimeError("no accelerator")
+
+    monkeypatch.setattr(pallas_stencil, "_probe_magic_round", probe)
+    monkeypatch.setattr(pallas_stencil, "_MAGIC_GUARD",
+                        {"ok": None, "probing": False})
+    with pytest.warns(RuntimeWarning, match="probe failed"):
+        assert pallas_stencil._round_mode_for(
+            BLUR_TAPS, interpret=False) == "rint"
+    # A crashed probe is NOT a detected fold: the cause stays distinct so
+    # automation treats it as retryable, never terminal.
+    assert pallas_stencil._MAGIC_GUARD["cause"] == "probe-error"
+
+
+def test_magic_guard_pass_keeps_magic(monkeypatch):
+    monkeypatch.setattr(pallas_stencil, "_probe_magic_round", lambda: True)
+    monkeypatch.setattr(pallas_stencil, "_MAGIC_GUARD",
+                        {"ok": None, "probing": False})
+    assert pallas_stencil._round_mode_for(
+        BLUR_TAPS, interpret=False) == "magic"
+
+
+def test_magic_guard_probe_recursion_breaks(monkeypatch):
+    """While the probe's own kernel builds, the guard must report magic
+    (the form under test) instead of recursing into another probe."""
+    monkeypatch.setattr(pallas_stencil, "_MAGIC_GUARD",
+                        {"ok": None, "probing": True})
+    assert pallas_stencil._compiled_magic_ok() is True
 
 
 def test_quantize_acc_modes_agree():

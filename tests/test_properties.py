@@ -7,6 +7,12 @@ for ANY odd filter, any image shape, any mesh that fits, any storage mode.
 
 import jax
 import numpy as np
+import pytest
+
+# Optional dev dependency (pyproject `dev` extra): without it the module
+# must SKIP, not break collection of the whole suite on minimal installs.
+pytest.importorskip("hypothesis")
+
 from hypothesis import given, settings, strategies as st
 
 from parallel_convolution_tpu.ops import filters as filters_lib, oracle

@@ -12,6 +12,8 @@ import hashlib
 import numpy as np
 import pytest
 
+from parallel_convolution_tpu.utils.jax_compat import IS_MODERN_JAX
+
 from parallel_convolution_tpu.ops import filters, oracle
 from parallel_convolution_tpu.utils import imageio
 
@@ -52,6 +54,7 @@ def test_oracle_f32_pinned():
     assert _digest(out) == "223143e6491f0418"
 
 
+@pytest.mark.skipif(not IS_MODERN_JAX, reason="float-mode FMA contraction pin holds on the current XLA:CPU; old jaxlib rounds the shifted path differently")
 def test_float_mode_fma_contract():
     """Round-5 soak find, pinned: f32 FLOAT-mode chained runs live in the
     rounding regime, where the compiled backends' single-rounding FMA

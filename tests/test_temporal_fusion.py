@@ -7,6 +7,7 @@ import pytest
 from parallel_convolution_tpu.ops import filters, oracle
 from parallel_convolution_tpu.parallel import mesh as mesh_lib, step
 from parallel_convolution_tpu.utils import imageio
+from parallel_convolution_tpu.utils.jax_compat import IS_MODERN_JAX
 
 
 def _mesh(shape):
@@ -77,6 +78,7 @@ def _slab_depths(fn, xs):
     return {min(int(a), int(b)) for a, b in shapes}
 
 
+@pytest.mark.skipif(not IS_MODERN_JAX, reason="HLO slab-shape pin targets the current shard_map lowering (old lowerings emit extra collective-permutes)")
 def test_fused_halo_exchanges_deep_slabs(grey_small):
     # fuse=5 must exchange 5-deep halo slabs once per chunk (1/5 the
     # collective rounds of fuse=1, whose slabs are 1-deep).
@@ -93,6 +95,7 @@ def test_fused_halo_exchanges_deep_slabs(grey_small):
     assert depths(5) == {5}
 
 
+@pytest.mark.skipif(not IS_MODERN_JAX, reason="HLO slab-shape pin targets the current shard_map lowering (old lowerings emit extra collective-permutes)")
 def test_fused_convergence_exchanges_deep_slabs(grey_small):
     """The round-4 fused convergence path must carry the same structural
     saving: inside the while_loop chunk, fused steps exchange fuse-deep
