@@ -60,6 +60,11 @@ def _add_perf_args(p: argparse.ArgumentParser) -> None:
                         "backends: per-device edge-class launches skip "
                         "ghost-ring masking on provably-interior tiles "
                         "(bit-identical; no-op for fuse=1 and periodic)")
+    p.add_argument("--fallback", action="store_true",
+                   help="graceful backend degradation: probe the backend "
+                        "once and walk pallas_rdma -> pallas -> shifted "
+                        "on a transient compile/launch failure instead of "
+                        "dying (the effective backend is printed/stamped)")
     p.add_argument("--fast", action="store_true",
                    help="on a TPU, fill any knob NOT explicitly passed "
                         "with the measured flagship family "
@@ -126,10 +131,14 @@ def _mesh_from_flag(spec: str | None):
 
 
 def main(argv: list[str] | None = None) -> int:
+    from parallel_convolution_tpu.resilience import faults
     from parallel_convolution_tpu.utils.config import BOUNDARIES
     from parallel_convolution_tpu.utils.platform import apply_platform_env
 
     apply_platform_env()
+    # Honor PCTPU_FAULTS so injected-fault drills run end-to-end through
+    # the real CLI (no-op unless the env var is set).
+    faults.install_from_env()
     ap = argparse.ArgumentParser(prog="pconv-tpu", description=__doc__)
     sub = ap.add_subparsers(dest="cmd", required=True)
 
@@ -308,7 +317,7 @@ def main(argv: list[str] | None = None) -> int:
             channels=3 if args.mode == "rgb" else 1,
             interior_split=args.interior_split,
             backend=args.backend, storage=args.storage, fuse=args.fuse,
-            reps=args.reps, tile=tile,
+            reps=args.reps, tile=tile, fallback=args.fallback,
         )
         if note:
             row["platform_note"] = note
@@ -343,7 +352,8 @@ def main(argv: list[str] | None = None) -> int:
                              backend=args.backend, storage=args.storage,
                              fuse=args.fuse, boundary=args.boundary,
                              tile=tile,
-                             interior_split=args.interior_split)
+                             interior_split=args.interior_split,
+                             fallback=args.fallback)
     if args.checkpoint:
         from parallel_convolution_tpu.parallel import step as step_lib
         from parallel_convolution_tpu.utils import checkpoint, sharded_io
@@ -355,9 +365,18 @@ def main(argv: list[str] | None = None) -> int:
             ckpt_dir=args.checkpoint, every=args.checkpoint_every,
             backend=args.backend, fuse=args.fuse, boundary=args.boundary,
             tile=tile, interior_split=args.interior_split,
+            fallback=args.fallback,
         )
         sharded_io.save_sharded(args.output, out, args.rows, args.cols,
                                 args.mode)
+        if args.fallback:
+            # run_checkpointed resolved per chunk inside iterate_prepared;
+            # surface the process's last resolution so a degraded run is
+            # labeled in the summary line, not only on stderr.
+            from parallel_convolution_tpu.resilience import degrade
+
+            model.effective_backend = (degrade.effective_for(args.backend)
+                                       or args.backend)
     elif args.sharded_io:
         model.run_raw_file_sharded(args.image, args.output, args.rows,
                                    args.cols, args.mode, args.loops)
@@ -365,8 +384,11 @@ def main(argv: list[str] | None = None) -> int:
         model.run_raw_file(args.image, args.output, args.rows, args.cols,
                            args.mode, args.loops)
     r, c = mesh.shape["x"], mesh.shape["y"]
+    eff = getattr(model, "effective_backend", None) or args.backend
+    label = (args.backend if eff == args.backend
+             else f"{args.backend} degraded to {eff}")
     print(f"ran {args.loops} x {args.filter_name} on {r}x{c} mesh "
-          f"({args.backend}) -> {args.output}")
+          f"({label}) -> {args.output}")
     return 0
 
 

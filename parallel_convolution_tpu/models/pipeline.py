@@ -34,6 +34,12 @@ class ConvolutionModel:
         kernel), or 'xla_conv' (conv_general_dilated).
       quantize: apply uint8 store-back semantics each iteration (the
         reference's behavior for images); False = float Jacobi mode.
+      fallback: probe the backend once per (mesh, config) and walk the
+        degradation chain pallas_rdma → pallas → shifted on a
+        classified-transient compile/launch failure instead of dying
+        (resilience.degrade).  The backend actually used is recorded in
+        ``self.effective_backend`` after each run — a degraded run can
+        always be told apart from the requested tier.
     """
 
     filt: Filter | str = "blur3"
@@ -49,6 +55,8 @@ class ConvolutionModel:
     #                override; None = per-kernel tuned default
     interior_split: bool = False  # unmasked-interior launch split (fused
     #                Pallas on a 1x1 grid; bit-identical, opt-in experiment)
+    fallback: bool = False  # graceful backend degradation on transient
+    #                compile/launch failure (resilience.degrade)
 
     def __post_init__(self) -> None:
         if isinstance(self.filt, str):
@@ -56,13 +64,37 @@ class ConvolutionModel:
         if self.mesh is None:
             self.mesh = make_grid_mesh()
         step_lib._check_storage(self.storage, self.quantize)
+        # The backend the last run ACTUALLY used (== self.backend unless
+        # fallback degraded it); None until a run happens.
+        self.effective_backend: str | None = None
+
+    def _resolved_backend(self, hw: tuple[int, int]) -> str:
+        """Resolve for the REAL (H, W) workload: the probe must compile
+        the same kernel family (block geometry + storage dtype) the run
+        will, or it could pass while the run crashes."""
+        if not self.fallback:
+            self.effective_backend = self.backend
+            return self.backend
+        from parallel_convolution_tpu.parallel.mesh import (
+            grid_shape, padded_extent,
+        )
+
+        R, C = grid_shape(self.mesh)
+        block_hw = (padded_extent(hw[0], R) // R, padded_extent(hw[1], C) // C)
+        eff = step_lib._resolve_fallback(
+            self.mesh, self.filt, self.backend, self.quantize, self.fuse,
+            self.boundary, step_lib._norm_tile(self.tile),
+            self.interior_split, self.storage, block_hw=block_hw)
+        self.effective_backend = eff
+        return eff
 
     # -- array-level API ----------------------------------------------------
     def run_planar(self, x, iters: int) -> jnp.ndarray:
         """(C, H, W) f32 in → (C, H, W) f32 out after ``iters`` iterations."""
         return step_lib.sharded_iterate(
             x, self.filt, iters, mesh=self.mesh,
-            quantize=self.quantize, backend=self.backend,
+            quantize=self.quantize,
+            backend=self._resolved_backend(x.shape[-2:]),
             storage=self.storage, fuse=self.fuse, boundary=self.boundary,
             tile=self.tile, interior_split=self.interior_split,
         )
@@ -117,7 +149,8 @@ class ConvolutionModel:
         )
         out = step_lib.iterate_prepared(
             xs, self.filt, iters, self.mesh, (rows, cols),
-            quantize=self.quantize, backend=self.backend,
+            quantize=self.quantize,
+            backend=self._resolved_backend((rows, cols)),
             fuse=self.fuse, boundary=self.boundary, tile=self.tile,
             interior_split=self.interior_split,
         )

@@ -551,8 +551,14 @@ def fused_rdma_step(
     covering the scratch form regardless of the process's global
     backend.
     """
+    from parallel_convolution_tpu.resilience.faults import fault_point
     from parallel_convolution_tpu.utils.config import BOUNDARIES
 
+    # Trace-time consult: models the in-kernel exchange failing to build
+    # (the round-5 tiled-RDMA compile crash class).  Zero overhead when no
+    # fault plan is installed, and runs only while tracing — never on the
+    # device hot path.
+    fault_point("halo_exchange")
     if boundary not in BOUNDARIES:
         raise ValueError(f"boundary must be one of {BOUNDARIES}, got {boundary!r}")
     if interpret is None:

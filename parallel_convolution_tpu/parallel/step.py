@@ -40,6 +40,7 @@ from parallel_convolution_tpu.parallel.mesh import (
     make_grid_mesh,
     padded_extent,
 )
+from parallel_convolution_tpu.resilience.faults import fault_point
 from parallel_convolution_tpu.utils.config import BACKENDS  # canonical list
 from parallel_convolution_tpu.utils.jax_compat import shard_map
 
@@ -144,6 +145,7 @@ def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
                 p = p * _valid_mask(valid_hw, block_hw).astype(p.dtype)
             return p
         depth = r * fuse
+        fault_point("halo_exchange")  # trace-time: a launch-build failure
         p = halo.halo_exchange(v, depth, grid, boundary)
         if pallas_like and fuse > 1:
             # All T levels inside one kernel: one HBM round trip per chunk.
@@ -223,6 +225,9 @@ def _build_iterate(mesh: Mesh, filt: Filter, iters: int, quantize: bool,
                    tile: tuple[int, int] | None = None,
                    interior_split: bool = False):
     """Compile the fixed-count iteration runner for one (mesh, config)."""
+    # Consulted only on lru_cache misses — i.e. exactly when a fresh
+    # trace/compile happens, the event the 'backend_compile' site models.
+    fault_point("backend_compile")
     grid = grid_shape(mesh)
     _check_block_size(filt, block_hw)
     fuse = max(1, min(fuse, iters or 1))
@@ -268,6 +273,7 @@ def _build_converge(mesh: Mesh, filt: Filter, tol: float, max_iters: int,
     fuse ≥ 1 works for any check_every and the iterate stays bit-identical
     to fuse=1 (fused steps are exact, tested in test_sharded.py).
     """
+    fault_point("backend_compile")  # lru_cache miss == a fresh compile
     grid = grid_shape(mesh)
     _check_block_size(filt, block_hw)
     # A chunk fuses at most the n-1 pre-pair iterations (the final one is
@@ -436,13 +442,40 @@ def _norm_tile(tile) -> tuple[int, int] | None:
     return (th, tw)
 
 
+def _storage_name(dtype) -> str:
+    """The STORAGE_DTYPES name for an array dtype (default 'f32')."""
+    for name, dt in STORAGE_DTYPES.items():
+        if jnp.dtype(dt) == jnp.dtype(dtype):
+            return name
+    return "f32"
+
+
+def _resolve_fallback(mesh, filt, backend, quantize, fuse, boundary, tile,
+                      interior_split, storage="f32",
+                      block_hw=None) -> str:
+    """Walk the degradation chain (resilience.degrade) for this config.
+
+    ``block_hw``/``storage`` must describe the REAL run: kernel selection
+    depends on both (e.g. pallas_rdma's tiled-vs-monolithic switch), so a
+    probe on a different geometry or dtype could pass while the real
+    launch crashes — exactly the gap this probe exists to close.
+    """
+    from parallel_convolution_tpu.resilience import degrade
+
+    return degrade.resolve_backend(
+        mesh, filt, backend, quantize=quantize, fuse=fuse, boundary=boundary,
+        tile=tile, interior_split=interior_split, storage=storage,
+        block_hw=block_hw)
+
+
 def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
                      valid_hw, quantize: bool = True,
                      backend: str = "shifted", fuse: int = 1,
                      boundary: str = "zero",
                      tile: tuple[int, int] | None = None,
                      interior_split: bool = False,
-                     check_contract: bool = True):
+                     check_contract: bool = True,
+                     fallback: bool = False):
     """Iterate an already-sharded padded (C, Hp, Wp) array in place(-ish).
 
     The zero-copy entry for huge images loaded via utils.sharded_io: input
@@ -454,6 +487,14 @@ def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
     ``utils.checkpoint.run_checkpointed`` that validated the initial state
     once and whose chunk inputs are in contract by induction (quantized
     outputs are always in [0, 255]).
+
+    ``fallback=True`` probes ``backend`` once per (mesh, config) per
+    process BEFORE the real (donating) run and, on a classified-transient
+    compile/launch failure, walks the degradation chain
+    ``pallas_rdma → pallas → shifted`` (resilience.degrade) — emitting a
+    BackendDegradedWarning rather than dying with the first failed tier.
+    Probing first also means the donated input is never lost to a launch
+    that was going to fail.
     """
     if jnp.dtype(xs.dtype) == jnp.uint8 and not quantize:
         _check_storage("u8", quantize)  # public entry: same guard as above
@@ -461,6 +502,12 @@ def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
         _check_quantize_contract(xs, filt, quantize)
     R, Cc = grid_shape(mesh)
     block_hw = (xs.shape[1] // R, xs.shape[2] // Cc)
+    if fallback:
+        backend = _resolve_fallback(mesh, filt, backend, quantize, fuse,
+                                    boundary, _norm_tile(tile),
+                                    interior_split,
+                                    storage=_storage_name(xs.dtype),
+                                    block_hw=block_hw)
     fn = _build_iterate(mesh, filt, iters, quantize, tuple(valid_hw),
                         block_hw, backend, fuse, boundary, _norm_tile(tile),
                         interior_split)
@@ -472,7 +519,8 @@ def sharded_iterate(x, filt: Filter, iters: int, mesh: Mesh | None = None,
                     storage: str = "f32", fuse: int = 1,
                     boundary: str = "zero",
                     tile: tuple[int, int] | None = None,
-                    interior_split: bool = False):
+                    interior_split: bool = False,
+                    fallback: bool = False):
     """Run ``iters`` stencil iterations of a global (C, H, W) f32 image
     sharded over the 2D mesh.  Returns the global (C, H, W) f32 result
     (bit-identical to the serial oracle for any mesh shape).
@@ -498,7 +546,7 @@ def sharded_iterate(x, filt: Filter, iters: int, mesh: Mesh | None = None,
     out = iterate_prepared(xs, filt, iters, mesh, valid_hw,
                            quantize=quantize, backend=backend, fuse=fuse,
                            boundary=boundary, tile=tile,
-                           interior_split=interior_split)
+                           interior_split=interior_split, fallback=fallback)
     return out[:, : valid_hw[0], : valid_hw[1]].astype(jnp.float32)
 
 
@@ -507,17 +555,23 @@ def sharded_converge(x, filt: Filter, tol: float, max_iters: int,
                      quantize: bool = False, backend: str = "shifted",
                      storage: str = "f32", boundary: str = "zero",
                      fuse: int = 1, tile: tuple[int, int] | None = None,
-                     interior_split: bool = False):
+                     interior_split: bool = False, fallback: bool = False):
     """Run-to-convergence (BASELINE config 5).  Returns (result, iters_run).
 
     ``fuse``/``tile`` mirror :func:`sharded_iterate`: fused chunks run
     between convergence checks (any fuse ≥ 1, any check_every), so config
-    5 rides the same optimized kernels as the fixed-count path.
+    5 rides the same optimized kernels as the fixed-count path — including
+    ``fallback=True`` backend degradation.
     """
     if mesh is None:
         mesh = make_grid_mesh()
     _check_storage(storage, quantize)
     xs, valid_hw, block_hw = _prepare(x, mesh, filt.radius, storage)
+    if fallback:
+        backend = _resolve_fallback(mesh, filt, backend, quantize, fuse,
+                                    boundary, _norm_tile(tile),
+                                    interior_split, storage,
+                                    block_hw=block_hw)
     _check_quantize_contract(xs, filt, quantize)
     fn = _build_converge(mesh, filt, float(tol), int(max_iters),
                          int(check_every), quantize, valid_hw, block_hw,

@@ -1,0 +1,53 @@
+"""Resilience layer: fault injection, classified retry, backend degradation.
+
+The project's own operational history is the motivation (BASELINE.md,
+ROUND4/5 notes): four multi-hour tunnel outages, a tiled-RDMA compile
+crash on silicon, two driver rounds whose headline bench row was a silent
+CPU fallback, and per-round shell scripts re-encoding retry/terminal
+logic nobody could test.  This package turns each of those observed
+outage modes into a first-class, deterministic, replayable mechanism:
+
+* :mod:`~parallel_convolution_tpu.resilience.faults` — a seeded,
+  process-global fault plan with named injection sites that library code
+  consults via the zero-overhead-when-disabled :func:`fault_point` hook.
+* :mod:`~parallel_convolution_tpu.resilience.retry` — the
+  transient/terminal error taxonomy (:func:`classify`) and
+  :func:`with_retry`, capped exponential backoff with deterministic
+  jitter — the one tested implementation of the loop that previously
+  lived, divergently, in ``tunnel_watch.sh`` and ``chip_session_r5*.sh``.
+* :mod:`~parallel_convolution_tpu.resilience.degrade` — graceful backend
+  degradation: probe a backend once per (mesh, config) per process and
+  walk ``pallas_rdma → pallas → shifted`` on classified-transient
+  compile/launch failure, so a fallback can never silently masquerade as
+  the requested tier (the effective backend is stamped into bench rows).
+* :mod:`~parallel_convolution_tpu.resilience.supervisor` — the leg-queue
+  runner behind ``scripts/run_supervised.py``: per-leg completion
+  predicates, terminal-failure sentinel file, JSON status ledger.
+
+Everything here except ``degrade``'s probe is jax-free and import-light,
+so hooks can live in modules (``utils.platform``) that must parse
+``--help`` without paying backend startup.
+"""
+
+from parallel_convolution_tpu.resilience.faults import (  # noqa: F401
+    InjectedFault,
+    KNOWN_SITES,
+    fault_point,
+    injected,
+    install_plan,
+    plan_from_env,
+    plan_from_spec,
+    uninstall_plan,
+)
+from parallel_convolution_tpu.resilience.retry import (  # noqa: F401
+    RetryExhausted,
+    RetryPolicy,
+    classify,
+    with_retry,
+)
+
+__all__ = [
+    "InjectedFault", "KNOWN_SITES", "fault_point", "injected",
+    "install_plan", "plan_from_env", "plan_from_spec", "uninstall_plan",
+    "RetryExhausted", "RetryPolicy", "classify", "with_retry",
+]

@@ -1,0 +1,162 @@
+"""Transient/terminal error taxonomy + retry with deterministic backoff.
+
+This is the tested replacement for the retry/HALT-sentinel logic that
+previously lived in two divergent shell scripts (``tunnel_watch.sh``'s
+4-minute probe loop and ``chip_session_r5c.sh``'s per-leg keep-best /
+MISMATCH-is-terminal handling).  The taxonomy encodes what four rounds of
+operating the tunnel platform actually taught:
+
+transient (retrying can heal it)
+    tunnel/RPC loss (``jax.devices()`` hang, UNAVAILABLE, socket resets),
+    OOM on a probe (RESOURCE_EXHAUSTED), Mosaic/XLA INTERNAL compile
+    crashes (the round-5 tiled-RDMA helper crash recovered on retry),
+    timeouts of any stripe.
+
+terminal (retrying burns chip time forever — stop, leave a sentinel)
+    magic-round byte MISMATCH (a compiler-behavior change), checkpoint
+    config/grid mismatch, shape errors, and generally every
+    ``ValueError``/``TypeError``-class programming or contract error.
+
+Unknown exceptions default to **terminal**: an unbounded retry loop on a
+condition nobody has classified is exactly the failure mode the round-5
+scripts had to hand-patch (the watcher refiring a MISMATCH session every
+4 minutes).  Add markers here as new transients are observed.
+
+Backoff jitter is deterministic (seeded ``random.Random``), so a retry
+schedule in a test or an incident report is replayable exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+
+from parallel_convolution_tpu.resilience.faults import InjectedFault
+
+TRANSIENT = "transient"
+TERMINAL = "terminal"
+
+# Lower-cased substrings matched against "ExcType: message".  Terminal
+# markers win over transient ones: "MISMATCH" inside an RPC error text is
+# a detected compiler fold, not a tunnel blip.
+TERMINAL_MARKERS = (
+    # NOTE: keep these NARROW.  Shape/contract errors are already terminal
+    # via their exception types (ValueError/TypeError below); a bare
+    # "shape" substring here would misclassify transient Mosaic INTERNAL
+    # crashes whose messages mention vector shapes.
+    "mismatch",            # magic-round guard / byte-compare failures
+    "config mismatch",
+    "checkpoint grid",
+    "requires quantize",
+    "unknown backend",
+)
+TRANSIENT_MARKERS = (
+    "unavailable",
+    "deadline_exceeded",
+    "deadline exceeded",
+    "socket closed",
+    "connection reset",
+    "connection refused",
+    "broken pipe",
+    "tunnel",
+    "resource_exhausted",
+    "resource exhausted",
+    "out of memory",
+    "rpc",
+    "internal:",           # XlaRuntimeError INTERNAL (Mosaic compile crash)
+    "mosaic",
+    "timed out",
+    "timeout",
+)
+
+_TERMINAL_TYPES = (
+    ValueError, TypeError, KeyError, IndexError, AttributeError,
+    AssertionError, NotImplementedError, ZeroDivisionError,
+)
+_TRANSIENT_TYPES = (
+    TimeoutError, ConnectionError, BrokenPipeError, InterruptedError,
+)
+
+
+def classify(exc: BaseException) -> str:
+    """Map an exception to ``"transient"`` or ``"terminal"``.
+
+    Order matters: injected faults carry their own classification;
+    explicit exception types beat message scans; terminal markers beat
+    transient ones; unknowns are terminal (see module docstring).
+    """
+    if isinstance(exc, InjectedFault):
+        return TRANSIENT if exc.transient else TERMINAL
+    if isinstance(exc, _TERMINAL_TYPES):
+        return TERMINAL
+    if isinstance(exc, _TRANSIENT_TYPES):
+        return TRANSIENT
+    msg = f"{type(exc).__name__}: {exc}".lower()
+    if any(m in msg for m in TERMINAL_MARKERS):
+        return TERMINAL
+    if any(m in msg for m in TRANSIENT_MARKERS):
+        return TRANSIENT
+    return TERMINAL
+
+
+class RetryExhausted(RuntimeError):
+    """All attempts failed with transient errors; the last one is chained."""
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Capped exponential backoff with deterministic jitter.
+
+    Attempt k (1-based) sleeps ``min(cap, base * mult**(k-1))`` scaled by
+    a jitter factor drawn uniformly from [0.5, 1.0] — drawn from a
+    ``Random(seed)`` private to each :func:`with_retry` call, so a given
+    (policy, failure pattern) always produces the same schedule.
+    """
+
+    max_attempts: int = 5
+    base_delay: float = 0.5
+    max_delay: float = 60.0
+    multiplier: float = 2.0
+    seed: int = 0
+
+    def delay(self, attempt: int, rng: random.Random) -> float:
+        raw = min(self.max_delay,
+                  self.base_delay * self.multiplier ** (attempt - 1))
+        return raw * (0.5 + 0.5 * rng.random())
+
+    def delays(self) -> list[float]:
+        """The full schedule this policy would sleep (for tests/reports)."""
+        rng = random.Random(self.seed)
+        return [self.delay(k, rng) for k in range(1, self.max_attempts)]
+
+
+def with_retry(fn, policy: RetryPolicy | None = None, *,
+               classify=classify, sleep=time.sleep, on_retry=None):
+    """Call ``fn()``; retry classified-transient failures per ``policy``.
+
+    Terminal failures re-raise immediately and untouched (the caller's
+    sentinel/halt logic sees the original exception).  When every attempt
+    fails transiently, raises :class:`RetryExhausted` chained to the last
+    error.  ``on_retry(attempt, exc, delay)`` observes each backoff;
+    ``sleep`` is injectable so tests assert schedules without waiting.
+    """
+    policy = policy or RetryPolicy()
+    rng = random.Random(policy.seed)
+    last: BaseException | None = None
+    for attempt in range(1, policy.max_attempts + 1):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — classification is the point
+            if classify(e) == TERMINAL:
+                raise
+            last = e
+            if attempt == policy.max_attempts:
+                break
+            d = policy.delay(attempt, rng)
+            if on_retry is not None:
+                on_retry(attempt, e, d)
+            sleep(d)
+    raise RetryExhausted(
+        f"{policy.max_attempts} attempts failed transiently; last: {last!r}"
+    ) from last

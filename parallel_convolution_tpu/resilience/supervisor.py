@@ -1,0 +1,220 @@
+"""Supervised leg queue: the tested replacement for the chip-session shell.
+
+``tunnel_watch.sh`` + ``chip_session_r5*.sh`` encoded, in copy-pasted
+shell, exactly three ideas: (1) a queue of legs, each complete iff its
+output artifact exists (often with a required content pattern, e.g. a
+``"summary"`` row); (2) retry-on-transient with the tunnel probed between
+passes; (3) a terminal-failure sentinel (``HALT_r5c``) that stops the
+watcher when retrying cannot heal the condition (magic-round MISMATCH).
+This module is those three ideas as one importable, unit-tested class;
+``scripts/run_supervised.py`` is the CLI.
+
+Each attempt's stdout/stderr land in ``<state_dir>/<leg>.out|.err``; a
+JSON status ledger (``status.json``) is atomically rewritten after every
+attempt, so an operator (or the next session) can see exactly where a
+run died without scraping logs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import re
+import subprocess
+import time
+from pathlib import Path
+
+from parallel_convolution_tpu.resilience.retry import RetryPolicy
+
+HALT_NAME = "HALT"
+LEDGER_NAME = "status.json"
+
+
+@dataclasses.dataclass
+class Leg:
+    """One unit of work with an artifact-based completion predicate.
+
+    ``done_file`` + optional ``done_pattern`` (regex) define completion —
+    the queue is idempotent, like the ``[ -e ]`` guards in the old shell:
+    a re-run skips landed legs.  With no ``done_file``, completion is
+    simply a zero exit.  ``terminal_pattern`` (regex, searched in the
+    attempt's combined stdout+stderr) marks failures retrying cannot heal
+    — the supervisor drops the sentinel and stops the whole queue.
+    """
+
+    name: str
+    cmd: list[str]
+    done_file: str | None = None
+    done_pattern: str | None = None
+    terminal_pattern: str | None = None
+    timeout: float | None = None
+    env: dict | None = None
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Leg":
+        unknown = set(d) - {f.name for f in dataclasses.fields(cls)}
+        if unknown:
+            raise ValueError(f"unknown leg field(s) {sorted(unknown)}")
+        leg = cls(**d)
+        if not leg.name or not leg.cmd:
+            raise ValueError("leg needs a name and a non-empty cmd")
+        return leg
+
+    def is_complete(self) -> bool:
+        if self.done_file is None:
+            return False  # rc==0 of an attempt is the only signal
+        p = Path(self.done_file)
+        if not p.exists():
+            return False
+        if self.done_pattern is None:
+            return True
+        try:
+            return re.search(self.done_pattern, p.read_text()) is not None
+        except OSError:
+            return False
+
+
+class Supervisor:
+    """Run a :class:`Leg` queue with classified retry + terminal sentinel."""
+
+    def __init__(self, legs: list[Leg], state_dir, *,
+                 policy: RetryPolicy | None = None, sleep=time.sleep,
+                 log=None):
+        self.legs = list(legs)
+        names = [leg.name for leg in self.legs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate leg names in {names}")
+        self.state_dir = Path(state_dir)
+        self.policy = policy or RetryPolicy(max_attempts=5, base_delay=2.0,
+                                            max_delay=240.0)
+        self._sleep = sleep
+        self._log = log or (lambda msg: print(msg, flush=True))
+        self._status: dict = {"legs": {}, "halt": None}
+
+    # -- ledger ------------------------------------------------------------
+    @property
+    def halt_path(self) -> Path:
+        return self.state_dir / HALT_NAME
+
+    @property
+    def ledger_path(self) -> Path:
+        return self.state_dir / LEDGER_NAME
+
+    def _write_ledger(self) -> None:
+        self._status["updated"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                time.gmtime())
+        tmp = self.ledger_path.with_suffix(".json.tmp")
+        tmp.write_text(json.dumps(self._status, indent=2))
+        os.replace(tmp, self.ledger_path)
+
+    def _leg_status(self, leg: Leg) -> dict:
+        return self._status["legs"].setdefault(
+            leg.name, {"state": "pending", "attempts": 0})
+
+    # -- execution ---------------------------------------------------------
+    def _attempt(self, leg: Leg) -> tuple[int | None, str]:
+        """One subprocess attempt; returns (rc or None on timeout, text)."""
+        out = self.state_dir / f"{leg.name}.out"
+        err = self.state_dir / f"{leg.name}.err"
+        env = dict(os.environ)
+        if leg.env:
+            env.update({k: str(v) for k, v in leg.env.items()})
+        try:
+            with open(out, "wb") as fo, open(err, "wb") as fe:
+                p = subprocess.run(leg.cmd, stdout=fo, stderr=fe,
+                                   timeout=leg.timeout, env=env)
+            rc = p.returncode
+        except subprocess.TimeoutExpired:
+            rc = None
+        except OSError as e:  # unrunnable cmd: surface in the ledger
+            err.write_bytes(repr(e).encode())
+            rc = -1
+        text = ""
+        for p_ in (out, err):
+            try:
+                text += p_.read_text(errors="replace")
+            except OSError:
+                pass
+        return rc, text
+
+    def _halt(self, leg: Leg, reason: str) -> None:
+        self._status["halt"] = {"leg": leg.name, "reason": reason}
+        self.halt_path.write_text(
+            f"leg {leg.name}: {reason}\n"
+            "Terminal failure: retrying cannot heal it. Remove this file "
+            "only after fixing the cause.\n")
+        self._write_ledger()
+        self._log(f"supervisor: TERMINAL failure in leg {leg.name!r}: "
+                  f"{reason} — sentinel at {self.halt_path}")
+
+    def run(self) -> int:
+        """Run the queue.  0 = all legs complete; 1 = some leg exhausted
+        its retries (queue continued past it); 2 = terminal halt."""
+        self.state_dir.mkdir(parents=True, exist_ok=True)
+        if self.halt_path.exists():
+            self._log(f"supervisor: refusing to run — sentinel present at "
+                      f"{self.halt_path}")
+            return 2
+        exhausted = False
+        for leg in self.legs:
+            st = self._leg_status(leg)
+            if leg.is_complete():
+                st["state"] = "done"
+                st.setdefault("completed_at", time.strftime(
+                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+                self._write_ledger()
+                self._log(f"supervisor: leg {leg.name!r} already complete")
+                continue
+            done = False
+            # One RNG drawn exactly like RetryPolicy.delays()/with_retry:
+            # the same policy must produce the same schedule everywhere.
+            rng = random.Random(self.policy.seed)
+            for attempt in range(1, self.policy.max_attempts + 1):
+                st["state"] = "running"
+                st["attempts"] = attempt
+                self._write_ledger()
+                rc, text = self._attempt(leg)
+                st["last_rc"] = rc
+                if leg.terminal_pattern and re.search(leg.terminal_pattern,
+                                                      text):
+                    st["state"] = "terminal"
+                    self._halt(leg, f"output matched terminal pattern "
+                                    f"{leg.terminal_pattern!r}")
+                    return 2
+                complete = (leg.is_complete() if leg.done_file is not None
+                            else rc == 0)
+                if complete:
+                    st["state"] = "done"
+                    st["completed_at"] = time.strftime(
+                        "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+                    self._write_ledger()
+                    self._log(f"supervisor: leg {leg.name!r} complete "
+                              f"(attempt {attempt})")
+                    done = True
+                    break
+                st["last_error"] = ("timeout" if rc is None
+                                    else f"rc={rc}, incomplete")
+                self._write_ledger()
+                if attempt < self.policy.max_attempts:
+                    d = self.policy.delay(attempt, rng)
+                    self._log(f"supervisor: leg {leg.name!r} attempt "
+                              f"{attempt} failed ({st['last_error']}); "
+                              f"retrying in {d:.1f}s")
+                    self._sleep(d)
+            if not done:
+                st["state"] = "exhausted"
+                self._write_ledger()
+                self._log(f"supervisor: leg {leg.name!r} exhausted "
+                          f"{self.policy.max_attempts} attempts; continuing")
+                exhausted = True
+        return 1 if exhausted else 0
+
+
+def legs_from_json(text: str) -> list[Leg]:
+    """Parse a JSON list of leg dicts (the ``--legs`` file format)."""
+    data = json.loads(text)
+    if not isinstance(data, list):
+        raise ValueError("legs file must be a JSON list of leg objects")
+    return [Leg.from_dict(d) for d in data]

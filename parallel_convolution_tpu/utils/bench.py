@@ -121,6 +121,7 @@ def bench_iterate(
     reps: int = 3,
     tile: tuple[int, int] | None = None,
     interior_split: bool = False,
+    fallback: bool = False,
 ) -> dict:
     """Gpixels/sec/chip for the standard fixed-iteration workload.
 
@@ -128,7 +129,15 @@ def bench_iterate(
     default) — passed explicitly because it is a static jit argument;
     monkeypatching the module defaults does NOT reach already-traced
     kernels.  ``interior_split`` benches the unmasked-interior launch
-    split (fused Pallas backends; any grid since round 5)."""
+    split (fused Pallas backends; any grid since round 5).
+
+    Every row is stamped with ``platform`` (the mesh devices' platform —
+    a CPU row can never read as a chip record again, the BENCH_r04/r05
+    failure mode) and ``effective_backend``.  ``fallback=True``
+    additionally walks the degradation chain (resilience.degrade) on a
+    transient compile/launch failure, and the row then records the
+    backend that ACTUALLY produced the number, with the requested one
+    still under ``backend``."""
     if mesh is None:
         mesh = make_grid_mesh()
     reps = max(1, reps)  # reps=0 would leave the slope path's median empty
@@ -142,8 +151,18 @@ def bench_iterate(
     # dtype and sharding are invariant, exactly the double-buffer reuse the
     # real pipeline gets.
     xs, valid_hw, block_hw = step_lib._prepare(x, mesh, filt.radius, storage)
+    effective = backend
+    if fallback:
+        from parallel_convolution_tpu.resilience import degrade
+
+        # Probe on the REAL block geometry + storage: kernel selection
+        # (e.g. pallas_rdma tiled-vs-monolithic) depends on both.
+        effective = degrade.resolve_backend(
+            mesh, filt, backend, quantize=quantize, fuse=fuse,
+            tile=tile, interior_split=interior_split, storage=storage,
+            block_hw=block_hw)
     fn = step_lib._build_iterate(mesh, filt, iters, quantize, valid_hw,
-                                 block_hw, backend, fuse, tile=tile,
+                                 block_hw, effective, fuse, tile=tile,
                                  interior_split=interior_split)
     out = fence(fn(xs))  # compile + warmup
 
@@ -198,9 +217,17 @@ def bench_iterate(
             [first] + [span(1) for _ in range(reps - 1)])
     n_dev = mesh.size
     gpx = H * W * channels * iters / secs / 1e9
+    dev0 = mesh.devices.flat[0]
     return {
         "workload": f"{filt.name} {H}x{W}x{channels} {iters} iters",
         "backend": backend,
+        # The backend that ACTUALLY produced this number (differs from
+        # 'backend' only when fallback degraded it) and the hardware it
+        # ran on — a silent CPU fallback or tier downgrade can no longer
+        # masquerade as the requested configuration in published rows.
+        "effective_backend": effective,
+        "platform": dev0.platform,
+        "device_kind": getattr(dev0, "device_kind", "") or "",
         "storage": storage,
         "fuse": fuse,
         "mesh": "x".join(str(s) for s in grid_shape(mesh)),

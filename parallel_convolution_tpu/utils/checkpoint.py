@@ -12,12 +12,27 @@ Chunked execution does not perturb semantics: in u8 mode every iteration
 ends quantized to exact integers, and float-mode shards are saved as raw
 float32, so save/restore is lossless and the checkpointed run remains
 bit-identical to an uninterrupted one.
+
+Hardening (resilience PR): snapshots are now *verifiable*, not just
+ordered.  Each shard is written atomically (tmp + rename) and its CRC32 +
+byte length are recorded in ``meta.json``; loading validates completeness
+and checksums and raises :class:`CheckpointCorrupt` on a torn snapshot
+(the multi-host prune race: ``meta.json`` present but shard files
+missing/truncated).  ``load_state(..., fallback=True)`` — the default
+inside :func:`run_checkpointed` — then walks back to the newest snapshot
+that does validate instead of crashing or, worse, resuming from garbage.
+Injection sites ``checkpoint_write_shard`` / ``checkpoint_write_meta``
+(resilience.faults) let tests kill a save between any two writes and
+prove the resumed run byte-identical (tests/test_resilience.py).
 """
 
 from __future__ import annotations
 
+import io
 import json
 import os
+import warnings
+import zlib
 from pathlib import Path
 
 import jax
@@ -25,10 +40,19 @@ import numpy as np
 from jax.sharding import Mesh
 
 from parallel_convolution_tpu.parallel.mesh import block_sharding, grid_shape
+from parallel_convolution_tpu.resilience.faults import fault_point
 
 META_NAME = "meta.json"
 LATEST_NAME = "LATEST"
 KEEP_SNAPSHOTS = 2
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A snapshot's meta exists but its shard set is incomplete/damaged."""
+
+
+class CheckpointWarning(UserWarning):
+    """A corrupt snapshot was skipped in favor of an older (or fresh) state."""
 
 
 def _coords(index, block_hw) -> tuple[int, int]:
@@ -48,14 +72,83 @@ def _latest_snap(ckpt_dir) -> Path | None:
     return snap if (snap / META_NAME).exists() else None
 
 
+def _candidate_snaps(ckpt_dir) -> list[Path]:
+    """Snapshots to try, newest-claim first: the LATEST pointer's target,
+    then every other ``it_*`` dir with a meta, newest iteration first."""
+    d = Path(ckpt_dir)
+    first = _latest_snap(d)
+    out = [first] if first is not None else []
+    if d.exists():
+        rest = sorted(
+            (p for p in d.iterdir() if p.is_dir()
+             and p.name.startswith("it_") and (p / META_NAME).exists()),
+            key=lambda p: p.name, reverse=True,
+        )
+        out += [p for p in rest if first is None or p.name != first.name]
+    return out
+
+
+def _expected_shards(meta: dict) -> list[str]:
+    g0, g1 = meta["grid"]
+    return [f"shard_{r}_{c}.npy" for r in range(g0) for c in range(g1)]
+
+
+def _validate_snapshot(snap: Path, meta: dict) -> None:
+    """Raise :class:`CheckpointCorrupt` unless every expected shard file is
+    present and matches its recorded CRC32/length.
+
+    Shards without a CRC record (a legacy snapshot, or — multi-host —
+    shards another host wrote under its own meta) degrade to a header
+    parse: presence + a readable ``.npy`` is the best that host can check.
+    """
+    problems = []
+    recorded = meta.get("shards", {})
+    for name in _expected_shards(meta):
+        p = snap / name
+        if not p.exists():
+            problems.append(f"missing {name}")
+            continue
+        rec = recorded.get(name)
+        if rec is not None:
+            # Stream the CRC in chunks: shards can be device-block-sized
+            # (hundreds of MB at 65536² scale) — never a whole-file read.
+            crc, n = 0, 0
+            with open(p, "rb") as f:
+                while chunk := f.read(1 << 20):
+                    crc = zlib.crc32(chunk, crc)
+                    n += len(chunk)
+            if n != rec["bytes"]:
+                problems.append(
+                    f"truncated {name} ({n} != {rec['bytes']} bytes)")
+            elif crc != rec["crc32"]:
+                problems.append(f"checksum mismatch in {name}")
+        else:
+            try:
+                np.load(p, mmap_mode="r")
+            except Exception:
+                problems.append(f"unreadable {name} (no CRC recorded)")
+    if problems:
+        raise CheckpointCorrupt(
+            f"snapshot {snap.name} is torn: {'; '.join(problems[:8])}"
+            + (f" (+{len(problems) - 8} more)" if len(problems) > 8 else "")
+        )
+
+
 def save_state(ckpt_dir, arr: jax.Array, meta: dict) -> None:
     """Snapshot a sharded padded (C, Hp, Wp) array + metadata.
 
     Crash-safe by construction: each snapshot is its own
-    ``it_<NNNNNNNN>/`` directory, meta is written last inside it, and the
-    ``LATEST`` pointer flips atomically only after the snapshot is
-    complete — a crash at any point leaves the previous snapshot intact.
-    Older snapshots beyond KEEP_SNAPSHOTS are pruned.
+    ``it_<NNNNNNNN>/`` directory, every shard is serialized in memory
+    first and written atomically (tmp + rename) with its CRC32 recorded,
+    meta is written last inside the directory, and the ``LATEST`` pointer
+    flips atomically only after the snapshot is complete — a crash at any
+    point leaves the previous snapshot intact AND leaves the torn one
+    detectable (:func:`_validate_snapshot`).  Older snapshots beyond
+    KEEP_SNAPSHOTS are pruned.
+
+    Fault sites: ``checkpoint_write_shard`` before each shard write;
+    ``checkpoint_write_meta`` twice — before the meta write and before the
+    LATEST flip — so tests can kill the save at every boundary.
     """
     d = Path(ckpt_dir)
     d.mkdir(parents=True, exist_ok=True)
@@ -63,12 +156,24 @@ def save_state(ckpt_dir, arr: jax.Array, meta: dict) -> None:
     snap.mkdir(exist_ok=True)
     R_blocks = meta["grid"]
     block_hw = (arr.shape[1] // R_blocks[0], arr.shape[2] // R_blocks[1])
+    shards: dict[str, dict] = {}
     for shard in arr.addressable_shards:
         r, c = _coords(shard.index, block_hw)
-        np.save(snap / f"shard_{r}_{c}.npy", np.asarray(shard.data))
+        name = f"shard_{r}_{c}.npy"
+        buf = io.BytesIO()
+        np.save(buf, np.asarray(shard.data))
+        raw = buf.getvalue()
+        fault_point("checkpoint_write_shard")
+        tmp = snap / (name + ".tmp")
+        tmp.write_bytes(raw)
+        os.replace(tmp, snap / name)
+        shards[name] = {"crc32": zlib.crc32(raw), "bytes": len(raw)}
+    meta = dict(meta, shards=shards)
+    fault_point("checkpoint_write_meta")
     tmp = snap / (META_NAME + ".tmp")
     tmp.write_text(json.dumps(meta))
     os.replace(tmp, snap / META_NAME)
+    fault_point("checkpoint_write_meta")
     ptr_tmp = d / (LATEST_NAME + ".tmp")
     ptr_tmp.write_text(snap.name)
     os.replace(ptr_tmp, d / LATEST_NAME)
@@ -89,32 +194,61 @@ def save_state(ckpt_dir, arr: jax.Array, meta: dict) -> None:
 
 
 def load_meta(ckpt_dir) -> dict | None:
+    """The LATEST snapshot's meta, unvalidated (shards may still be torn —
+    use :func:`load_state` for validated loading)."""
     snap = _latest_snap(ckpt_dir)
     if snap is None:
         return None
     return json.loads((snap / META_NAME).read_text())
 
 
-def load_state(ckpt_dir, mesh: Mesh) -> tuple[jax.Array, dict]:
-    """Restore the sharded array (each device reads only its own shard)."""
-    snap = _latest_snap(ckpt_dir)
-    if snap is None:
+def load_state(ckpt_dir, mesh: Mesh,
+               fallback: bool = False) -> tuple[jax.Array, dict]:
+    """Restore the sharded array (each device reads only its own shard).
+
+    Validates snapshot completeness + per-shard CRC32 before any device
+    read; a torn snapshot raises :class:`CheckpointCorrupt` — unless
+    ``fallback=True``, in which case the walk continues to the newest
+    OLDER snapshot that validates (with a :class:`CheckpointWarning`
+    naming what was skipped).  Returns ``(array, meta)`` of the snapshot
+    actually loaded, so the caller resumes from its true iteration count.
+
+    A grid mismatch is a config error, not corruption: it raises
+    ``ValueError`` immediately, fallback or not.
+    """
+    candidates = _candidate_snaps(ckpt_dir)
+    if not candidates:
         raise FileNotFoundError(f"no checkpoint at {ckpt_dir}")
-    meta = json.loads((snap / META_NAME).read_text())
-    shape = tuple(meta["shape"])
     grid = grid_shape(mesh)
-    if tuple(meta["grid"]) != grid:
-        raise ValueError(
-            f"checkpoint grid {meta['grid']} != mesh grid {list(grid)}"
-        )
-    block_hw = (shape[1] // grid[0], shape[2] // grid[1])
+    last_err: CheckpointCorrupt | None = None
+    for snap in candidates:
+        meta = json.loads((snap / META_NAME).read_text())
+        if tuple(meta["grid"]) != grid:
+            raise ValueError(
+                f"checkpoint grid {meta['grid']} != mesh grid {list(grid)}"
+            )
+        try:
+            _validate_snapshot(snap, meta)
+        except CheckpointCorrupt as e:
+            if not fallback:
+                raise
+            warnings.warn(f"skipping torn snapshot: {e}", CheckpointWarning,
+                          stacklevel=2)
+            last_err = e
+            continue
+        shape = tuple(meta["shape"])
+        block_hw = (shape[1] // grid[0], shape[2] // grid[1])
 
-    def cb(index):
-        r, c = _coords(index, block_hw)
-        return np.load(snap / f"shard_{r}_{c}.npy")
+        def cb(index, snap=snap, block_hw=block_hw):
+            r, c = _coords(index, block_hw)
+            return np.load(snap / f"shard_{r}_{c}.npy")
 
-    arr = jax.make_array_from_callback(shape, block_sharding(mesh), cb)
-    return arr, meta
+        arr = jax.make_array_from_callback(shape, block_sharding(mesh), cb)
+        return arr, meta
+    raise CheckpointCorrupt(
+        f"no valid snapshot in {ckpt_dir}: every candidate is torn "
+        f"(last: {last_err})"
+    )
 
 
 def run_checkpointed(
@@ -131,12 +265,20 @@ def run_checkpointed(
     boundary: str = "zero",
     tile: tuple[int, int] | None = None,
     interior_split: bool = False,
+    fallback: bool = False,
 ) -> jax.Array:
     """Iterate with a snapshot every ``every`` iterations; auto-resume.
 
     If ``ckpt_dir`` holds a compatible checkpoint, continues from its
     iteration count (``xs`` may then be None).  Returns the padded sharded
     result after ``total_iters`` total iterations.
+
+    Resume is resilient by default: a torn LATEST snapshot falls back to
+    the newest valid one (:func:`load_state` with ``fallback=True``), and
+    if *no* snapshot validates the run restarts from ``xs`` with a
+    :class:`CheckpointWarning` — never from corrupt bytes.  ``fallback``
+    here is the *backend* degradation knob, threaded to
+    ``step.iterate_prepared`` (resilience.degrade).
     """
     from parallel_convolution_tpu.parallel import step as step_lib
 
@@ -150,15 +292,37 @@ def run_checkpointed(
         "valid_hw": list(valid_hw),
         "grid": list(grid),
     }
-    meta = load_meta(ckpt_dir)
+    # Gate on the config FIRST (one small JSON read): a mismatch must not
+    # cost shard validation + a full device load before raising.  All
+    # snapshots in a dir come from one run, so the latest meta speaks for
+    # every fallback candidate too.
+    meta0 = load_meta(ckpt_dir)
+    if meta0 is not None:
+        saved_cfg = {k: meta0.get(k) for k in config}
+        if saved_cfg != config:
+            raise ValueError(
+                f"checkpoint config mismatch: {saved_cfg} != {config}"
+            )
+    meta = None
+    try:
+        loaded_xs, meta = load_state(ckpt_dir, mesh, fallback=True)
+    except FileNotFoundError:
+        pass
+    except CheckpointCorrupt as e:
+        warnings.warn(
+            f"no usable checkpoint in {ckpt_dir} ({e}); starting fresh",
+            CheckpointWarning, stacklevel=2)
     done = 0
     if meta is not None:
+        # Re-check against the snapshot actually loaded: with no LATEST
+        # pointer yet (a first-save crash) meta0 above was None and the
+        # pre-gate never ran.
         saved_cfg = {k: meta.get(k) for k in config}
         if saved_cfg != config:
             raise ValueError(
                 f"checkpoint config mismatch: {saved_cfg} != {config}"
             )
-        xs, _ = load_state(ckpt_dir, mesh)
+        xs = loaded_xs
         done = int(meta["iters_done"])
     if xs is None:
         raise ValueError("no checkpoint found and no initial state given")
@@ -180,6 +344,7 @@ def run_checkpointed(
             xs, filt, chunk, mesh, valid_hw, interior_split=interior_split,
             quantize=quantize, backend=backend, fuse=min(fuse, chunk),
             boundary=boundary, tile=tile, check_contract=False,
+            fallback=fallback,
         )
         done += chunk
         if done < total_iters:  # final state is the caller's to persist

@@ -42,6 +42,8 @@ class RunConfig:
     sharded_io: bool = False
     checkpoint_dir: str | None = None
     checkpoint_every: int = 100
+    fallback: bool = False  # graceful backend degradation on transient
+    #                         compile/launch failure (resilience.degrade)
 
     def __post_init__(self) -> None:
         if self.mode not in ("grey", "rgb"):
@@ -89,5 +91,5 @@ class RunConfig:
         return ConvolutionModel(
             filt=self.filter_name, mesh=mesh, backend=self.backend,
             quantize=self.quantize, storage=self.storage, fuse=self.fuse,
-            boundary=self.boundary, tile=self.tile,
+            boundary=self.boundary, tile=self.tile, fallback=self.fallback,
         )

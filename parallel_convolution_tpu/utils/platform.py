@@ -105,6 +105,12 @@ def ensure_live_backend(timeout: float = 120.0) -> str | None:
     """
     import subprocess
 
+    from parallel_convolution_tpu.resilience.faults import fault_point
+
+    # The 'device_probe' site models this very guard failing (OOM on
+    # probe, tunnel flaps): callers that want bounded retries wrap
+    # ensure_live_backend in resilience.retry.with_retry.
+    fault_point("device_probe")
     try:
         p = subprocess.run(
             [sys.executable, "-c", _PROBE_SRC],

@@ -22,6 +22,7 @@ from jax.sharding import Mesh
 from parallel_convolution_tpu.parallel.mesh import (
     block_sharding, grid_shape, padded_extent,
 )
+from parallel_convolution_tpu.resilience.faults import fault_point
 from parallel_convolution_tpu.utils import imageio
 
 
@@ -58,6 +59,7 @@ def load_sharded(
         r1, c1 = min(rs.stop or Hp, rows), min(cs.stop or Wp, cols)
         out = np.zeros((C, bh, bw), dtype)
         if r1 > r0 and c1 > c0:
+            fault_point("io_read")  # one consult per device-block read
             blk = _read_block_np(path, rows, cols, mode, r0, r1, c0, c1)
             out[:, : r1 - r0, : c1 - c0] = imageio.interleaved_to_planar(blk)
         return out

@@ -1,4 +1,8 @@
 #!/bin/sh
+# SUPERSEDED (resilience PR): express future chip sessions as a JSON legs
+# file for scripts/run_supervised.py (tested retry/terminal logic in
+# parallel_convolution_tpu/resilience/).  Kept as the round-5 record.
+#
 # Round-5 chip session: everything still waiting on TPU silicon, ordered
 # by value so another tunnel outage costs the least.  Supersedes
 # chip_session_r4b.sh (same legs 1-5, plus the round-5 additions).

@@ -1,4 +1,8 @@
 #!/bin/sh
+# SUPERSEDED (resilience PR): express future chip sessions as a JSON legs
+# file for scripts/run_supervised.py (tested retry/terminal logic in
+# parallel_convolution_tpu/resilience/).  Kept as the round-5 record.
+#
 # Round-5 follow-up chip session.  First run (2026-07-31 ~05:57 UTC)
 # got through the bf16 fuse-40/48 rows (preserved in
 # evidence/tune_convex_r5b.jsonl.partial: 122.1 / 125.7 Gpx/s — the

@@ -1,4 +1,9 @@
 #!/bin/sh
+# SUPERSEDED (resilience PR): express future chip sessions as a JSON legs
+# file for scripts/run_supervised.py (completion predicates, classified
+# retry, terminal HALT sentinel — all tested in tests/test_resilience.py).
+# Kept as the round-5 operational record; do not extend.
+#
 # Round-5 third-window chip queue, re-armed by tunnel_watch.sh after the
 # FOURTH tunnel outage (died ~11:45 UTC 2026-07-31, mid-way through the
 # magic-round fuse re-sweep; rows landed so far are preserved in

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Supervised leg-queue runner — the tested successor to the shell era.
+
+``tunnel_watch.sh`` + ``chip_session_r5*.sh`` (now marked superseded)
+encoded retry-on-transient, idempotent leg completion, and the
+terminal-failure sentinel in copy-pasted shell nobody could test.  This
+CLI drives the same workflow through
+``parallel_convolution_tpu.resilience.supervisor``: one JSON legs file
+in, a JSON status ledger + per-leg stdout/stderr captures + (on terminal
+failure) a ``HALT`` sentinel out.
+
+Legs file: a JSON list of objects with fields
+  name              unique leg name (required)
+  cmd               argv list (required)
+  done_file         completion artifact path (optional; else rc==0)
+  done_pattern      regex the artifact must contain (optional)
+  terminal_pattern  regex in stdout+stderr marking an unretryable failure
+                    (e.g. '"magic_round_guard": "MISMATCH"')
+  timeout           per-attempt seconds (optional)
+  env               extra environment vars (optional)
+
+Example — the round-5 chip session, as data instead of shell::
+
+  [
+    {"name": "bench_sanity",
+     "cmd": ["python", "bench.py"],
+     "done_file": "evidence/bench_sanity.json",
+     "done_pattern": "\\"best_backend\\"",
+     "terminal_pattern": "\\"magic_round_guard\\": \\"MISMATCH\\"",
+     "timeout": 1800},
+    {"name": "soak",
+     "cmd": ["python", "scripts/soak.py", "--n", "20"],
+     "done_file": "evidence/soak.jsonl",
+     "done_pattern": "\\"summary\\"",
+     "timeout": 1800}
+  ]
+
+Exit codes: 0 all legs complete; 1 some leg exhausted its retries;
+2 terminal halt (sentinel written — remove it only after fixing the
+cause).  Re-running is always safe: completed legs are skipped and an
+existing sentinel refuses to run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import sys
+from pathlib import Path
+
+import _path  # noqa: F401  (repo root + JAX_PLATFORMS re-apply)
+
+from parallel_convolution_tpu.resilience.retry import RetryPolicy
+from parallel_convolution_tpu.resilience.supervisor import (
+    Supervisor, legs_from_json,
+)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--legs", required=True,
+                    help="JSON legs file (see module docstring)")
+    ap.add_argument("--state-dir", default="supervised_state",
+                    help="ledger + captures + HALT sentinel directory")
+    ap.add_argument("--max-attempts", type=int, default=5)
+    ap.add_argument("--base-delay", type=float, default=10.0,
+                    help="first backoff (seconds); doubles per attempt")
+    ap.add_argument("--max-delay", type=float, default=240.0,
+                    help="backoff cap — the old watcher's 4-minute probe")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="jitter seed (schedules are deterministic)")
+    ap.add_argument("--status", action="store_true",
+                    help="print the current ledger and exit")
+    ap.add_argument("--clear-halt", action="store_true",
+                    help="remove the HALT sentinel (after fixing the cause)")
+    args = ap.parse_args()
+
+    state = Path(args.state_dir)
+    if args.status:
+        ledger = state / "status.json"
+        print(ledger.read_text() if ledger.exists()
+              else json.dumps({"legs": {}, "halt": None}))
+        return 0
+    if args.clear_halt:
+        halt = state / "HALT"
+        if halt.exists():
+            shutil.copy(halt, halt.with_suffix(".cleared"))
+            halt.unlink()
+            print(f"removed {halt} (copy kept at {halt}.cleared)")
+        return 0
+
+    legs = legs_from_json(Path(args.legs).read_text())
+    sup = Supervisor(
+        legs, state,
+        policy=RetryPolicy(max_attempts=args.max_attempts,
+                           base_delay=args.base_delay,
+                           max_delay=args.max_delay, seed=args.seed),
+    )
+    return sup.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,13 @@
+#!/bin/bash
+# Tier-1 verify, encoded ONCE — this is the ROADMAP.md "Tier-1 verify"
+# command verbatim (keep the two in sync; the ROADMAP line is the spec).
+# bash, not sh: the verbatim command needs pipefail + PIPESTATUS.
+# Run from anywhere: resolves to the repo root first.
+cd "$(dirname "$0")/.." || exit 1
+
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc

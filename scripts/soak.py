@@ -14,15 +14,28 @@ pytest fuzzes keep the suite fast; this script converts idle wall-clock
 
 One JSON row per config (failures carry the config verbatim), one
 summary row at the end; exit 0 iff every config matched.
+
+``--faults N`` is the resilience soak: N random fault plans
+(resilience.faults specs — checkpoint tears, compile/exchange crashes)
+are sampled and each trial runs as a leg of the supervised runner
+(resilience.supervisor) in a clean 8-virtual-device CPU child: inject →
+crash mid-checkpointed-run → resume clean → byte-compare against the
+oracle.  Idle wall-clock (a dead TPU tunnel) thereby exercises the
+recovery paths, not just the happy path:
+
+  python scripts/soak.py --faults 16 --seed 0
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import random
 import sys
+import tempfile
 import time
+from pathlib import Path
 
 import _path  # noqa: F401  (repo root + JAX_PLATFORMS re-apply)
 
@@ -151,6 +164,121 @@ def run_converge(cfg, jax, np, filters, oracle, mesh_lib, step, imageio):
     return row
 
 
+def run_fault_trial(spec: str, seed: int, out_path: str) -> int:
+    """One injected-fault drill: crash a checkpointed run, resume, compare.
+
+    Runs in its own process (the supervised runner spawns it on the
+    forced 8-virtual-device CPU mesh) so an injected trace-time fault
+    can't poison compilation caches for sibling trials.  Exit 0 iff the
+    resumed output is byte-identical to the oracle.
+    """
+    import jax
+    import numpy as np
+
+    from parallel_convolution_tpu.ops import filters, oracle
+    from parallel_convolution_tpu.parallel import mesh as mesh_lib, step
+    from parallel_convolution_tpu.resilience import faults
+    from parallel_convolution_tpu.utils import checkpoint, imageio
+
+    rng = random.Random(seed)
+    filt = filters.get_filter(rng.choice(["blur3", "gaussian5", "sharpen3"]))
+    H, W = rng.randrange(33, 70), rng.randrange(33, 70)
+    total, every = rng.randrange(5, 11), rng.randrange(2, 4)
+    n_dev = len(jax.devices())
+    shape = rng.choice([s for s in [(1, 2), (2, 2), (2, 4)]
+                        if s[0] * s[1] <= n_dev] or [(1, 1)])
+    mesh = mesh_lib.make_grid_mesh(jax.devices()[: shape[0] * shape[1]],
+                                   shape)
+    img = imageio.generate_test_image(H, W, "grey", seed=seed)
+    x = imageio.interleaved_to_planar(img).astype(np.float32)
+    ck = tempfile.mkdtemp(prefix="pctpu_fault_trial_")
+
+    crashed = None
+    with faults.injected(spec, seed=seed) as plan:
+        try:
+            xs, valid_hw, _ = step._prepare(x, mesh, filt.radius)
+            checkpoint.run_checkpointed(xs, filt, total, mesh, valid_hw,
+                                        ckpt_dir=ck, every=every)
+        except Exception as e:  # noqa: BLE001 — the injected crash
+            crashed = repr(e)
+        fired = plan.fired
+    # The restarted process: fresh input, no plan — must auto-resume from
+    # whatever (possibly torn) checkpoint state the crash left behind.
+    xs2, valid_hw, _ = step._prepare(x, mesh, filt.radius)
+    out = checkpoint.run_checkpointed(xs2, filt, total, mesh, valid_hw,
+                                      ckpt_dir=ck, every=every)
+    got = np.asarray(out)[:, : valid_hw[0], : valid_hw[1]].astype(np.uint8)
+    want = oracle.run_serial_u8(img, filt, total)
+    ok = bool(np.array_equal(got[0], want))
+    row = {
+        "ok": ok, "spec": spec, "seed": seed, "crashed": crashed,
+        "fired": [list(f) for f in fired], "filter": filt.name,
+        "H": H, "W": W, "total": total, "every": every,
+        "mesh": "x".join(map(str, shape)),
+    }
+    Path(out_path).parent.mkdir(parents=True, exist_ok=True)
+    Path(out_path).write_text(json.dumps(row))
+    print(json.dumps(row), flush=True)
+    return 0 if ok else 1
+
+
+def _sample_fault_spec(rng: random.Random, n_shards: int) -> str:
+    """A random single-site plan biased toward checkpoint tears."""
+    site = rng.choice(
+        ["checkpoint_write_shard"] * 3 + ["checkpoint_write_meta"] * 2
+        + ["backend_compile", "halo_exchange"])
+    if site == "checkpoint_write_shard":
+        hit = rng.randrange(1, 2 * n_shards + 1)  # spans two save rounds
+    elif site == "checkpoint_write_meta":
+        hit = rng.randrange(1, 5)  # meta + LATEST consults, two saves
+    else:
+        hit = 1
+    return f"{site}:{hit}"
+
+
+def run_fault_soak(args) -> int:
+    """Sample ``--faults`` random plans; run each drill as a supervised leg."""
+    from parallel_convolution_tpu.resilience.retry import RetryPolicy
+    from parallel_convolution_tpu.resilience.supervisor import (
+        Leg, Supervisor,
+    )
+    from parallel_convolution_tpu.utils.platform import child_env_cpu
+
+    rng = random.Random(args.seed)
+    state = Path(args.state_dir or tempfile.mkdtemp(prefix="pctpu_fault_soak_"))
+    legs = []
+    for i in range(args.faults):
+        spec = _sample_fault_spec(rng, n_shards=8)
+        out = state / f"trial_{i:03d}.json"
+        legs.append(Leg(
+            name=f"trial_{i:03d}",
+            cmd=[sys.executable, os.path.abspath(__file__),
+                 "--fault-trial", spec,
+                 "--trial-seed", str(rng.randrange(10_000)),
+                 "--trial-out", str(out)],
+            done_file=str(out), done_pattern='"ok": true',
+            timeout=600.0, env=child_env_cpu(8),
+        ))
+    t0 = time.time()
+    sup = Supervisor(legs, state,
+                     policy=RetryPolicy(max_attempts=2, base_delay=0.2,
+                                        max_delay=1.0, seed=args.seed))
+    rc = sup.run()
+    fails = 0
+    for leg in legs:
+        p = Path(leg.done_file)
+        if p.exists():
+            print(p.read_text().strip(), flush=True)
+        if not leg.is_complete():
+            fails += 1
+    print(json.dumps({
+        "summary": "fault-soak", "n": args.faults, "seed": args.seed,
+        "failures": fails, "state_dir": str(state), "supervisor_rc": rc,
+        "wall_s": round(time.time() - t0, 1),
+    }), flush=True)
+    return 1 if (fails or rc) else 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=64)
@@ -158,7 +286,24 @@ def main() -> int:
     ap.add_argument("--converge", action="store_true",
                     help="soak the run-to-convergence path (C6) instead "
                          "of fixed-count iteration")
+    ap.add_argument("--faults", type=int, default=0, metavar="N",
+                    help="resilience mode: run N random injected-fault "
+                         "crash/resume drills through the supervised "
+                         "runner instead of the byte-compare soak")
+    ap.add_argument("--state-dir", default=None,
+                    help="--faults: supervisor state dir (default: mkdtemp)")
+    # Hidden: one drill in a child process (the supervisor's leg cmd).
+    ap.add_argument("--fault-trial", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--trial-seed", type=int, default=0,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--trial-out", default=None, help=argparse.SUPPRESS)
     args = ap.parse_args()
+
+    if args.fault_trial:
+        return run_fault_trial(args.fault_trial, args.trial_seed,
+                               args.trial_out)
+    if args.faults:
+        return run_fault_soak(args)
 
     import jax
     import numpy as np

@@ -1,4 +1,9 @@
 #!/bin/sh
+# SUPERSEDED (resilience PR): use scripts/run_supervised.py — the same
+# probe/retry/sentinel workflow as a tested library
+# (parallel_convolution_tpu/resilience/), with a JSON status ledger.
+# Kept as the round-5 operational record; do not extend.
+#
 # Probe the TPU tunnel every 4 minutes; whenever it answers, fire the
 # current chip-session queue (idempotent: [ -e ] guards skip landed
 # legs).  Keeps looping until every guarded output exists — a
