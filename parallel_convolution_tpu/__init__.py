@@ -20,6 +20,8 @@ Layout
                iteration step with double buffering + convergence (C6/C8).
 ``models/``    end-to-end pipelines: the flagship distributed ConvolutionModel
                and the Jacobi run-to-convergence solver.
+``serving/``   the long-lived service tier: warm-executable cache,
+               micro-batching, admission control, HTTP/in-process fronts.
 ``utils/``     raw image I/O (C7), benchmark timers (C10), tracing, config.
 ``cli.py``     command-line entrypoint mirroring the reference's argv
                vocabulary (C12).

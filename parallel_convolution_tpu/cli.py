@@ -120,14 +120,9 @@ def _resolve_perf_knobs(args, mesh) -> None:
 
 
 def _mesh_from_flag(spec: str | None):
-    from parallel_convolution_tpu.parallel.mesh import make_grid_mesh
+    from parallel_convolution_tpu.parallel.mesh import mesh_from_spec
 
-    if not spec:
-        return make_grid_mesh()
-    r, c = (int(v) for v in spec.lower().split("x"))
-    import jax
-
-    return make_grid_mesh(jax.devices()[: r * c], (r, c))
+    return mesh_from_spec(spec)
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -60,6 +60,20 @@ def make_grid_mesh(
     return Mesh(arr, AXES)
 
 
+def mesh_from_spec(spec: str | None) -> Mesh:
+    """Build the mesh a CLI ``--mesh`` flag names: ``"RxC"`` takes the
+    first R*C devices; None/empty means all devices near-square.  The ONE
+    parser for this grammar (cli.py, scripts/serve.py, scripts/loadgen.py
+    all route here, so the entry points cannot drift)."""
+    if not spec:
+        return make_grid_mesh()
+    try:
+        r, c = (int(v) for v in spec.lower().split("x"))
+    except ValueError as e:
+        raise ValueError(f"mesh spec must be RxC, got {spec!r}") from e
+    return make_grid_mesh(jax.devices()[: r * c], (r, c))
+
+
 def block_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding of a planar (C, H, W) image over the grid: P(None, 'x', 'y')."""
     return NamedSharding(mesh, P(None, *AXES))

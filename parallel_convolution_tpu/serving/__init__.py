@@ -1,0 +1,40 @@
+"""Serving layer: micro-batched convolution as a long-lived service.
+
+Every pre-round-8 entry point (CLI, bench.py, scripts/) is a one-shot
+batch run that pays compile + mesh setup per invocation.  This package is
+the sustained-throughput regime the ROADMAP north star actually names —
+"serves heavy traffic" — built as three thin layers over the existing
+stack, none of which duplicate compute code:
+
+``engine.py``    warm-executable cache keyed on the full compile identity
+                 (shape, filter, storage, iters, fuse, mesh, backend) with
+                 LRU eviction, startup warmup, and per-key single-flight
+                 compilation.  The persistent-communication idea of
+                 "Persistent & Partitioned MPI for Stencil Communication"
+                 (PAPERS.md): set the schedule up once, amortize it across
+                 many executions.
+``batcher.py``   bounded request queue + micro-batching: same-key requests
+                 coalesce into a stacked leading dim, flushed on
+                 max-batch-size or max-latency deadline.
+``service.py``   admission control (queue depth, per-request deadlines,
+                 typed load-shedding) wired into the resilience stack:
+                 transient failures retry via ``with_retry``; compile
+                 faults walk the ``degrade`` backend ladder per key;
+                 ``effective_backend`` is stamped into every response.
+``frontend.py``  stdlib-only HTTP/JSON frontend plus an in-process
+                 transport so tier-1 tests need no sockets.
+
+CLI surfaces: ``scripts/serve.py`` (boot the HTTP server) and
+``scripts/loadgen.py`` (closed/open-loop load generator emitting
+p50/p95/p99 + phase-breakdown rows in the bench-row schema).
+"""
+
+from parallel_convolution_tpu.serving.engine import EngineKey, WarmEngine
+from parallel_convolution_tpu.serving.service import (
+    ConvolutionService, Rejected, Request, Response,
+)
+
+__all__ = [
+    "ConvolutionService", "EngineKey", "Rejected", "Request", "Response",
+    "WarmEngine",
+]

@@ -1,0 +1,185 @@
+"""Bounded request queue with same-key micro-batching.
+
+The throughput regime of an iterated stencil is bandwidth-bound and its
+executables are batch-shaped, so the way to serve many small requests
+fast is to coalesce them: requests with the SAME :class:`EngineKey`
+stack on a leading dim and ride one device program.  The batcher is the
+queueing half of that bargain; the engine is the compute half.
+
+Invariants (asserted by ``tests/test_serving.py``):
+
+* **Bounded queue.**  ``try_submit`` refuses (returns False) once
+  ``max_queue`` items are pending — admission control happens at the
+  door, atomically with the queue, so overflow can never wedge the
+  worker or grow memory.
+* **Same-key only.**  A flush drains only items whose key equals the
+  head item's key (up to ``max_batch``); mixed-key arrivals are never
+  co-batched, because different keys mean different compiled programs.
+  Other keys keep their arrival order for subsequent flushes.
+* **Deadline flush.**  The head item waits at most ``max_delay_s`` for
+  batch-mates (or less, if its own deadline is sooner); a single request
+  on an idle service therefore completes in ~``max_delay_s``, it does
+  not wait for a full batch.
+* **One worker.**  All device execution happens on the single worker
+  thread, serializing access to the mesh; HTTP handler threads only
+  enqueue and wait on their slot.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+__all__ = ["MicroBatcher", "Slot"]
+
+
+class Slot:
+    """One request's result rendezvous (a minimal, stdlib-only future)."""
+
+    __slots__ = ("_event", "_result")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result = None
+
+    def set(self, result) -> None:
+        self._result = result
+        self._event.set()
+
+    def result(self, timeout: float | None = None):
+        """The Response/Rejected once available; None on wait timeout."""
+        if not self._event.wait(timeout):
+            return None
+        return self._result
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+
+class _Item:
+    __slots__ = ("key", "payload", "slot", "enqueued_at", "deadline_at")
+
+    def __init__(self, key, payload, deadline_at):
+        self.key = key
+        self.payload = payload
+        self.slot = Slot()
+        self.enqueued_at = time.monotonic()
+        self.deadline_at = deadline_at  # absolute monotonic, or None
+
+
+class MicroBatcher:
+    """Coalesce same-key requests; flush on size or deadline.
+
+    ``execute(key, items)`` (the service's batch runner) is called on the
+    worker thread with 1..max_batch same-key items and MUST set every
+    item's slot — the batcher guarantees delivery attempts, the executor
+    guarantees typed results.
+    """
+
+    def __init__(self, execute, *, max_batch: int = 8,
+                 max_delay_s: float = 0.005, max_queue: int = 64,
+                 start: bool = True):
+        if max_batch < 1 or max_queue < 1 or max_delay_s < 0:
+            raise ValueError("max_batch/max_queue >= 1, max_delay_s >= 0")
+        self._execute = execute
+        self.max_batch = max_batch
+        self.max_delay_s = max_delay_s
+        self.max_queue = max_queue
+        self._cv = threading.Condition()
+        self._pending: deque[_Item] = deque()
+        self._closed = False
+        self._worker: threading.Thread | None = None
+        self.stats = {"enqueued": 0, "refused": 0, "flushes": 0,
+                      "flushed_items": 0, "max_observed_depth": 0}
+        if start:
+            self.start()
+
+    # -- producer side -------------------------------------------------------
+    def try_submit(self, key, payload, deadline_at=None) -> Slot | None:
+        """Enqueue; returns the item's :class:`Slot`, or None when the
+        queue is full or the batcher closed (the caller sheds load)."""
+        item = _Item(key, payload, deadline_at)
+        with self._cv:
+            if self._closed or len(self._pending) >= self.max_queue:
+                self.stats["refused"] += 1
+                return None
+            self._pending.append(item)
+            self.stats["enqueued"] += 1
+            self.stats["max_observed_depth"] = max(
+                self.stats["max_observed_depth"], len(self._pending))
+            self._cv.notify_all()
+        return item.slot
+
+    def depth(self) -> int:
+        with self._cv:
+            return len(self._pending)
+
+    # -- worker side ---------------------------------------------------------
+    def start(self) -> None:
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._loop, name="pctpu-batcher", daemon=True)
+            self._worker.start()
+
+    def close(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop accepting; optionally wait for the queue to drain."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        w = self._worker
+        if drain and w is not None and w.is_alive():
+            w.join(timeout)
+
+    def _collect(self) -> tuple[object, list[_Item]] | None:
+        """Block until a flush is due; returns (key, same-key items)."""
+        with self._cv:
+            while not self._pending:
+                if self._closed:
+                    return None
+                self._cv.wait(timeout=0.1)
+            head = self._pending[0]
+            flush_at = head.enqueued_at + self.max_delay_s
+            if head.deadline_at is not None and head.deadline_at < flush_at:
+                # The head cannot afford the full batching window: flush
+                # NOW rather than gamble its remaining budget on
+                # hypothetical batch-mates.  (Waiting until exactly
+                # deadline_at would guarantee the executor's expiry check
+                # sheds it — a tight deadline on an idle service must be
+                # served, not starved.)
+                flush_at = 0.0
+            while True:
+                n_same = sum(1 for it in self._pending if it.key == head.key)
+                now = time.monotonic()
+                if (n_same >= self.max_batch or now >= flush_at
+                        or self._closed):
+                    break
+                self._cv.wait(timeout=flush_at - now)
+            batch: list[_Item] = []
+            rest: deque[_Item] = deque()
+            for it in self._pending:
+                if it.key == head.key and len(batch) < self.max_batch:
+                    batch.append(it)
+                else:
+                    rest.append(it)   # order among other keys preserved
+            self._pending = rest
+            self.stats["flushes"] += 1
+            self.stats["flushed_items"] += len(batch)
+            self._cv.notify_all()
+            return head.key, batch
+
+    def _loop(self) -> None:
+        while True:
+            got = self._collect()
+            if got is None:
+                return
+            key, batch = got
+            try:
+                self._execute(key, batch)
+            except BaseException as e:  # noqa: BLE001 — never kill the worker
+                # The executor's contract is typed results; if it leaked an
+                # exception anyway, fail its items rather than hanging their
+                # waiters (and keep serving subsequent batches).
+                for it in batch:
+                    if not it.slot.done():
+                        it.slot.set(e)

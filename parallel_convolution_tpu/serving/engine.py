@@ -1,0 +1,325 @@
+"""Warm-executable engine: compile once per key, serve many times.
+
+A one-shot entry point (CLI ``run``, ``bench.py``) pays trace + compile +
+mesh setup on every invocation; a service must pay them once per
+*configuration* and amortize across the request stream — the serving
+analogue of persistent MPI channels (PAPERS.md "Persistent and
+Partitioned MPI for Stencil Communication": set the communication/compute
+schedule up once, reuse it for every iteration).
+
+Design points:
+
+* **Key = full compile identity.**  :class:`EngineKey` carries everything
+  that changes the compiled program: per-request image shape, filter,
+  storage dtype, iteration count, fuse, boundary, quantize, requested
+  backend — plus the engine's mesh grid.  Two requests with equal keys
+  are guaranteed to share an executable (and therefore to be safely
+  micro-batchable).
+* **LRU eviction.**  The cache holds at most ``capacity`` keys; touching
+  a key refreshes it.  Eviction drops the engine's reference (the
+  underlying ``parallel.step._build_iterate`` lru_cache may briefly keep
+  the jitted runner alive; that cache is bounded too).
+* **Per-key single-flight.**  A cold key compiles exactly once no matter
+  how many threads ask for it concurrently: one leader compiles, the
+  rest wait on the in-flight event — a thundering herd of identical cold
+  requests can never stampede the compiler.
+* **Degradation per key.**  With ``fallback=True`` (the serving default)
+  the requested backend is resolved through the resilience ladder
+  (``resilience.degrade``: probe once, walk pallas_rdma → pallas →
+  shifted on classified-transient compile faults); the entry records the
+  ``effective_backend`` that every response is stamped with.
+
+Batched execution stacks B same-key images on the leading dim and folds
+them into the plane axis — ``(B, C, H, W) → (B*C, H, W)`` — which is the
+framework's established data-parallel tier (``ConvolutionModel.run_images``
+concatenates planes the same way; SURVEY.md §2: DP "falls out free"
+because every plane is independent in the stencil).  The fold is exactly
+a vmap of the prepared per-image step over the stacked dim, realized on
+the axis the compiled runner already treats as batch — so batched bytes
+are identical to sequential single-request bytes by construction, which
+``tests/test_serving.py`` asserts per backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from parallel_convolution_tpu.ops.filters import get_filter
+from parallel_convolution_tpu.utils.config import (
+    BACKENDS, BOUNDARIES, STORAGES,
+)
+from parallel_convolution_tpu.utils.tracing import PhaseTimer
+
+__all__ = ["EngineKey", "WarmEngine"]
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineKey:
+    """The compile identity of one servable configuration.
+
+    ``shape`` is ONE request's planar image shape (C, H, W); the batch
+    dim is not part of the key — executables per batch size live inside
+    the key's cache entry.  ``grid`` pins the mesh the executable was
+    built for, so an engine restarted on different hardware can never
+    alias a stale key.
+    """
+
+    shape: tuple[int, int, int]      # (C, H, W) of one request
+    filter_name: str = "blur3"
+    storage: str = "f32"
+    iters: int = 1
+    fuse: int = 1
+    boundary: str = "zero"
+    quantize: bool = True
+    backend: str = "shifted"         # requested; the entry records effective
+    grid: tuple[int, int] = (1, 1)   # mesh grid (rows, cols)
+
+    def validate(self) -> None:
+        """Terminal (ValueError) on any out-of-registry field — the typed
+        ``Rejected("invalid")`` the service returns comes from here."""
+        get_filter(self.filter_name)  # raises on unknown names
+        if self.backend not in BACKENDS:
+            raise ValueError(f"unknown backend {self.backend!r}")
+        if self.storage not in STORAGES:
+            raise ValueError(f"unknown storage {self.storage!r}")
+        if self.boundary not in BOUNDARIES:
+            raise ValueError(f"unknown boundary {self.boundary!r}")
+        if self.storage == "u8" and not self.quantize:
+            raise ValueError("storage='u8' requires quantize=True")
+        if len(self.shape) != 3 or min(self.shape) < 1:
+            raise ValueError(f"bad planar shape {self.shape}")
+        if self.iters < 1 or self.fuse < 1:
+            raise ValueError("iters and fuse must be >= 1")
+
+
+class _Entry:
+    """One warm key: resolved backend + compiled runners per batch size."""
+
+    __slots__ = ("key", "effective_backend", "fns", "lock")
+
+    def __init__(self, key: EngineKey, effective_backend: str):
+        self.key = key
+        self.effective_backend = effective_backend
+        self.fns: dict[int, object] = {}   # batch size -> jitted runner
+        self.lock = threading.Lock()       # per-batch-size build flight
+
+
+class _InFlight:
+    """A cold key's compilation in progress: leader fills, waiters wait."""
+
+    __slots__ = ("event", "entry", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.entry: _Entry | None = None
+        self.error: BaseException | None = None
+
+
+class WarmEngine:
+    """Warm-executable cache over ``parallel.step`` for a fixed mesh."""
+
+    def __init__(self, mesh=None, capacity: int = 16, fallback: bool = True):
+        from parallel_convolution_tpu.parallel.mesh import make_grid_mesh
+
+        self.mesh = mesh if mesh is not None else make_grid_mesh()
+        self.capacity = max(1, int(capacity))
+        self.fallback = fallback
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[EngineKey, _Entry] = OrderedDict()
+        self._inflight: dict[EngineKey, _InFlight] = {}
+        self.stats = {
+            "hits": 0, "misses": 0, "compiles": 0, "evictions": 0,
+            "single_flight_waits": 0, "batches": 0, "images": 0,
+        }
+
+    # -- key construction ---------------------------------------------------
+    def key_for(self, shape, **kw) -> EngineKey:
+        """An :class:`EngineKey` for this engine's mesh; clamps fuse the
+        way ``_build_iterate`` will, so equal executables get equal keys."""
+        from parallel_convolution_tpu.parallel.mesh import grid_shape
+
+        key = EngineKey(shape=tuple(int(s) for s in shape),
+                        grid=grid_shape(self.mesh), **kw)
+        if key.fuse > max(1, key.iters):
+            key = dataclasses.replace(key, fuse=max(1, key.iters))
+        return key
+
+    # -- entry acquisition (LRU + single-flight) ----------------------------
+    def entry(self, key: EngineKey) -> _Entry:
+        """The warm entry for ``key``; compiles (single-flight) when cold."""
+        while True:
+            with self._lock:
+                e = self._entries.get(key)
+                if e is not None:
+                    self._entries.move_to_end(key)
+                    self.stats["hits"] += 1
+                    return e
+                fl = self._inflight.get(key)
+                if fl is None:
+                    fl = _InFlight()
+                    self._inflight[key] = fl
+                    self.stats["misses"] += 1
+                    leader = True
+                else:
+                    self.stats["single_flight_waits"] += 1
+                    leader = False
+            if not leader:
+                fl.event.wait()
+                if fl.error is not None:
+                    raise fl.error
+                # The leader landed the entry; loop to take the hit path
+                # (or recompile if an eviction already dropped it).
+                with self._lock:
+                    e = self._entries.get(key)
+                    if e is not None:
+                        self._entries.move_to_end(key)
+                        return e
+                continue
+            try:
+                entry = self._build_entry(key)
+            except BaseException as err:
+                fl.error = err
+                with self._lock:
+                    self._inflight.pop(key, None)
+                fl.event.set()
+                raise
+            with self._lock:
+                self._entries[key] = entry
+                self._entries.move_to_end(key)
+                while len(self._entries) > self.capacity:
+                    self._entries.popitem(last=False)
+                    self.stats["evictions"] += 1
+                self._inflight.pop(key, None)
+            fl.event.set()
+            return entry
+
+    def _build_entry(self, key: EngineKey) -> _Entry:
+        """Resolve the backend (degradation ladder) and compile batch=1.
+
+        Runs OUTSIDE the engine lock (compiles take seconds on real
+        chips); the single-flight record keeps concurrent cold callers
+        from duplicating the work.
+        """
+        key.validate()
+        effective = key.backend
+        if self.fallback:
+            from parallel_convolution_tpu.resilience import degrade
+
+            effective = degrade.resolve_backend(
+                self.mesh, get_filter(key.filter_name), key.backend,
+                quantize=key.quantize, fuse=key.fuse, boundary=key.boundary,
+                storage=key.storage, block_hw=self._block_hw(key))
+        entry = _Entry(key, effective)
+        self._compile_batch(entry, 1)
+        return entry
+
+    def _block_hw(self, key: EngineKey) -> tuple[int, int]:
+        from parallel_convolution_tpu.parallel.mesh import padded_extent
+
+        (_, H, W), (R, C) = key.shape, key.grid
+        return (padded_extent(H, R) // R, padded_extent(W, C) // C)
+
+    def _compile_batch(self, entry: _Entry, batch: int):
+        """The jitted runner for ``batch`` stacked requests of this key."""
+        with entry.lock:
+            fn = entry.fns.get(batch)
+            if fn is not None:
+                return fn
+            from parallel_convolution_tpu.parallel import step as step_lib
+
+            key = entry.key
+            C, H, W = key.shape
+            filt = get_filter(key.filter_name)
+            # Folded leading dim: batch × channels independent planes.
+            probe = np.zeros((batch * C, H, W), np.float32)
+            xs, valid_hw, block_hw = step_lib._prepare(
+                probe, self.mesh, filt.radius, key.storage)
+            fn = step_lib._build_iterate(
+                self.mesh, filt, key.iters, key.quantize, valid_hw,
+                block_hw, entry.effective_backend, key.fuse, key.boundary,
+                None, False)
+            # Trace + XLA-compile NOW (jit compiles on first call): warm
+            # means the request path never sees compilation.
+            import jax
+
+            jax.block_until_ready(fn(xs))
+            entry.fns[batch] = fn
+            with self._lock:
+                self.stats["compiles"] += 1
+            return fn
+
+    # -- warmup -------------------------------------------------------------
+    def warmup(self, keys) -> list[str]:
+        """Pre-compile declared configs (batch size 1); returns the
+        effective backend per key, in order."""
+        return [self.entry(k).effective_backend for k in keys]
+
+    # -- execution ----------------------------------------------------------
+    def run_batch(self, key: EngineKey, images: np.ndarray,
+                  timer: PhaseTimer | None = None):
+        """Run ``images`` (B, C, H, W) f32 through the warm executable.
+
+        Returns ``(out, info)``: ``out`` is (B, C, H, W) float32 with the
+        valid extent restored, ``info`` carries ``effective_backend`` and
+        the compile/copy_in/device/copy_out phase walls (seconds) from
+        ``timer`` (a fresh :class:`PhaseTimer` when not supplied — the
+        serving latency breakdown reuses its ``to_row`` export).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from parallel_convolution_tpu.parallel import step as step_lib
+
+        t = timer or PhaseTimer()
+        B, C, H, W = images.shape
+        if (C, H, W) != key.shape:
+            raise ValueError(
+                f"batch shape {(C, H, W)} does not match key {key.shape}")
+        with t.phase("compile"):
+            entry = self.entry(key)
+            fn = entry.fns.get(B) or self._compile_batch(entry, B)
+        filt = get_filter(key.filter_name)
+        with t.phase("copy_in"):
+            folded = np.ascontiguousarray(
+                images.reshape(B * C, H, W).astype(np.float32))
+            xs, valid_hw, _ = step_lib._prepare(
+                folded, self.mesh, filt.radius, key.storage)
+            jax.block_until_ready(xs)
+        with t.phase("device"):
+            out = fn(xs)
+            jax.block_until_ready(out)
+        with t.phase("copy_out"):
+            out = np.asarray(
+                out[:, : valid_hw[0], : valid_hw[1]].astype(jnp.float32))
+            out = out.reshape(B, C, H, W)
+        with self._lock:
+            self.stats["batches"] += 1
+            self.stats["images"] += B
+        info = {
+            "effective_backend": entry.effective_backend,
+            "batch_size": B,
+            "phases": {name: t.wall(name)
+                       for name in ("compile", "copy_in", "device",
+                                    "copy_out")},
+        }
+        return out, info
+
+    # -- introspection ------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Stats + resident keys, for /stats and the loadgen row."""
+        with self._lock:
+            return {
+                "stats": dict(self.stats),
+                "capacity": self.capacity,
+                "resident": [
+                    {"filter": k.filter_name, "shape": list(k.shape),
+                     "backend": k.backend,
+                     "effective_backend": e.effective_backend,
+                     "batch_sizes": sorted(e.fns)}
+                    for k, e in self._entries.items()
+                ],
+            }

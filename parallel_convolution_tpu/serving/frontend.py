@@ -1,0 +1,180 @@
+"""Transports for the convolution service: in-process and HTTP/JSON.
+
+One wire format, two transports:
+
+* :class:`InProcessClient` — dict-in/dict-out against a local
+  :class:`ConvolutionService`.  Tier-1 tests and ``loadgen --in-process``
+  use this: the full request/response codec is exercised with no sockets.
+* :func:`make_http_server` — a stdlib-only ``ThreadingHTTPServer``
+  speaking the same JSON bodies.  No framework, no dependencies: the
+  deployment story stays "python scripts/serve.py".
+
+Wire format (POST ``/v1/convolve``)::
+
+    {"image_b64": <base64 raw u8 bytes>, "rows": H, "cols": W,
+     "mode": "grey"|"rgb", "filter": "blur3", "iters": 1,
+     "backend": "shifted", "storage": "f32", "fuse": 1,
+     "boundary": "zero", "quantize": true, "deadline_ms": 500}
+
+    200 -> {"ok": true, "image_b64": ..., "effective_backend": ...,
+            "backend": ..., "request_id": ..., "batch_size": ...,
+            "phases": {"queue": s, "compile": s, "device": s,
+                       "copy_in": s, "copy_out": s, "total": s}}
+    400 -> {"ok": false, "rejected": "invalid",    "detail": ...}
+    429 -> {"ok": false, "rejected": "queue_full"|"deadline"|"error", ...}
+
+``GET /healthz`` returns ``{"ok": true}`` plus the service snapshot;
+``GET /stats`` returns the snapshot alone.  Rejections map to HTTP 429
+(load shed — retryable by the client) except contract errors (400).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+
+import numpy as np
+
+from parallel_convolution_tpu.serving.service import (
+    ConvolutionService, Rejected, Request, Response,
+)
+
+__all__ = ["InProcessClient", "decode_request", "encode_response",
+           "make_http_server"]
+
+_REJECT_STATUS = {"invalid": 400, "queue_full": 429, "deadline": 429,
+                  "error": 429, "timeout": 504}
+
+
+def decode_request(body: dict) -> Request:
+    """Wire dict → :class:`Request` (raises ValueError on malformed).
+
+    EVERY coercion sits inside the try: a null/listy ``iters`` or
+    ``deadline_ms`` raises TypeError, which must surface as the typed
+    400, not as an unhandled handler-thread exception (DESIGN.md
+    invariant 3: contract violations are typed, decided before enqueue).
+    """
+    try:
+        rows, cols = int(body["rows"]), int(body["cols"])
+        mode = body.get("mode", "grey")
+        raw = base64.b64decode(body["image_b64"])
+        channels = 3 if mode == "rgb" else 1
+        if rows < 1 or cols < 1:
+            raise ValueError(f"bad image extent {rows}x{cols}")
+        if len(raw) != rows * cols * channels:
+            raise ValueError(
+                f"image_b64 carries {len(raw)} bytes, expected "
+                f"{rows * cols * channels} for {rows}x{cols} {mode}")
+        img = np.frombuffer(raw, np.uint8).reshape(
+            (rows, cols, 3) if mode == "rgb" else (rows, cols))
+        deadline_ms = body.get("deadline_ms")
+        return Request(
+            image=img,
+            filter_name=body.get("filter", "blur3"),
+            iters=int(body.get("iters", 1)),
+            backend=body.get("backend", "shifted"),
+            storage=body.get("storage", "f32"),
+            fuse=int(body.get("fuse", 1)),
+            boundary=body.get("boundary", "zero"),
+            quantize=bool(body.get("quantize", True)),
+            deadline_s=(float(deadline_ms) / 1e3
+                        if deadline_ms is not None else None),
+            request_id=body.get("request_id"),
+        )
+    except (KeyError, TypeError, ValueError) as e:
+        raise ValueError(f"malformed request body: {e}") from e
+
+
+def encode_response(result) -> tuple[int, dict]:
+    """:class:`Response`/:class:`Rejected` → (http_status, wire dict)."""
+    if isinstance(result, Rejected):
+        return _REJECT_STATUS.get(result.reason, 429), {
+            "ok": False, "rejected": result.reason,
+            "request_id": result.request_id, "detail": result.detail,
+        }
+    assert isinstance(result, Response)
+    return 200, {
+        "ok": True,
+        "image_b64": base64.b64encode(
+            np.ascontiguousarray(result.image).tobytes()).decode("ascii"),
+        "effective_backend": result.effective_backend,
+        "backend": result.backend,
+        "request_id": result.request_id,
+        "batch_size": result.batch_size,
+        "phases": result.phases,
+    }
+
+
+class InProcessClient:
+    """The socket-free transport: same codec, direct service calls."""
+
+    def __init__(self, service: ConvolutionService):
+        self.service = service
+
+    def request(self, body: dict,
+                timeout: float | None = None) -> tuple[int, dict]:
+        """One wire-format request → (status, wire-format response)."""
+        try:
+            req = decode_request(body)
+        except ValueError as e:
+            return 400, {"ok": False, "rejected": "invalid",
+                         "request_id": body.get("request_id") or "",
+                         "detail": str(e)}
+        return encode_response(self.service.submit(req, timeout=timeout))
+
+    def healthz(self) -> tuple[int, dict]:
+        return 200, {"ok": True, **self.service.snapshot()}
+
+    def stats(self) -> tuple[int, dict]:
+        return 200, self.service.snapshot()
+
+
+def make_http_server(service: ConvolutionService, host: str = "127.0.0.1",
+                     port: int = 8080):
+    """A ``ThreadingHTTPServer`` bound to (host, port); ``port=0`` picks a
+    free one (``server.server_address[1]`` reports it).  The caller runs
+    ``serve_forever()`` / ``shutdown()``; handler threads block inside
+    ``service.submit`` while the single batcher worker drives the mesh.
+    """
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    client = InProcessClient(service)
+
+    class Handler(BaseHTTPRequestHandler):
+        # Quiet by default: per-request lines go through log_message,
+        # which a server script may re-point at its own logger.
+        def log_message(self, fmt, *args):  # noqa: D102
+            pass
+
+        def _send(self, status: int, payload: dict) -> None:
+            data = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):  # noqa: N802 — http.server API
+            if self.path == "/healthz":
+                self._send(*client.healthz())
+            elif self.path == "/stats":
+                self._send(*client.stats())
+            else:
+                self._send(404, {"ok": False, "detail": "unknown path"})
+
+        def do_POST(self):  # noqa: N802 — http.server API
+            if self.path != "/v1/convolve":
+                self._send(404, {"ok": False, "detail": "unknown path"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
+            except (ValueError, json.JSONDecodeError) as e:
+                self._send(400, {"ok": False, "rejected": "invalid",
+                                 "detail": f"bad JSON body: {e}"})
+                return
+            self._send(*client.request(body))
+
+    return ThreadingHTTPServer((host, port), Handler)

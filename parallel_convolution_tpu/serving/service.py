@@ -1,0 +1,289 @@
+"""Admission-controlled convolution service over the warm engine.
+
+The request/response surface of the serving layer: validation, admission
+control (bounded queue depth + per-request deadlines + typed
+load-shedding), micro-batched execution, and the resilience wiring —
+transient failures retry via ``resilience.retry.with_retry`` and compile
+faults walk the ``resilience.degrade`` backend ladder per key (inside
+the engine).  Every successful response is stamped with the
+``effective_backend`` that actually produced its bytes, continuing the
+round-7 rule that a degraded tier can never masquerade as the requested
+one in any artifact.
+
+Results are TYPED, never exceptions across the service boundary:
+
+* :class:`Response`  — the filtered image + per-request latency phases
+  (queue / compile / device / copy, from ``utils.tracing.PhaseTimer``).
+* :class:`Rejected`  — load shedding (``queue_full``), missed deadlines
+  (``deadline``), contract errors (``invalid``), and exhausted/terminal
+  execution failures (``error``).  A queue overflow yields a
+  ``Rejected``, not an exception and not a hang — asserted in tier-1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+
+import numpy as np
+
+from parallel_convolution_tpu.serving.batcher import MicroBatcher
+from parallel_convolution_tpu.serving.engine import EngineKey, WarmEngine
+from parallel_convolution_tpu.utils.tracing import PhaseTimer
+
+__all__ = ["ConvolutionService", "Rejected", "Request", "Response"]
+
+
+@dataclasses.dataclass
+class Request:
+    """One filtering request: an interleaved u8 image + run knobs.
+
+    ``image`` is (H, W) grey or (H, W, 3) RGB uint8 — the reference CLI's
+    image contract.  ``deadline_s`` is a relative latency budget; a
+    request still queued past it is shed with ``Rejected("deadline")``
+    rather than served late.
+    """
+
+    image: np.ndarray
+    filter_name: str = "blur3"
+    iters: int = 1
+    backend: str = "shifted"
+    storage: str = "f32"
+    fuse: int = 1
+    boundary: str = "zero"
+    quantize: bool = True
+    deadline_s: float | None = None
+    request_id: str | None = None
+
+
+@dataclasses.dataclass
+class Response:
+    """A served result; ``phases`` is the per-request latency breakdown
+    in seconds (queue, compile, device, copy_in, copy_out, total)."""
+
+    image: np.ndarray                # uint8, same layout as the request
+    effective_backend: str
+    backend: str                     # as requested
+    request_id: str
+    batch_size: int                  # how many requests shared the program
+    phases: dict
+
+    ok = True
+
+
+@dataclasses.dataclass
+class Rejected:
+    """A typed non-result: load shed, deadline miss, or failed execution."""
+
+    reason: str                      # queue_full | deadline | invalid | error
+    request_id: str
+    detail: str = ""
+
+    ok = False
+
+
+class ConvolutionService:
+    """Micro-batched, admission-controlled serving of the stencil stack.
+
+    ``retry_policy`` governs ``with_retry`` around batch execution:
+    classified-transient failures (tunnel blips, injected faults, Mosaic
+    INTERNAL crashes) are retried with deterministic backoff; terminal
+    failures and exhausted retries become ``Rejected("error")`` for every
+    request in the batch.  ``fallback`` (default True) lets the engine
+    walk the degradation ladder per key on transient compile faults.
+    """
+
+    def __init__(self, mesh=None, *, capacity: int = 16,
+                 max_batch: int = 8, max_delay_s: float = 0.005,
+                 max_queue: int = 64, fallback: bool = True,
+                 retry_policy=None, start: bool = True):
+        from parallel_convolution_tpu.resilience.retry import RetryPolicy
+
+        self.engine = WarmEngine(mesh, capacity=capacity, fallback=fallback)
+        self.retry_policy = retry_policy or RetryPolicy(
+            max_attempts=3, base_delay=0.05, max_delay=2.0)
+        self.batcher = MicroBatcher(
+            self._execute_batch, max_batch=max_batch,
+            max_delay_s=max_delay_s, max_queue=max_queue, start=start)
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+        self.stats = {
+            "submitted": 0, "completed": 0, "retries": 0,
+            "rejected_queue_full": 0, "rejected_deadline": 0,
+            "rejected_invalid": 0, "rejected_error": 0,
+            "client_timeouts": 0,
+        }
+
+    # -- admission -----------------------------------------------------------
+    def _bump(self, counter: str, n: int = 1) -> None:
+        with self._lock:
+            self.stats[counter] += n
+
+    def _validate(self, req: Request) -> tuple[EngineKey, np.ndarray]:
+        """Terminal ValueError on any contract violation (→ ``invalid``)."""
+        from parallel_convolution_tpu.ops.filters import get_filter
+        from parallel_convolution_tpu.utils import imageio
+
+        img = np.asarray(req.image)
+        if img.dtype != np.uint8 or img.ndim not in (2, 3) or (
+                img.ndim == 3 and img.shape[-1] != 3):
+            raise ValueError(
+                f"image must be uint8 (H, W) or (H, W, 3), got "
+                f"{img.dtype} {img.shape}")
+        planar = imageio.interleaved_to_planar(img).astype(np.float32)
+        key = self.engine.key_for(
+            planar.shape, filter_name=req.filter_name, storage=req.storage,
+            iters=int(req.iters), fuse=int(req.fuse), boundary=req.boundary,
+            quantize=bool(req.quantize), backend=req.backend)
+        key.validate()
+        filt = get_filter(key.filter_name)
+        R, C = key.grid
+        if (min(-(-planar.shape[1] // R), -(-planar.shape[2] // C))
+                < filt.radius * key.fuse):
+            raise ValueError(
+                f"per-device block smaller than radius*fuse "
+                f"({filt.radius}*{key.fuse}) for image "
+                f"{planar.shape[1:]} on grid {key.grid}")
+        if key.boundary == "periodic" and (
+                planar.shape[1] % R or planar.shape[2] % C):
+            raise ValueError(
+                "periodic boundary requires grid-divisible dimensions")
+        return key, planar
+
+    def submit(self, req: Request, wait: bool = True,
+               timeout: float | None = None):
+        """Admit + (optionally) await one request.
+
+        ``wait=True`` returns a :class:`Response` or :class:`Rejected`;
+        ``wait=False`` returns the queue :class:`Slot` (or the immediate
+        ``Rejected``) so callers can multiplex.
+        """
+        rid = req.request_id or f"r{next(self._ids)}"
+        self._bump("submitted")
+        try:
+            key, planar = self._validate(req)
+        except Exception as e:  # noqa: BLE001 — contract errors are typed
+            self._bump("rejected_invalid")
+            return Rejected("invalid", rid, detail=str(e))
+        deadline_at = (time.monotonic() + req.deadline_s
+                       if req.deadline_s is not None else None)
+        payload = {"planar": planar, "rid": rid, "rgb": req.image.ndim == 3,
+                   "backend": req.backend}
+        slot = self.batcher.try_submit(key, payload, deadline_at)
+        if slot is None:
+            self._bump("rejected_queue_full")
+            return Rejected("queue_full", rid,
+                            detail=f"queue depth >= {self.batcher.max_queue}")
+        if not wait:
+            return slot
+        result = slot.result(timeout)
+        if result is None:
+            # NOT a server-side shed: the caller gave up waiting while the
+            # request may still be executing (and will later count as
+            # completed).  Distinct reason + counter so an unresponsive
+            # service can never reconcile as healthy load shedding.
+            self._bump("client_timeouts")
+            return Rejected("timeout", rid, detail="client wait timed out")
+        return result
+
+    # -- execution (batcher worker thread) ------------------------------------
+    def _execute_batch(self, key: EngineKey, items) -> None:
+        from parallel_convolution_tpu.resilience.retry import with_retry
+        from parallel_convolution_tpu.utils import imageio
+
+        start = time.monotonic()
+        live = []
+        for it in items:
+            if it.deadline_at is not None and start > it.deadline_at:
+                self._bump("rejected_deadline")
+                it.slot.set(Rejected(
+                    "deadline", it.payload["rid"],
+                    detail=f"queued {start - it.enqueued_at:.3f}s past "
+                           "deadline"))
+            else:
+                live.append(it)
+        if not live:
+            return
+        stacked = np.stack([it.payload["planar"] for it in live])
+        timer = PhaseTimer()
+
+        def attempt():
+            return self.engine.run_batch(key, stacked, timer=timer)
+
+        def on_retry(attempt_no, exc, delay):
+            self._bump("retries")
+
+        try:
+            out, info = with_retry(attempt, self.retry_policy,
+                                   on_retry=on_retry)
+        except Exception as e:  # noqa: BLE001 — typed result, never a hang
+            self._bump("rejected_error", len(live))
+            for it in live:
+                it.slot.set(Rejected("error", it.payload["rid"],
+                                     detail=repr(e)[:500]))
+            return
+        phases = dict(info["phases"])
+        u8 = np.clip(np.rint(out), 0.0, 255.0).astype(np.uint8)
+        for i, it in enumerate(live):
+            plane = u8[i]
+            image = (imageio.planar_to_interleaved(plane)
+                     if it.payload["rgb"] else plane[0])
+            queue_s = start - it.enqueued_at
+            per = {"queue": round(queue_s, 6),
+                   **{k: round(v, 6) for k, v in phases.items()},
+                   }
+            per["total"] = round(queue_s + sum(phases.values()), 6)
+            it.slot.set(Response(
+                image=image,
+                effective_backend=info["effective_backend"],
+                backend=it.payload["backend"],
+                request_id=it.payload["rid"],
+                batch_size=info["batch_size"],
+                phases=per,
+            ))
+            self._bump("completed")
+
+    # -- lifecycle / introspection -------------------------------------------
+    def warmup(self, configs) -> list[str]:
+        """Pre-compile declared configs before taking traffic.
+
+        ``configs`` are dicts with ``rows``/``cols``/``mode`` plus any
+        :class:`Request` knobs (filter, iters, backend, storage, fuse,
+        boundary, quantize); returns each config's effective backend.
+        """
+        keys = []
+        for c in configs:
+            channels = 3 if c.get("mode", "grey") == "rgb" else 1
+            keys.append(self.engine.key_for(
+                (channels, int(c["rows"]), int(c["cols"])),
+                filter_name=c.get("filter", c.get("filter_name", "blur3")),
+                storage=c.get("storage", "f32"),
+                iters=int(c.get("iters", 1)),
+                fuse=int(c.get("fuse", 1)),
+                boundary=c.get("boundary", "zero"),
+                quantize=bool(c.get("quantize", True)),
+                backend=c.get("backend", "shifted")))
+        return self.engine.warmup(keys)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            stats = dict(self.stats)
+        snap = self.engine.snapshot()
+        dev = self.engine.mesh.devices.flat[0]
+        return {
+            "service": stats,
+            "batcher": dict(self.batcher.stats),
+            "engine": snap["stats"],
+            "resident": snap["resident"],
+            "queue_depth": self.batcher.depth(),
+            "mesh": "x".join(str(s)
+                             for s in (self.engine.mesh.shape["x"],
+                                       self.engine.mesh.shape["y"])),
+            "platform": dev.platform,
+            "device_kind": getattr(dev, "device_kind", "") or "",
+        }
+
+    def close(self) -> None:
+        self.batcher.close()

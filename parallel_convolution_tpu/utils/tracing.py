@@ -6,7 +6,10 @@ printed at the end.  Here:
 * :class:`PhaseTimer` — named wall-clock phases (load / compile / iterate /
   write) with a structured report, the upgrade over printf timings.  Device
   work is fenced with ``jax.block_until_ready`` so a phase means what it
-  says under async dispatch.
+  says under async dispatch.  Phases NEST (``queue`` around
+  ``compile``/``device``/``copy`` is the serving layer's per-request
+  breakdown); nested walls are recorded under slash-joined paths and a
+  flat :meth:`PhaseTimer.to_row` export merges them into bench-row dicts.
 * :func:`device_trace` — context manager around ``jax.profiler.trace``;
   writes a TensorBoard/Perfetto trace of the XLA execution (the real
   per-op timeline the reference never had).
@@ -28,28 +31,46 @@ class PhaseTimer:
     >>> with t.phase("iterate"):
     ...     out = run()          # doctest: +SKIP
     >>> t.report()               # doctest: +SKIP
+
+    Phases nest: entering ``phase("device")`` inside ``phase("serve")``
+    accumulates under the path ``"serve/device"`` while ``"serve"`` keeps
+    the enclosing wall — so a report's top-level walls stay additive and
+    nested ones attribute where the time inside them went.  Not
+    thread-safe: use one timer per request/batch (the serving engine
+    does), not one shared across worker threads.
     """
 
     def __init__(self) -> None:
         self.walls: dict[str, float] = {}
         self.counts: dict[str, int] = {}
+        self._stack: list[str] = []
 
     @contextlib.contextmanager
     def phase(self, name: str, fence=None):
         """Time a phase; ``fence`` (a jax value/tree) is block_until_ready'd
         before the clock stops so async device work is charged here."""
+        self._stack.append(name)
+        path = "/".join(self._stack)
         t0 = time.perf_counter()
         try:
             yield
         finally:
-            if fence is not None:
-                jax.block_until_ready(fence)
-            dt = time.perf_counter() - t0
-            self.walls[name] = self.walls.get(name, 0.0) + dt
-            self.counts[name] = self.counts.get(name, 0) + 1
+            try:
+                if fence is not None:
+                    jax.block_until_ready(fence)
+            finally:
+                # Record + pop even when the body OR the fence raised:
+                # a failing phase must not corrupt the nesting stack (the
+                # fault/retry paths re-enter the same timer afterwards).
+                dt = time.perf_counter() - t0
+                self._stack.pop()
+                self.walls[path] = self.walls.get(path, 0.0) + dt
+                self.counts[path] = self.counts.get(path, 0) + 1
 
     def report(self) -> dict:
-        total = sum(self.walls.values())
+        # Total sums only TOP-LEVEL phases: a nested wall is already inside
+        # its parent's, so summing every path would double-count it.
+        total = sum(v for k, v in self.walls.items() if "/" not in k)
         return {
             "total_s": round(total, 4),
             "phases": {
@@ -58,6 +79,25 @@ class PhaseTimer:
                 for k, v in sorted(self.walls.items(), key=lambda kv: -kv[1])
             },
         }
+
+    def to_row(self, prefix: str = "phase_", scale: float = 1.0,
+               digits: int = 6) -> dict:
+        """Flat ``{prefix<path>_s: wall}`` dict for merging into bench rows.
+
+        Nested paths flatten with underscores (``serve/device`` →
+        ``phase_serve_device_s``).  ``scale`` converts units (1e3 = ms, with
+        the key suffix left to the caller's prefix convention); the serving
+        latency breakdown merges this straight into its per-request and
+        loadgen rows.
+        """
+        return {
+            f"{prefix}{k.replace('/', '_')}_s": round(v * scale, digits)
+            for k, v in self.walls.items()
+        }
+
+    def wall(self, name: str) -> float:
+        """Accumulated seconds for one phase path (0.0 if never entered)."""
+        return self.walls.get(name, 0.0)
 
     def dump(self, path) -> None:
         with open(path, "w") as f:

@@ -64,22 +64,31 @@ def main() -> int:
 
     rows = []
 
-    # Provenance: which rint implementation the Pallas kernels resolve
-    # for the canonical blur3 taps on THIS platform — stamped on every
-    # row so the evidence file states which kernel produced it even if
-    # the library default changes later.
-    from parallel_convolution_tpu.ops.filters import get_filter as _gf
+    # Provenance: which rint implementation the Pallas kernels resolve for
+    # THIS config's own filter taps on THIS platform — stamped only on rows
+    # a Pallas kernel actually produces (ADVICE low: the blur3-resolved
+    # mode was previously stamped on every row, including the serial C++
+    # and jacobi rows that run no Pallas kernel at all, so the field could
+    # misstate which kernel variant made a row).
     from parallel_convolution_tpu.ops.pallas_stencil import _round_mode_for
 
-    _blur_taps = tuple(float(t) for t in _gf("blur3").taps.reshape(-1))
-    round_mode = _round_mode_for(_blur_taps, interpret=not on_tpu())
+    _PALLAS_BACKENDS = ("pallas", "pallas_sep", "pallas_rdma")
 
-    def emit(name, row):
-        row = {"config": name, "round_mode": round_mode, **row}
+    def round_mode_for_cfg(filter_name: str, backend: str) -> str | None:
+        if backend not in _PALLAS_BACKENDS:
+            return None  # no Pallas kernel runs: no rint provenance to claim
+        taps = tuple(float(t)
+                     for t in get_filter(filter_name).taps.reshape(-1))
+        return _round_mode_for(taps, interpret=not on_tpu())
+
+    def emit(name, row, round_mode=None):
+        row = {"config": name,
+               **({"round_mode": round_mode} if round_mode else {}), **row}
         rows.append(row)
         print(json.dumps(row), flush=True)
 
-    # 1. serial CPU reference, 1920x2520 grey (never scaled: host-sized)
+    # 1. serial CPU reference, 1920x2520 grey (never scaled: host-sized).
+    # No round_mode: the serial oracle/C++ path runs no Pallas kernel.
     emit("1: serial 3x3 blur 1920x2520 grey",
          bench.bench_oracle_proxy((1920, 2520), iters=2))
 
@@ -96,21 +105,24 @@ def main() -> int:
         (1920 // max(1, scale // 4), 2520 // max(1, scale // 4)),
         get_filter("blur3"), 100,
         mesh=mesh_for((2, 2)), channels=3, backend=sep_backend,
-        storage="bf16", fuse=16 if platform == "tpu" else 4, reps=2))
+        storage="bf16", fuse=16 if platform == "tpu" else 4, reps=2),
+        round_mode=round_mode_for_cfg("blur3", sep_backend))
 
     # 3. 5x5 edge-detect, 8192^2 grey, 100 iters, 4x4 mesh
     emit("3: 5x5 edge 8192^2 grey 4x4 mesh", bench.bench_iterate(
         (8192 // scale, 8192 // scale), get_filter("edge5"),
         100 if scale == 1 else 10, mesh=mesh_for((4, 4)),
         backend=two_d_backend, storage="bf16",
-        fuse=4 if platform == "tpu" else 2, reps=2))
+        fuse=4 if platform == "tpu" else 2, reps=2),
+        round_mode=round_mode_for_cfg("edge5", two_d_backend))
 
     # 4. 3x3 blur, 65536^2 RGB, v5e-16, pallas kernel (the north star)
     emit("4: 3x3 blur 65536^2 rgb pallas", bench.bench_iterate(
         (65536 // scale, 65536 // scale), get_filter("blur3"),
         100 if scale == 1 else 5, mesh=mesh_for((4, 4)), channels=3,
         backend=sep_backend, storage="bf16",
-        fuse=16 if platform == "tpu" else 2, reps=1))
+        fuse=16 if platform == "tpu" else 2, reps=1),
+        round_mode=round_mode_for_cfg("blur3", sep_backend))
 
     # 5. iterated 3x3 jacobi to convergence (psum), 32768^2
     size5 = 32768 // scale

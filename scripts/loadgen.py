@@ -1,0 +1,314 @@
+#!/usr/bin/env python
+"""Closed/open-loop load generator for the convolution service.
+
+Pushes a stream of identical-config requests at either transport —
+``--url`` (the HTTP frontend) or ``--in-process`` (no sockets; builds the
+service in this process, the tier-1 smoke path) — and emits ONE summary
+row in the established bench-row schema: p50/p95/p99 latency,
+Gpixels/s, a queue/compile/device/copy phase breakdown (means across
+completed requests, from the serving ``PhaseTimer`` export), the
+effective backend(s) that actually produced the bytes, and typed
+rejection counts.
+
+  # closed loop: --concurrency workers, each issuing back-to-back
+  python scripts/loadgen.py --in-process --n 50 --concurrency 4 \\
+      --rows 48 --cols 64 --iters 2
+
+  # open loop: fixed arrival rate (req/s), concurrency unbounded-ish
+  python scripts/loadgen.py --url http://127.0.0.1:8080 --n 200 --rate 50
+
+Exit status is 0 iff every request either completed or was shed with a
+TYPED rejection — a transport error, HTTP 5xx, or byte-size mismatch is
+a non-rejected failure and exits 1 (the ``run_t1.sh --serving-smoke``
+gate).  ``--check`` additionally byte-compares every completed response
+against the NumPy oracle.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import statistics
+import sys
+import threading
+import time
+
+import _path  # noqa: F401  (repo root + JAX_PLATFORMS re-apply)
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float | None:
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+class _HTTPTransport:
+    def __init__(self, url: str, timeout: float):
+        self.base = url.rstrip("/")
+        self.timeout = timeout
+
+    def request(self, body: dict) -> tuple[int, dict]:
+        import urllib.error
+        import urllib.request
+
+        data = json.dumps(body).encode()
+        req = urllib.request.Request(
+            f"{self.base}/v1/convolve", data=data,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read())
+            except Exception:  # noqa: BLE001
+                return e.code, {"ok": False, "detail": f"http {e.code}"}
+
+    def snapshot(self) -> dict:
+        import urllib.request
+
+        with urllib.request.urlopen(f"{self.base}/stats",
+                                    timeout=self.timeout) as resp:
+            return json.loads(resp.read())
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    tgt = ap.add_mutually_exclusive_group(required=True)
+    tgt.add_argument("--url", default=None,
+                     help="HTTP frontend base URL (scripts/serve.py)")
+    tgt.add_argument("--in-process", action="store_true",
+                     help="build the service in this process (no sockets)")
+    ap.add_argument("--n", type=int, default=50, help="total requests")
+    ap.add_argument("--concurrency", type=int, default=4,
+                    help="closed-loop worker count (ignored with --rate)")
+    ap.add_argument("--rate", type=float, default=None, metavar="RPS",
+                    help="open loop: fixed arrival rate in requests/sec")
+    ap.add_argument("--rows", type=int, default=48)
+    ap.add_argument("--cols", type=int, default=64)
+    ap.add_argument("--mode", default="grey", choices=["grey", "rgb"])
+    ap.add_argument("--filter", default="blur3", dest="filter_name")
+    ap.add_argument("--iters", type=int, default=2)
+    ap.add_argument("--backend", default="shifted")
+    ap.add_argument("--storage", default="f32")
+    ap.add_argument("--fuse", type=int, default=1)
+    ap.add_argument("--boundary", default="zero")
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="per-request latency budget (missed -> typed shed)")
+    ap.add_argument("--timeout", type=float, default=120.0,
+                    help="client-side wait per request")
+    ap.add_argument("--seed", type=int, default=0, help="image seed")
+    ap.add_argument("--check", action="store_true",
+                    help="byte-compare completed responses vs the oracle")
+    ap.add_argument("--out", default=None,
+                    help="also write the summary row JSON to this path")
+    # In-process service knobs (no-ops with --url):
+    ap.add_argument("--mesh", default=None, help="RxC (in-process only)")
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--max-delay-ms", type=float, default=5.0)
+    ap.add_argument("--max-queue", type=int, default=256)
+    ap.add_argument("--warm", action="store_true",
+                    help="pre-compile the config before the timed run "
+                         "(in-process; separates compile from steady-state)")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from parallel_convolution_tpu.utils import imageio
+
+    img = imageio.generate_test_image(args.rows, args.cols, args.mode,
+                                      seed=args.seed)
+    body = {
+        "image_b64": base64.b64encode(
+            np.ascontiguousarray(img).tobytes()).decode("ascii"),
+        "rows": args.rows, "cols": args.cols, "mode": args.mode,
+        "filter": args.filter_name, "iters": args.iters,
+        "backend": args.backend, "storage": args.storage,
+        "fuse": args.fuse, "boundary": args.boundary,
+    }
+    if args.deadline_ms is not None:
+        body["deadline_ms"] = args.deadline_ms
+
+    service = None
+    if args.in_process:
+        from parallel_convolution_tpu.resilience import faults
+        from parallel_convolution_tpu.serving.frontend import InProcessClient
+        from parallel_convolution_tpu.serving.service import (
+            ConvolutionService,
+        )
+
+        faults.install_from_env()
+        mesh = None
+        if args.mesh:
+            from parallel_convolution_tpu.parallel.mesh import mesh_from_spec
+
+            mesh = mesh_from_spec(args.mesh)
+        service = ConvolutionService(
+            mesh, max_batch=args.max_batch,
+            max_delay_s=args.max_delay_ms / 1e3, max_queue=args.max_queue)
+        client = InProcessClient(service)
+        transport_request = (
+            lambda b: client.request(b, timeout=args.timeout))
+        transport_snapshot = service.snapshot
+    else:
+        http = _HTTPTransport(args.url, args.timeout)
+        transport_request = http.request
+        transport_snapshot = http.snapshot
+
+    if args.warm and service is not None:
+        service.warmup([{"rows": args.rows, "cols": args.cols,
+                         "mode": args.mode, "filter": args.filter_name,
+                         "iters": args.iters, "backend": args.backend,
+                         "storage": args.storage, "fuse": args.fuse,
+                         "boundary": args.boundary}])
+
+    want = None
+    if args.check:
+        from parallel_convolution_tpu.ops import oracle
+        from parallel_convolution_tpu.ops.filters import get_filter
+
+        want = oracle.run_serial_u8(img, get_filter(args.filter_name),
+                                    args.iters, boundary=args.boundary)
+
+    results = []                      # (latency_s, status, resp_dict)
+    results_lock = threading.Lock()
+
+    def one_request(i: int) -> None:
+        b = dict(body, request_id=f"lg{i}")
+        t0 = time.perf_counter()
+        try:
+            status, resp = transport_request(b)
+        except Exception as e:  # noqa: BLE001 — a transport failure row
+            status, resp = -1, {"ok": False, "detail": repr(e)[:300]}
+        lat = time.perf_counter() - t0
+        with results_lock:
+            results.append((lat, status, resp))
+
+    t_start = time.perf_counter()
+    if args.rate:
+        # Open loop: arrivals on a fixed clock regardless of completions —
+        # each request gets its own thread so a slow server shows up as
+        # latency (and eventually typed queue_full sheds), not as a
+        # silently reduced offered rate.
+        threads = []
+        interval = 1.0 / args.rate
+        for i in range(args.n):
+            target = t_start + i * interval
+            delay = target - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            th = threading.Thread(target=one_request, args=(i,), daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(args.timeout)
+    else:
+        # Closed loop: --concurrency workers, each back-to-back.
+        counter = iter(range(args.n))
+        counter_lock = threading.Lock()
+
+        def worker():
+            while True:
+                with counter_lock:
+                    i = next(counter, None)
+                if i is None:
+                    return
+                one_request(i)
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(max(1, args.concurrency))]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+    wall = time.perf_counter() - t_start
+
+    completed = [(lat, r) for lat, s, r in results if s == 200 and r.get("ok")]
+    rejected: dict[str, int] = {}
+    failures = []
+    for lat, s, r in results:
+        if s == 200 and r.get("ok"):
+            continue
+        reason = r.get("rejected")
+        if reason and reason != "timeout":
+            rejected[reason] = rejected.get(reason, 0) + 1
+        else:
+            # No typed reason — or "timeout", the client giving up on an
+            # unresponsive service, which is a failure, not load shedding.
+            failures.append({"status": s,
+                             "detail": r.get("detail", "") or reason or ""})
+    mismatches = 0
+    if want is not None:
+        raw = want.tobytes()
+        for _, r in completed:
+            if base64.b64decode(r["image_b64"]) != raw:
+                mismatches += 1
+    bad_bytes = sum(
+        1 for _, r in completed
+        if len(base64.b64decode(r["image_b64"])) != img.size)
+    non_rejected_failures = len(failures) + mismatches + bad_bytes
+
+    lats = sorted(lat for lat, _ in completed)
+    channels = 3 if args.mode == "rgb" else 1
+    px = args.rows * args.cols * channels * args.iters * len(completed)
+    phase_names = ("queue", "compile", "device", "copy_in", "copy_out")
+    phases_ms = {
+        p: round(1e3 * statistics.mean(
+            [r["phases"].get(p, 0.0) for _, r in completed]), 3)
+        for p in phase_names
+    } if completed else {}
+    effective = sorted({r.get("effective_backend", "") for _, r in completed})
+    batch_sizes = [r.get("batch_size", 1) for _, r in completed]
+
+    row = {
+        "workload": (f"serve {args.filter_name} {args.rows}x{args.cols}"
+                     f"x{channels} {args.iters} iters"),
+        "loop": "open" if args.rate else "closed",
+        "n": args.n,
+        **({"rate_rps": args.rate} if args.rate
+           else {"concurrency": args.concurrency}),
+        "backend": args.backend,
+        "effective_backend": (effective[0] if len(effective) == 1
+                              else effective),
+        "completed": len(completed),
+        "rejected": rejected,
+        "non_rejected_failures": non_rejected_failures,
+        "wall_s": round(wall, 4),
+        "p50_ms": round(1e3 * _percentile(lats, 0.50), 3) if lats else None,
+        "p95_ms": round(1e3 * _percentile(lats, 0.95), 3) if lats else None,
+        "p99_ms": round(1e3 * _percentile(lats, 0.99), 3) if lats else None,
+        "gpixels_per_s": round(px / wall / 1e9, 6) if wall else None,
+        "phases_ms": phases_ms,
+        "batch_mean": (round(statistics.mean(batch_sizes), 2)
+                       if batch_sizes else None),
+        "batch_max": max(batch_sizes, default=None),
+    }
+    if want is not None:
+        row["oracle_mismatches"] = mismatches
+    try:
+        snap = transport_snapshot()
+        row["platform"] = snap.get("platform", "")
+        row["mesh"] = snap.get("mesh", "")
+        row["engine"] = snap.get("engine", {})
+        row["service"] = snap.get("service", {})
+    except Exception as e:  # noqa: BLE001 — the row survives a dead /stats
+        row["snapshot_error"] = repr(e)[:200]
+    if failures:
+        row["failure_sample"] = failures[:3]
+
+    print(json.dumps(row), flush=True)
+    if args.out:
+        from pathlib import Path
+
+        p = Path(args.out)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(json.dumps(row, indent=2))
+    if service is not None:
+        service.close()
+    return 1 if non_rejected_failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,7 +3,26 @@
 # command verbatim (keep the two in sync; the ROADMAP line is the spec).
 # bash, not sh: the verbatim command needs pipefail + PIPESTATUS.
 # Run from anywhere: resolves to the repo root first.
+#
+#   scripts/run_t1.sh                  the tier-1 pytest gate
+#   scripts/run_t1.sh --serving-smoke  boot the in-process serving stack on
+#                                      the 8-virtual-device CPU mesh, push
+#                                      50 loadgen requests, exit nonzero on
+#                                      ANY non-rejected failure (typed load
+#                                      sheds are permitted, errors are not).
+#                                      Row lands in evidence/serving_smoke.json
+#                                      (the supervisor leg's done_file —
+#                                      see scripts/t1_legs.json).
 cd "$(dirname "$0")/.." || exit 1
+
+if [ "${1:-}" = "--serving-smoke" ]; then
+  exec timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python scripts/loadgen.py --in-process --n 50 --concurrency 4 \
+      --rows 48 --cols 64 --mode grey --filter blur3 --iters 2 \
+      --mesh 2x4 --max-batch 8 --max-delay-ms 5 --check \
+      --out evidence/serving_smoke.json
+fi
 
 set -o pipefail
 rm -f /tmp/_t1.log

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Boot the convolution service behind the stdlib HTTP frontend.
+
+The long-lived counterpart of the one-shot CLI: compile-once warm
+executables, micro-batching, admission control, and per-request latency
+tracing (parallel_convolution_tpu/serving/).  stdlib only — deployment
+is this script, nothing else.
+
+  # CPU smoke on 8 virtual devices
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \\
+    python scripts/serve.py --port 8080 --mesh 2x4 \\
+      --warm '{"rows": 48, "cols": 64, "filter": "blur3", "iters": 2}'
+
+  curl -s localhost:8080/healthz | python -m json.tool
+  python scripts/loadgen.py --url http://127.0.0.1:8080 --n 100 ...
+
+``PCTPU_FAULTS`` is honored (resilience.faults), so injected-fault
+drills run end-to-end through the real server; transient compile faults
+degrade the backend per key (the /stats `resident` table shows the
+effective tier) instead of killing the process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+
+import _path  # noqa: F401  (repo root + JAX_PLATFORMS re-apply)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8080,
+                    help="0 = pick a free port (printed on boot)")
+    ap.add_argument("--mesh", default=None, help="RxC grid (default: all "
+                                                 "devices, near-square)")
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. cpu) before init")
+    ap.add_argument("--capacity", type=int, default=16,
+                    help="warm-executable cache size (LRU-evicted keys)")
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--max-delay-ms", type=float, default=5.0,
+                    help="micro-batch flush deadline")
+    ap.add_argument("--max-queue", type=int, default=64,
+                    help="admission bound: deeper queues shed load")
+    ap.add_argument("--no-fallback", action="store_true",
+                    help="disable the per-key backend degradation ladder")
+    ap.add_argument("--warm", action="append", default=[],
+                    metavar="JSON", help="config to pre-compile at startup "
+                    '(repeatable), e.g. \'{"rows": 512, "cols": 512, '
+                    '"mode": "rgb", "filter": "blur3", "iters": 10, '
+                    '"backend": "pallas_sep"}\'')
+    args = ap.parse_args()
+
+    if args.platform:
+        from parallel_convolution_tpu.utils.platform import force_platform
+
+        force_platform(args.platform, warn=True)
+
+    from parallel_convolution_tpu.resilience import faults
+    from parallel_convolution_tpu.serving.frontend import make_http_server
+    from parallel_convolution_tpu.serving.service import ConvolutionService
+    from parallel_convolution_tpu.utils.platform import enable_compile_cache
+
+    faults.install_from_env()
+    enable_compile_cache()
+
+    mesh = None
+    if args.mesh:
+        from parallel_convolution_tpu.parallel.mesh import mesh_from_spec
+
+        mesh = mesh_from_spec(args.mesh)
+
+    service = ConvolutionService(
+        mesh, capacity=args.capacity, max_batch=args.max_batch,
+        max_delay_s=args.max_delay_ms / 1e3, max_queue=args.max_queue,
+        fallback=not args.no_fallback)
+    warm_cfgs = [json.loads(w) for w in args.warm]
+    if warm_cfgs:
+        effective = service.warmup(warm_cfgs)
+        for cfg, eff in zip(warm_cfgs, effective):
+            print(json.dumps({"warmed": cfg, "effective_backend": eff}),
+                  flush=True)
+
+    server = make_http_server(service, args.host, args.port)
+    host, port = server.server_address[:2]
+    print(json.dumps({"serving": f"http://{host}:{port}",
+                      **{k: v for k, v in service.snapshot().items()
+                         if k in ("mesh", "platform", "device_kind")}}),
+          flush=True)
+
+    stopping = []
+
+    def _stop(signum, frame):
+        import threading
+
+        if stopping:   # timeout(1) + shell job control can double-signal
+            return
+        stopping.append(signum)
+        print(json.dumps({"stopping": signum,
+                          "final": service.snapshot()}), flush=True)
+        # shutdown() must not run on the thread inside serve_forever (it
+        # would deadlock waiting for the suspended loop to acknowledge).
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGINT, _stop)
+    signal.signal(signal.SIGTERM, _stop)
+    try:
+        server.serve_forever()
+    finally:
+        server.server_close()
+        service.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

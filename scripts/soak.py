@@ -24,6 +24,16 @@ oracle.  Idle wall-clock (a dead TPU tunnel) thereby exercises the
 recovery paths, not just the happy path:
 
   python scripts/soak.py --faults 16 --seed 0
+
+``--serve --faults N`` runs the same drill through the SERVING engine
+(round 8): each trial installs a random compile/exchange fault plan,
+pushes a burst of requests through an in-process ConvolutionService —
+whose with_retry/degradation wiring must heal the injected faults into
+byte-identical responses — then simulates the restart (plan uninstalled,
+probe cache cleared, fresh service) and requires clean service.  This
+extends ``PCTPU_FAULTS`` coverage to the serving layer:
+
+  python scripts/soak.py --serve --faults 8 --seed 0
 """
 
 from __future__ import annotations
@@ -222,6 +232,108 @@ def run_fault_trial(spec: str, seed: int, out_path: str) -> int:
     return 0 if ok else 1
 
 
+def run_serve_trial(spec: str, seed: int, out_path: str) -> int:
+    """One injected-fault drill through the serving engine.
+
+    Phase 1 (faulted): with ``spec`` installed, a burst of same-key
+    requests flows through an in-process ConvolutionService; the engine's
+    retry + per-key degradation must turn every injected transient
+    compile/exchange fault into a byte-identical response (possibly on a
+    degraded effective backend — recorded in the row).
+    Phase 2 ("resume"): plan uninstalled and probe cache cleared — the
+    fresh-process state after a restart — then a fresh service must serve
+    the same key cleanly on the REQUESTED tier.  Exit 0 iff every
+    response in both phases is byte-identical to the oracle.
+    """
+    import jax
+    import numpy as np
+
+    from parallel_convolution_tpu.ops import filters, oracle
+    from parallel_convolution_tpu.parallel import mesh as mesh_lib
+    from parallel_convolution_tpu.resilience import degrade, faults
+    from parallel_convolution_tpu.resilience.retry import RetryPolicy
+    from parallel_convolution_tpu.serving.service import (
+        ConvolutionService, Request, Response,
+    )
+    from parallel_convolution_tpu.utils import imageio
+
+    rng = random.Random(seed)
+    filt = filters.get_filter(rng.choice(["blur3", "gaussian5", "sharpen3"]))
+    H, W = rng.randrange(28, 64), rng.randrange(28, 64)
+    iters = rng.randrange(1, 5)
+    backend = rng.choice(["shifted", "pallas", "pallas_sep"])
+    n_dev = len(jax.devices())
+    shape = rng.choice([s for s in [(1, 2), (2, 2), (2, 4)]
+                        if s[0] * s[1] <= n_dev] or [(1, 1)])
+    mesh = mesh_lib.make_grid_mesh(jax.devices()[: shape[0] * shape[1]],
+                                   shape)
+    img = imageio.generate_test_image(H, W, "grey", seed=seed)
+    want = oracle.run_serial_u8(img, filt, iters)
+    policy = RetryPolicy(max_attempts=4, base_delay=0.01, max_delay=0.1)
+
+    def burst(svc, n):
+        reqs = [svc.submit(Request(image=img, filter_name=filt.name,
+                                   iters=iters, backend=backend),
+                           wait=False) for _ in range(n)]
+        return [s.result(300) if hasattr(s, "result") else s for s in reqs]
+
+    with faults.injected(spec, seed=seed) as plan:
+        svc = ConvolutionService(mesh, max_delay_s=0.02, retry_policy=policy)
+        faulted = burst(svc, 6)
+        svc.close()
+        fired = plan.fired
+        retries = svc.stats["retries"]
+    # The restart: no plan, no cached probe verdicts — a fresh process's
+    # serving state, which must come up clean on the requested tier.
+    degrade.clear_probe_cache()
+    svc2 = ConvolutionService(mesh, max_delay_s=0.02, retry_policy=policy)
+    resumed = burst(svc2, 2)
+    svc2.close()
+
+    def verdicts(results):
+        out = []
+        for r in results:
+            ok = (isinstance(r, Response)
+                  and np.array_equal(np.asarray(r.image), want))
+            out.append({
+                "ok": bool(ok),
+                "effective_backend": getattr(r, "effective_backend", None),
+                **({} if ok else {"got": type(r).__name__,
+                                  "detail": getattr(r, "detail", "")[:200]}),
+            })
+        return out
+
+    vf, vr = verdicts(faulted), verdicts(resumed)
+    ok = all(v["ok"] for v in vf + vr) and all(
+        v["effective_backend"] == backend for v in vr)
+    row = {
+        "ok": ok, "mode": "serve", "spec": spec, "seed": seed,
+        "filter": filt.name, "H": H, "W": W, "iters": iters,
+        "backend": backend, "mesh": "x".join(map(str, shape)),
+        "fired": [list(f) for f in fired], "retries": retries,
+        "faulted_effective": sorted({v["effective_backend"] for v in vf
+                                     if v["effective_backend"]}),
+        "failures": [v for v in vf + vr if not v["ok"]][:4],
+    }
+    Path(out_path).parent.mkdir(parents=True, exist_ok=True)
+    Path(out_path).write_text(json.dumps(row))
+    print(json.dumps(row), flush=True)
+    return 0 if ok else 1
+
+
+def _sample_serve_fault_spec(rng: random.Random) -> str:
+    """A random transient compile/exchange plan for the serving drill.
+
+    Hit-indexed only (no open ranges, no probabilities): bounded retry
+    must heal every sampled plan DETERMINISTICALLY — a plan that fails
+    all compiles forever would test retry exhaustion, which has its own
+    unit test, not the soak's heal-and-serve property.
+    """
+    site = rng.choice(["backend_compile", "backend_compile",
+                       "halo_exchange"])
+    return f"{site}:{rng.randrange(1, 4)}"
+
+
 def _sample_fault_spec(rng: random.Random, n_shards: int) -> str:
     """A random single-site plan biased toward checkpoint tears."""
     site = rng.choice(
@@ -248,12 +360,17 @@ def run_fault_soak(args) -> int:
     state = Path(args.state_dir or tempfile.mkdtemp(prefix="pctpu_fault_soak_"))
     legs = []
     for i in range(args.faults):
-        spec = _sample_fault_spec(rng, n_shards=8)
+        if args.serve:
+            spec = _sample_serve_fault_spec(rng)
+            trial_flag = "--serve-trial"
+        else:
+            spec = _sample_fault_spec(rng, n_shards=8)
+            trial_flag = "--fault-trial"
         out = state / f"trial_{i:03d}.json"
         legs.append(Leg(
             name=f"trial_{i:03d}",
             cmd=[sys.executable, os.path.abspath(__file__),
-                 "--fault-trial", spec,
+                 trial_flag, spec,
                  "--trial-seed", str(rng.randrange(10_000)),
                  "--trial-out", str(out)],
             done_file=str(out), done_pattern='"ok": true',
@@ -272,7 +389,8 @@ def run_fault_soak(args) -> int:
         if not leg.is_complete():
             fails += 1
     print(json.dumps({
-        "summary": "fault-soak", "n": args.faults, "seed": args.seed,
+        "summary": "fault-soak", "mode": "serve" if args.serve else "batch",
+        "n": args.faults, "seed": args.seed,
         "failures": fails, "state_dir": str(state), "supervisor_rc": rc,
         "wall_s": round(time.time() - t0, 1),
     }), flush=True)
@@ -290,10 +408,17 @@ def main() -> int:
                     help="resilience mode: run N random injected-fault "
                          "crash/resume drills through the supervised "
                          "runner instead of the byte-compare soak")
+    ap.add_argument("--serve", action="store_true",
+                    help="with --faults: run the drills through the "
+                         "serving engine (retry/degradation must heal "
+                         "injected compile/exchange faults into "
+                         "byte-identical responses; then a clean restart "
+                         "must serve the requested tier)")
     ap.add_argument("--state-dir", default=None,
                     help="--faults: supervisor state dir (default: mkdtemp)")
     # Hidden: one drill in a child process (the supervisor's leg cmd).
     ap.add_argument("--fault-trial", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--serve-trial", default=None, help=argparse.SUPPRESS)
     ap.add_argument("--trial-seed", type=int, default=0,
                     help=argparse.SUPPRESS)
     ap.add_argument("--trial-out", default=None, help=argparse.SUPPRESS)
@@ -302,6 +427,11 @@ def main() -> int:
     if args.fault_trial:
         return run_fault_trial(args.fault_trial, args.trial_seed,
                                args.trial_out)
+    if args.serve_trial:
+        return run_serve_trial(args.serve_trial, args.trial_seed,
+                               args.trial_out)
+    if args.serve and not args.faults:
+        ap.error("--serve requires --faults N")
     if args.faults:
         return run_fault_soak(args)
 

@@ -1,0 +1,601 @@
+"""Serving layer: warm engine, micro-batcher, admission control, frontend.
+
+The round-8 acceptance properties (ISSUE 3), all on the 8-virtual-device
+CPU mesh:
+
+* batched responses are byte-identical to sequential single-request runs
+  AND to the serial oracle, for every backend the CPU mesh supports
+  (test_batched_bitexact_vs_sequential_and_oracle);
+* a second request on a warm key performs zero recompilation — the
+  engine's compile counter is flat and its hit counter moves
+  (test_second_request_warm_key_zero_recompile);
+* queue overflow yields a typed, counted ``Rejected`` — never an
+  exception and never a hang (test_queue_overflow_typed_rejection).
+"""
+
+from __future__ import annotations
+
+import base64
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from parallel_convolution_tpu.ops import filters, oracle
+from parallel_convolution_tpu.parallel import mesh as mesh_lib
+from parallel_convolution_tpu.resilience import degrade, faults
+from parallel_convolution_tpu.resilience.retry import RetryPolicy
+from parallel_convolution_tpu.serving.batcher import MicroBatcher
+from parallel_convolution_tpu.serving.engine import WarmEngine
+from parallel_convolution_tpu.serving.frontend import (
+    InProcessClient, make_http_server,
+)
+from parallel_convolution_tpu.serving.service import (
+    ConvolutionService, Rejected, Request, Response,
+)
+from parallel_convolution_tpu.utils import imageio, tracing
+from parallel_convolution_tpu.utils.config import BACKENDS
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_state():
+    yield
+    faults.uninstall_plan()
+    degrade.clear_probe_cache()
+
+
+def _mesh(shape=(2, 2)):
+    return mesh_lib.make_grid_mesh(jax.devices()[: shape[0] * shape[1]],
+                                   shape)
+
+
+def _service(**kw):
+    kw.setdefault("mesh", _mesh())
+    kw.setdefault("max_delay_s", 0.02)
+    kw.setdefault("retry_policy",
+                  RetryPolicy(max_attempts=3, base_delay=0.01,
+                              max_delay=0.05))
+    return ConvolutionService(kw.pop("mesh"), **kw)
+
+
+# ------------------------------------------------------------- PhaseTimer
+
+
+def test_phase_timer_nested_paths_and_to_row():
+    t = tracing.PhaseTimer()
+    with t.phase("serve"):
+        with t.phase("device"):
+            pass
+        with t.phase("device"):
+            pass
+    with t.phase("queue"):
+        pass
+    assert set(t.walls) == {"serve", "serve/device", "queue"}
+    assert t.counts["serve/device"] == 2
+    row = t.to_row()
+    assert set(row) == {"phase_serve_s", "phase_serve_device_s",
+                        "phase_queue_s"}
+    assert row["phase_serve_s"] >= row["phase_serve_device_s"] >= 0.0
+    assert t.wall("serve") >= t.wall("serve/device")
+    assert t.wall("never_entered") == 0.0
+
+
+def test_phase_timer_report_counts_top_level_only():
+    t = tracing.PhaseTimer()
+    with t.phase("outer"):
+        with t.phase("inner"):
+            time.sleep(0.01)
+    rep = t.report()
+    # Nested walls must not double-count into the total.
+    assert rep["total_s"] == round(t.walls["outer"], 4)
+    assert "outer/inner" in rep["phases"]
+
+
+# ------------------------------------------------------------ MicroBatcher
+
+
+class _StubExec:
+    """Records flushed batches; completes every slot with its payload."""
+
+    def __init__(self, delay=0.0):
+        self.batches = []
+        self.delay = delay
+
+    def __call__(self, key, items):
+        if self.delay:
+            time.sleep(self.delay)
+        self.batches.append((key, [it.payload for it in items]))
+        for it in items:
+            it.slot.set(("done", key, it.payload))
+
+
+def test_batcher_deadline_flush_single_request():
+    ex = _StubExec()
+    b = MicroBatcher(ex, max_batch=8, max_delay_s=0.03, max_queue=4)
+    t0 = time.monotonic()
+    slot = b.try_submit("k", 1)
+    assert slot is not None
+    assert slot.result(5.0) == ("done", "k", 1)
+    # A lone request flushes on the deadline, not on a full batch.
+    assert time.monotonic() - t0 < 2.0
+    assert ex.batches == [("k", [1])]
+    b.close()
+
+
+def test_batcher_coalesces_same_key_up_to_max_batch():
+    ex = _StubExec()
+    b = MicroBatcher(ex, max_batch=3, max_delay_s=0.05, max_queue=16,
+                     start=False)
+    slots = [b.try_submit("k", i) for i in range(5)]
+    b.start()
+    for s in slots:
+        assert s.result(5.0) is not None
+    sizes = [len(p) for k, p in ex.batches]
+    assert sizes == [3, 2]                     # cap respected, order kept
+    assert [p for _, p in ex.batches] == [[0, 1, 2], [3, 4]]
+    b.close()
+
+
+def test_batcher_mixed_keys_never_cobatched():
+    ex = _StubExec()
+    b = MicroBatcher(ex, max_batch=8, max_delay_s=0.02, max_queue=16,
+                     start=False)
+    for key, payload in [("a", 1), ("b", 2), ("a", 3), ("b", 4)]:
+        assert b.try_submit(key, payload) is not None
+    b.start()
+    deadline = time.monotonic() + 5.0
+    while len(ex.batches) < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert sorted((k, tuple(p)) for k, p in ex.batches) == [
+        ("a", (1, 3)), ("b", (2, 4))]          # same-key only, both served
+    b.close()
+
+
+def test_batcher_queue_full_refused_and_counted():
+    ex = _StubExec()
+    b = MicroBatcher(ex, max_batch=2, max_delay_s=0.01, max_queue=2,
+                     start=False)
+    assert b.try_submit("k", 1) is not None
+    assert b.try_submit("k", 2) is not None
+    assert b.try_submit("k", 3) is None        # typed refusal, no exception
+    assert b.stats["refused"] == 1
+    b.start()
+    b.close(drain=True)
+    assert b.stats["flushed_items"] == 2       # the admitted two completed
+
+
+def test_batcher_close_refuses_new_work():
+    b = MicroBatcher(_StubExec(), max_queue=4)
+    b.close()
+    assert b.try_submit("k", 1) is None
+
+
+# -------------------------------------------------------------- WarmEngine
+
+
+def _img(h=24, w=36, mode="grey", seed=1):
+    return imageio.generate_test_image(h, w, mode, seed=seed)
+
+
+def _planar(img):
+    return imageio.interleaved_to_planar(img).astype(np.float32)
+
+
+def test_engine_warm_key_caches_executable():
+    eng = WarmEngine(_mesh(), fallback=False)
+    key = eng.key_for((1, 24, 36), filter_name="blur3", iters=2)
+    x = _planar(_img())[None]
+    out1, info1 = eng.run_batch(key, x)
+    compiles = eng.stats["compiles"]
+    out2, info2 = eng.run_batch(key, x)
+    assert eng.stats["compiles"] == compiles   # zero recompilation
+    assert eng.stats["hits"] >= 1
+    np.testing.assert_array_equal(out1, out2)
+    assert info2["effective_backend"] == "shifted"
+    assert set(info2["phases"]) == {"compile", "copy_in", "device",
+                                    "copy_out"}
+
+
+def test_engine_lru_eviction_and_recompile():
+    eng = WarmEngine(_mesh(), capacity=1, fallback=False)
+    k1 = eng.key_for((1, 24, 36), filter_name="blur3", iters=1)
+    k2 = eng.key_for((1, 24, 36), filter_name="box3", iters=1)
+    eng.entry(k1)
+    eng.entry(k2)                              # evicts k1
+    assert eng.stats["evictions"] == 1
+    eng.entry(k1)                              # cold again
+    assert eng.stats["compiles"] == 3
+    assert [r["filter"] for r in eng.snapshot()["resident"]] == ["blur3"]
+
+
+def test_engine_single_flight_cold_key_compiles_once():
+    eng = WarmEngine(_mesh(), fallback=False)
+    key = eng.key_for((1, 26, 34), filter_name="gaussian5", iters=1)
+    barrier = threading.Barrier(4)
+    errors = []
+
+    def worker():
+        try:
+            barrier.wait(timeout=10)
+            eng.entry(key)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errors
+    assert eng.stats["compiles"] == 1          # one leader compiled
+    assert eng.stats["misses"] == 1
+    assert eng.stats["hits"] + eng.stats["single_flight_waits"] == 3
+
+
+def test_engine_key_validation_is_terminal():
+    eng = WarmEngine(_mesh(), fallback=False)
+    with pytest.raises(ValueError):
+        eng.key_for((1, 24, 36), backend="nope").validate()
+    with pytest.raises(ValueError):
+        eng.key_for((1, 24, 36), storage="u8", quantize=False).validate()
+    key = eng.key_for((1, 24, 36))
+    with pytest.raises(ValueError):
+        eng.run_batch(key, np.zeros((1, 1, 8, 8), np.float32))
+
+
+def test_engine_warmup_precompiles_declared_configs():
+    svc = _service()
+    effective = svc.warmup([{"rows": 24, "cols": 36, "filter": "blur3",
+                             "iters": 2}])
+    assert effective == ["shifted"]
+    compiles = svc.engine.stats["compiles"]
+    resp = svc.submit(Request(image=_img(), iters=2), timeout=60)
+    assert isinstance(resp, Response)
+    assert svc.engine.stats["compiles"] == compiles   # served fully warm
+    svc.close()
+
+
+# ------------------------------------------------- service: bit-exactness
+
+
+def _supported(backend, mesh, filt, block_hw):
+    """Does this backend compile+run on the CPU mesh?  (Probe verdict —
+    the same definition resolve_backend uses.)"""
+    try:
+        degrade.probe_backend(mesh, filt, backend, block_hw=block_hw)
+        return True
+    except Exception:  # noqa: BLE001 — unsupported here, whatever the class
+        return False
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_batched_bitexact_vs_sequential_and_oracle(backend):
+    mesh = _mesh()
+    filt = filters.get_filter("blur3")
+    img = _img(32, 48)
+    if not _supported(backend, mesh, filt, (16, 24)):
+        pytest.skip(f"{backend} does not run on this CPU mesh/jax")
+    want = oracle.run_serial_u8(img, filt, 2)
+
+    svc = _service(mesh=mesh, max_batch=4, max_delay_s=0.25, fallback=False)
+    # Sequential oracle runs: one at a time, each its own batch.
+    seq = svc.submit(Request(image=img, iters=2, backend=backend),
+                     timeout=120)
+    assert isinstance(seq, Response), seq
+    assert seq.batch_size == 1
+    # Concurrent same-key burst: must co-batch, and match byte-for-byte.
+    results = [None] * 4
+
+    def one(i):
+        results[i] = svc.submit(Request(image=img, iters=2, backend=backend),
+                                timeout=120)
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    for r in results:
+        assert isinstance(r, Response), r
+        assert r.effective_backend == backend
+        np.testing.assert_array_equal(r.image, seq.image)
+        np.testing.assert_array_equal(r.image, want)
+    assert max(r.batch_size for r in results) > 1   # batching really happened
+    svc.close()
+
+
+def test_rgb_roundtrip_matches_oracle():
+    img = _img(24, 30, mode="rgb", seed=7)
+    want = oracle.run_serial_u8(img, filters.get_filter("sharpen3"), 2)
+    svc = _service()
+    resp = svc.submit(Request(image=img, filter_name="sharpen3", iters=2),
+                      timeout=120)
+    assert isinstance(resp, Response)
+    assert resp.image.shape == img.shape
+    np.testing.assert_array_equal(resp.image, want)
+    svc.close()
+
+
+def test_second_request_warm_key_zero_recompile():
+    svc = _service()
+    img = _img()
+    r1 = svc.submit(Request(image=img, iters=2), timeout=120)
+    assert isinstance(r1, Response)
+    compiles = svc.engine.stats["compiles"]
+    hits = svc.engine.stats["hits"]
+    r2 = svc.submit(Request(image=img, iters=2), timeout=120)
+    assert isinstance(r2, Response)
+    assert svc.engine.stats["compiles"] == compiles   # ZERO recompilation
+    assert svc.engine.stats["hits"] > hits            # the cache served it
+    assert r2.effective_backend == "shifted"          # stamped per response
+    np.testing.assert_array_equal(r1.image, r2.image)
+    assert r2.phases["compile"] < 0.05                # warm path, no trace
+    svc.close()
+
+
+# --------------------------------------------- service: admission control
+
+
+def test_queue_overflow_typed_rejection():
+    svc = _service(max_queue=3, start=False)          # worker not running
+    img = _img()
+    slots = [svc.submit(Request(image=img, iters=1), wait=False)
+             for _ in range(3)]
+    shed = svc.submit(Request(image=img, iters=1), timeout=5)
+    assert isinstance(shed, Rejected)
+    assert shed.reason == "queue_full"
+    assert svc.stats["rejected_queue_full"] == 1
+    svc.batcher.start()                               # drain the admitted 3
+    for s in slots:
+        r = s.result(120)
+        assert isinstance(r, Response)
+    svc.close()
+
+
+def test_tight_deadline_on_idle_service_is_served_not_starved():
+    # deadline_s < max_delay_s must flush immediately, not wait out the
+    # batching window and then shed its own request (review finding).
+    svc = _service(max_delay_s=0.5)
+    svc.warmup([{"rows": 24, "cols": 36, "filter": "blur3", "iters": 1}])
+    t0 = time.monotonic()
+    r = svc.submit(Request(image=_img(), iters=1, deadline_s=0.2),
+                   timeout=60)
+    assert isinstance(r, Response), r
+    assert time.monotonic() - t0 < 0.45    # did not sit out max_delay_s
+    svc.close()
+
+
+def test_client_wait_timeout_is_distinct_typed_reason():
+    svc = _service(start=False)            # worker stopped: nothing answers
+    r = svc.submit(Request(image=_img(), iters=1), timeout=0.05)
+    assert isinstance(r, Rejected)
+    assert r.reason == "timeout"           # not conflated with "deadline"
+    assert svc.stats["client_timeouts"] == 1
+    assert svc.stats["rejected_deadline"] == 0
+    svc.batcher.close(drain=False)
+
+
+def test_phase_timer_stack_survives_raising_body():
+    t = tracing.PhaseTimer()
+    with pytest.raises(RuntimeError):
+        with t.phase("boom"):
+            raise RuntimeError("injected")
+    with t.phase("after"):
+        pass
+    assert set(t.walls) == {"boom", "after"}   # not "boom/after"
+
+
+def test_wire_decode_null_knob_is_typed_invalid():
+    # int(None) used to escape as TypeError past the 400 path (review).
+    svc = _service(start=False)
+    client = InProcessClient(svc)
+    status, resp = client.request(_wire_body(_img(), iters=None))
+    assert status == 400 and resp["rejected"] == "invalid"
+    status, resp = client.request(_wire_body(_img(), deadline_ms=[5]))
+    assert status == 400 and resp["rejected"] == "invalid"
+    svc.batcher.close(drain=False)
+
+
+def test_expired_deadline_typed_rejection():
+    svc = _service(start=False)
+    slot = svc.submit(Request(image=_img(), iters=1, deadline_s=0.01),
+                      wait=False)
+    time.sleep(0.05)
+    svc.batcher.start()
+    r = slot.result(60)
+    assert isinstance(r, Rejected)
+    assert r.reason == "deadline"
+    assert svc.stats["rejected_deadline"] == 1
+    svc.close()
+
+
+def test_invalid_requests_typed_rejection():
+    svc = _service(start=False)
+    bad_filter = svc.submit(Request(image=_img(), filter_name="nope"))
+    assert isinstance(bad_filter, Rejected) and bad_filter.reason == "invalid"
+    bad_dtype = svc.submit(
+        Request(image=np.zeros((8, 8), np.float32)))
+    assert isinstance(bad_dtype, Rejected) and bad_dtype.reason == "invalid"
+    big_fuse = svc.submit(Request(image=_img(8, 8), iters=64, fuse=64))
+    assert isinstance(big_fuse, Rejected) and big_fuse.reason == "invalid"
+    assert svc.stats["rejected_invalid"] == 3
+    svc.close()
+
+
+def test_mixed_key_requests_served_in_separate_batches():
+    svc = _service(max_batch=8, max_delay_s=0.2)
+    img = _img()
+    want_blur = oracle.run_serial_u8(img, filters.get_filter("blur3"), 1)
+    want_box = oracle.run_serial_u8(img, filters.get_filter("box3"), 1)
+    out = {}
+
+    def one(name):
+        out[name] = svc.submit(Request(image=img, filter_name=name),
+                               timeout=120)
+
+    threads = [threading.Thread(target=one, args=(n,))
+               for n in ("blur3", "box3")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    for name, want in (("blur3", want_blur), ("box3", want_box)):
+        assert isinstance(out[name], Response)
+        assert out[name].batch_size == 1       # different keys: never merged
+        np.testing.assert_array_equal(out[name].image, want)
+    svc.close()
+
+
+# ------------------------------------------------- service: resilience
+
+
+def test_compile_fault_walks_degradation_ladder():
+    img = _img(26, 38, seed=5)
+    want = oracle.run_serial_u8(img, filters.get_filter("blur3"), 3)
+    with faults.injected("backend_compile:1"):
+        svc = _service(fallback=True)
+        resp = svc.submit(Request(image=img, iters=3, backend="pallas"),
+                          timeout=120)
+        assert isinstance(resp, Response), resp
+        # The pallas probe ate the injected fault; the ladder walked to the
+        # normative tier and the response says so.
+        assert resp.backend == "pallas"
+        assert resp.effective_backend == "shifted"
+        np.testing.assert_array_equal(resp.image, want)
+        svc.close()
+
+
+def test_transient_engine_fault_healed_by_retry():
+    img = _img(28, 44, seed=6)
+    want = oracle.run_serial_u8(img, filters.get_filter("sharpen3"), 2)
+    with faults.injected("halo_exchange:1"):
+        svc = _service(fallback=False)         # no probe: retry must heal it
+        resp = svc.submit(Request(image=img, filter_name="sharpen3",
+                                  iters=2), timeout=120)
+        assert isinstance(resp, Response), resp
+        assert svc.stats["retries"] >= 1
+        assert resp.effective_backend == "shifted"
+        np.testing.assert_array_equal(resp.image, want)
+        svc.close()
+
+
+def test_exhausted_transient_faults_become_typed_error():
+    with faults.injected("backend_compile:*"):
+        svc = _service(fallback=False,
+                       retry_policy=RetryPolicy(max_attempts=2,
+                                                base_delay=0.01,
+                                                max_delay=0.02))
+        resp = svc.submit(Request(image=_img(30, 42, seed=9), iters=1),
+                          timeout=120)
+        assert isinstance(resp, Rejected)
+        assert resp.reason == "error"
+        assert svc.stats["rejected_error"] == 1
+        svc.close()
+
+
+# ----------------------------------------------------------- frontend
+
+
+def _wire_body(img, **kw):
+    body = {"image_b64": base64.b64encode(
+        np.ascontiguousarray(img).tobytes()).decode("ascii"),
+        "rows": img.shape[0], "cols": img.shape[1],
+        "mode": "rgb" if img.ndim == 3 else "grey"}
+    body.update(kw)
+    return body
+
+
+def test_inprocess_client_roundtrip_and_rejection_codec():
+    svc = _service()
+    client = InProcessClient(svc)
+    img = _img()
+    want = oracle.run_serial_u8(img, filters.get_filter("blur3"), 2)
+    status, resp = client.request(_wire_body(img, iters=2), timeout=120)
+    assert status == 200 and resp["ok"]
+    got = np.frombuffer(base64.b64decode(resp["image_b64"]),
+                        np.uint8).reshape(img.shape)
+    np.testing.assert_array_equal(got, want)
+    assert resp["effective_backend"] == "shifted"
+    assert resp["phases"]["total"] >= resp["phases"]["device"]
+
+    status, resp = client.request({"rows": 8})          # malformed body
+    assert status == 400 and resp["rejected"] == "invalid"
+    status, resp = client.request(_wire_body(img, filter="nope"))
+    assert status == 400 and resp["rejected"] == "invalid"
+    status, health = client.healthz()
+    assert status == 200 and health["ok"]
+    assert health["service"]["completed"] >= 1
+    svc.close()
+
+
+def test_loadgen_inprocess_emits_schema_valid_row():
+    """The acceptance row: scripts/loadgen.py against the CPU-mesh service
+    emits p50/p95/p99 + phase breakdown + effective_backend, oracle-checked,
+    with zero non-rejected failures (exit 0)."""
+    import json
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    from parallel_convolution_tpu.utils.platform import child_env_cpu
+
+    script = Path(__file__).resolve().parents[1] / "scripts" / "loadgen.py"
+    p = subprocess.run(
+        [sys.executable, str(script), "--in-process", "--n", "8",
+         "--concurrency", "2", "--rows", "24", "--cols", "36",
+         "--iters", "2", "--mesh", "2x2", "--check"],
+        capture_output=True, text=True, timeout=300, env=child_env_cpu(8))
+    assert p.returncode == 0, p.stdout + p.stderr
+    row = json.loads(p.stdout.strip().splitlines()[-1])
+    for field in ("workload", "backend", "effective_backend", "completed",
+                  "rejected", "non_rejected_failures", "wall_s", "p50_ms",
+                  "p95_ms", "p99_ms", "gpixels_per_s", "phases_ms",
+                  "platform", "mesh"):
+        assert field in row, f"missing {field!r} in {sorted(row)}"
+    assert row["completed"] == 8
+    assert row["non_rejected_failures"] == 0
+    assert row["oracle_mismatches"] == 0
+    assert row["effective_backend"] == "shifted"
+    assert row["platform"] == "cpu" and row["mesh"] == "2x2"
+    assert set(row["phases_ms"]) == {"queue", "compile", "device",
+                                     "copy_in", "copy_out"}
+    assert row["p50_ms"] <= row["p95_ms"] <= row["p99_ms"]
+
+
+def test_http_frontend_over_loopback():
+    import socket
+    import urllib.request
+
+    try:
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        probe.close()
+    except OSError:
+        pytest.skip("loopback sockets unavailable in this sandbox")
+    svc = _service()
+    server = make_http_server(svc, port=0)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        img = _img()
+        want = oracle.run_serial_u8(img, filters.get_filter("blur3"), 1)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/convolve",
+            data=__import__("json").dumps(_wire_body(img)).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            payload = __import__("json").loads(resp.read())
+        assert payload["ok"]
+        got = np.frombuffer(base64.b64decode(payload["image_b64"]),
+                            np.uint8).reshape(img.shape)
+        np.testing.assert_array_equal(got, want)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=30) as resp:
+            assert __import__("json").loads(resp.read())["ok"]
+    finally:
+        server.shutdown()
+        server.server_close()
+        svc.close()
