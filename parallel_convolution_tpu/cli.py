@@ -33,14 +33,22 @@ def _add_perf_args(p: argparse.ArgumentParser) -> None:
     """Filter/mesh/kernel knobs shared by the run and bench subcommands."""
     # Choices come from the canonical jax-free registries so a new backend
     # or storage tier lands in the CLI without a second edit.
-    from parallel_convolution_tpu.utils.config import BACKENDS, STORAGES
+    from parallel_convolution_tpu.utils.config import (
+        BACKEND_CHOICES, STORAGES,
+    )
 
     p.add_argument("--filter", default="blur3", dest="filter_name")
     p.add_argument("--mesh", default=None,
                    help="RxC grid, e.g. 2x4 (default: all devices)")
-    p.add_argument("--backend", default=None, choices=list(BACKENDS),
+    p.add_argument("--backend", default=None, choices=list(BACKEND_CHOICES),
                    help="correlate implementation (default: shifted, the "
-                        "normative XLA path)")
+                        "normative XLA path).  'auto' resolves backend — "
+                        "and any of --fuse/--tile left unset — through "
+                        "the tuning subsystem: plan cache "
+                        "(PCTPU_PLAN_FILE / scripts/tune.py --emit-plans) "
+                        "when present, else the roofline cost model; "
+                        "bits are identical to naming the resolved "
+                        "backend explicitly")
     p.add_argument("--storage", default=None, choices=list(STORAGES),
                    help="iteration-carry dtype (default: f32); narrower "
                         "carries shrink HBM/ICI traffic and stay "
@@ -115,7 +123,9 @@ def _resolve_perf_knobs(args, mesh) -> None:
         args.backend = "shifted"
     if args.storage is None:
         args.storage = "f32"
-    if args.fuse is None:
+    if args.fuse is None and args.backend != "auto":
+        # backend='auto' keeps the None: it means 'tune the depth too'
+        # (resolved with the backend through the plan cache/cost model).
         args.fuse = 1
 
 
@@ -370,8 +380,14 @@ def main(argv: list[str] | None = None) -> int:
             # labeled in the summary line, not only on stderr.
             from parallel_convolution_tpu.resilience import degrade
 
-            model.effective_backend = (degrade.effective_for(args.backend)
-                                       or args.backend)
+            req = args.backend
+            if req == "auto":
+                # The degrade walk saw the RESOLVED tier, never 'auto'.
+                from parallel_convolution_tpu import tuning
+
+                last = tuning.last_resolution()
+                req = last.backend if last else req
+            model.effective_backend = degrade.effective_for(req) or req
     elif args.sharded_io:
         model.run_raw_file_sharded(args.image, args.output, args.rows,
                                    args.cols, args.mode, args.loops)
@@ -380,8 +396,25 @@ def main(argv: list[str] | None = None) -> int:
                            args.mode, args.loops)
     r, c = mesh.shape["x"], mesh.shape["y"]
     eff = getattr(model, "effective_backend", None) or args.backend
-    label = (args.backend if eff == args.backend
-             else f"{args.backend} degraded to {eff}")
+    if args.backend == "auto":
+        # Auto-resolved, not degraded: label the tier AND where the plan
+        # came from (measured|interpolated|predicted) so a mistune or a
+        # missing plan file is visible in the summary line.  The
+        # checkpoint branch resolves inside iterate_prepared (the model
+        # object never runs), so fall back to the process's last
+        # resolution for both pieces.
+        from parallel_convolution_tpu import tuning
+
+        last = tuning.last_resolution()
+        src = getattr(model, "plan_source", "explicit")
+        if src == "explicit" and last is not None:
+            src = last.source
+        if eff == "auto" and last is not None:
+            eff = last.backend
+        label = f"auto resolved to {eff} [{src}]"
+    else:
+        label = (args.backend if eff == args.backend
+                 else f"{args.backend} degraded to {eff}")
     print(f"ran {args.loops} x {args.filter_name} on {r}x{c} mesh "
           f"({label}) -> {args.output}")
     return 0

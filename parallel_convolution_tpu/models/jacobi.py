@@ -36,7 +36,9 @@ class JacobiSolver:
     quantize: bool = False
     boundary: str = "zero"
     storage: str = "f32"  # iteration-carry dtype (see sharded_converge)
-    fuse: int = 1  # fused iterations between convergence checks
+    fuse: int | None = 1  # fused iterations between convergence checks;
+    #                None = tune it (backend="auto", resolved in
+    #                sharded_converge through the tuning subsystem)
     tile: tuple[int, int] | None = None  # Pallas kernel tile override
     interior_split: bool = False  # unmasked-interior launch split (see
     #                ConvolutionModel; fused chunks only)

@@ -44,12 +44,15 @@ class ConvolutionModel:
 
     filt: Filter | str = "blur3"
     mesh: Mesh | None = None
-    backend: str = "shifted"
+    backend: str = "shifted"  # any BACKENDS name, or "auto": resolve
+    #                backend (and any None knobs below) through the tuning
+    #                subsystem — plan cache first, cost model otherwise
     quantize: bool = True
     storage: str = "f32"  # 'bf16' halves HBM/ICI traffic, still bit-exact
     #                        in quantize mode (u8 values are exact in bf16)
-    fuse: int = 1  # iterations per halo exchange (temporal fusion, T*r-deep
-    #                halos once instead of r-deep every iteration)
+    fuse: int | None = 1  # iterations per halo exchange (temporal fusion,
+    #                T*r-deep halos once instead of r-deep every iteration);
+    #                None = let backend="auto" tune the depth
     boundary: str = "zero"  # 'periodic' = torus wrap (ring topology)
     tile: tuple[int, int] | None = None  # Pallas kernel output-tile (TH, TW)
     #                override; None = per-kernel tuned default
@@ -64,17 +67,43 @@ class ConvolutionModel:
         if self.mesh is None:
             self.mesh = make_grid_mesh()
         step_lib._check_storage(self.storage, self.quantize)
+        if self.fuse is None and self.backend != "auto":
+            raise ValueError(
+                "fuse=None means 'tune it' and needs backend='auto'")
         # The backend the last run ACTUALLY used (== self.backend unless
-        # fallback degraded it); None until a run happens.
+        # auto resolved it / fallback degraded it); None until a run
+        # happens.  plan_source records the auto resolution's provenance
+        # (measured|interpolated|predicted), or 'explicit'.
         self.effective_backend: str | None = None
+        self.plan_source: str = "explicit"
 
-    def _resolved_backend(self, hw: tuple[int, int]) -> str:
+    def _resolved_knobs(self, hw: tuple[int, int],
+                        channels: int = 1) -> tuple[str, int, object]:
         """Resolve for the REAL (H, W) workload: the probe must compile
         the same kernel family (block geometry + storage dtype) the run
-        will, or it could pass while the run crashes."""
+        will, or it could pass while the run crashes.
+
+        ``backend="auto"`` resolves through the tuning subsystem FIRST
+        (plan cache, else cost model); the degradation walk then guards
+        the resolved backend like any explicitly-named one.
+        """
+        backend, fuse, tile = self.backend, self.fuse, self.tile
+        if backend == "auto":
+            from parallel_convolution_tpu import tuning
+
+            res = tuning.resolve(
+                self.mesh, self.filt, (channels, *hw),
+                storage=self.storage, quantize=self.quantize,
+                boundary=self.boundary, fuse=fuse,
+                tile=step_lib._norm_tile(tile))
+            backend, fuse, tile = res.backend, res.fuse, res.tile
+            self.plan_source = res.source
+        else:
+            fuse = 1 if fuse is None else fuse
+            self.plan_source = "explicit"
         if not self.fallback:
-            self.effective_backend = self.backend
-            return self.backend
+            self.effective_backend = backend
+            return backend, fuse, tile
         from parallel_convolution_tpu.parallel.mesh import (
             grid_shape, padded_extent,
         )
@@ -82,21 +111,21 @@ class ConvolutionModel:
         R, C = grid_shape(self.mesh)
         block_hw = (padded_extent(hw[0], R) // R, padded_extent(hw[1], C) // C)
         eff = step_lib._resolve_fallback(
-            self.mesh, self.filt, self.backend, self.quantize, self.fuse,
-            self.boundary, step_lib._norm_tile(self.tile),
+            self.mesh, self.filt, backend, self.quantize, fuse,
+            self.boundary, step_lib._norm_tile(tile),
             self.interior_split, self.storage, block_hw=block_hw)
         self.effective_backend = eff
-        return eff
+        return eff, fuse, tile
 
     # -- array-level API ----------------------------------------------------
     def run_planar(self, x, iters: int) -> jnp.ndarray:
         """(C, H, W) f32 in → (C, H, W) f32 out after ``iters`` iterations."""
+        backend, fuse, tile = self._resolved_knobs(x.shape[-2:], x.shape[0])
         return step_lib.sharded_iterate(
             x, self.filt, iters, mesh=self.mesh,
-            quantize=self.quantize,
-            backend=self._resolved_backend(x.shape[-2:]),
-            storage=self.storage, fuse=self.fuse, boundary=self.boundary,
-            tile=self.tile, interior_split=self.interior_split,
+            quantize=self.quantize, backend=backend,
+            storage=self.storage, fuse=fuse, boundary=self.boundary,
+            tile=tile, interior_split=self.interior_split,
         )
 
     def run_image(self, img: np.ndarray, iters: int) -> np.ndarray:
@@ -147,11 +176,12 @@ class ConvolutionModel:
             src, rows, cols, mode, self.mesh,
             dtype=np.dtype(STORAGE_DTYPES[self.storage]),
         )
+        backend, fuse, tile = self._resolved_knobs(
+            (rows, cols), 3 if mode == "rgb" else 1)
         out = step_lib.iterate_prepared(
             xs, self.filt, iters, self.mesh, (rows, cols),
-            quantize=self.quantize,
-            backend=self._resolved_backend((rows, cols)),
-            fuse=self.fuse, boundary=self.boundary, tile=self.tile,
+            quantize=self.quantize, backend=backend,
+            fuse=fuse, boundary=self.boundary, tile=tile,
             interior_split=self.interior_split,
         )
         sharded_io.save_sharded(dst, out, rows, cols, mode)

@@ -41,7 +41,9 @@ from parallel_convolution_tpu.parallel.mesh import (
     padded_extent,
 )
 from parallel_convolution_tpu.resilience.faults import fault_point
-from parallel_convolution_tpu.utils.config import BACKENDS  # canonical list
+from parallel_convolution_tpu.utils.config import (  # canonical registries
+    AUTO, BACKENDS,
+)
 from parallel_convolution_tpu.utils.jax_compat import shard_map
 
 __all__ = ["BACKENDS", "STORAGE_DTYPES", "sharded_iterate", "sharded_converge",
@@ -450,6 +452,28 @@ def _storage_name(dtype) -> str:
     return "f32"
 
 
+def _resolve_auto(mesh, filt, backend, fuse, tile, storage, quantize,
+                  boundary, valid_hw, channels):
+    """``backend='auto'`` -> concrete ``(backend, fuse, tile, source)``.
+
+    Resolution goes through the tuning subsystem (plan cache if a
+    ``PCTPU_PLAN_FILE`` is armed, else the cost model) and happens
+    BEFORE the resilience degrade walk — auto picks the tier, the
+    fallback probe then guards the resolved launch exactly as it guards
+    an explicitly-named one.  Explicit backends pass through untouched
+    (``fuse=None`` then just normalizes to 1, the historical default).
+    """
+    if backend != AUTO:
+        return backend, (1 if fuse is None else int(fuse)), tile, None
+    from parallel_convolution_tpu import tuning
+
+    res = tuning.resolve(
+        mesh, filt, (channels, valid_hw[0], valid_hw[1]), storage=storage,
+        quantize=quantize, boundary=boundary, fuse=fuse,
+        tile=_norm_tile(tile))
+    return res.backend, res.fuse, res.tile, res.source
+
+
 def _resolve_fallback(mesh, filt, backend, quantize, fuse, boundary, tile,
                       interior_split, storage="f32",
                       block_hw=None) -> str:
@@ -470,7 +494,7 @@ def _resolve_fallback(mesh, filt, backend, quantize, fuse, boundary, tile,
 
 def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
                      valid_hw, quantize: bool = True,
-                     backend: str = "shifted", fuse: int = 1,
+                     backend: str = "shifted", fuse: int | None = 1,
                      boundary: str = "zero",
                      tile: tuple[int, int] | None = None,
                      interior_split: bool = False,
@@ -495,6 +519,11 @@ def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
     BackendDegradedWarning rather than dying with the first failed tier.
     Probing first also means the donated input is never lost to a launch
     that was going to fail.
+
+    ``backend="auto"`` resolves through the tuning subsystem first
+    (plan cache, else cost model; ``fuse=None``/``tile=None`` are then
+    tuned too, non-None values are pins) — the degrade walk below
+    applies to the *resolved* backend.
     """
     if jnp.dtype(xs.dtype) == jnp.uint8 and not quantize:
         _check_storage("u8", quantize)  # public entry: same guard as above
@@ -502,6 +531,9 @@ def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
         _check_quantize_contract(xs, filt, quantize)
     R, Cc = grid_shape(mesh)
     block_hw = (xs.shape[1] // R, xs.shape[2] // Cc)
+    backend, fuse, tile, _ = _resolve_auto(
+        mesh, filt, backend, fuse, tile, _storage_name(xs.dtype), quantize,
+        boundary, tuple(valid_hw), xs.shape[0])
     if fallback:
         backend = _resolve_fallback(mesh, filt, backend, quantize, fuse,
                                     boundary, _norm_tile(tile),
@@ -516,7 +548,7 @@ def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
 
 def sharded_iterate(x, filt: Filter, iters: int, mesh: Mesh | None = None,
                     quantize: bool = True, backend: str = "shifted",
-                    storage: str = "f32", fuse: int = 1,
+                    storage: str = "f32", fuse: int | None = 1,
                     boundary: str = "zero",
                     tile: tuple[int, int] | None = None,
                     interior_split: bool = False,
@@ -554,7 +586,8 @@ def sharded_converge(x, filt: Filter, tol: float, max_iters: int,
                      check_every: int = 1, mesh: Mesh | None = None,
                      quantize: bool = False, backend: str = "shifted",
                      storage: str = "f32", boundary: str = "zero",
-                     fuse: int = 1, tile: tuple[int, int] | None = None,
+                     fuse: int | None = 1,
+                     tile: tuple[int, int] | None = None,
                      interior_split: bool = False, fallback: bool = False):
     """Run-to-convergence (BASELINE config 5).  Returns (result, iters_run).
 
@@ -567,6 +600,9 @@ def sharded_converge(x, filt: Filter, tol: float, max_iters: int,
         mesh = make_grid_mesh()
     _check_storage(storage, quantize)
     xs, valid_hw, block_hw = _prepare(x, mesh, filt.radius, storage)
+    backend, fuse, tile, _ = _resolve_auto(
+        mesh, filt, backend, fuse, tile, storage, quantize, boundary,
+        tuple(valid_hw), xs.shape[0])
     if fallback:
         backend = _resolve_fallback(mesh, filt, backend, quantize, fuse,
                                     boundary, _norm_tile(tile),

@@ -77,13 +77,19 @@ class EngineKey:
     quantize: bool = True
     backend: str = "shifted"         # requested; the entry records effective
     grid: tuple[int, int] = (1, 1)   # mesh grid (rows, cols)
+    tile: tuple[int, int] | None = None  # Pallas kernel tile (None=default)
 
     def validate(self) -> None:
         """Terminal (ValueError) on any out-of-registry field — the typed
-        ``Rejected("invalid")`` the service returns comes from here."""
+        ``Rejected("invalid")`` the service returns comes from here.
+
+        ``backend="auto"`` never reaches here: :meth:`WarmEngine.key_for`
+        resolves it to a concrete tier first, so two requests that tune
+        to the same program share one key (and one executable)."""
         get_filter(self.filter_name)  # raises on unknown names
         if self.backend not in BACKENDS:
-            raise ValueError(f"unknown backend {self.backend!r}")
+            raise ValueError(f"unknown backend {self.backend!r} (auto is "
+                             "resolved in key_for, never stored in a key)")
         if self.storage not in STORAGES:
             raise ValueError(f"unknown storage {self.storage!r}")
         if self.boundary not in BOUNDARIES:
@@ -94,16 +100,26 @@ class EngineKey:
             raise ValueError(f"bad planar shape {self.shape}")
         if self.iters < 1 or self.fuse < 1:
             raise ValueError("iters and fuse must be >= 1")
+        if self.tile is not None and (
+                len(self.tile) != 2 or min(self.tile) < 1):
+            raise ValueError(f"tile must be two positive ints, "
+                             f"got {self.tile}")
 
 
 class _Entry:
     """One warm key: resolved backend + compiled runners per batch size."""
 
-    __slots__ = ("key", "effective_backend", "fns", "lock")
+    __slots__ = ("key", "effective_backend", "fns", "lock", "plan_source",
+                 "predicted_gpx")
 
-    def __init__(self, key: EngineKey, effective_backend: str):
+    def __init__(self, key: EngineKey, effective_backend: str,
+                 plan_source: str = "explicit",
+                 predicted_gpx: float | None = None):
         self.key = key
         self.effective_backend = effective_backend
+        self.plan_source = plan_source       # explicit|measured|
+        #                                      interpolated|predicted
+        self.predicted_gpx = predicted_gpx   # cost-model Gpx/s/chip
         self.fns: dict[int, object] = {}   # batch size -> jitted runner
         self.lock = threading.Lock()       # per-batch-size build flight
 
@@ -122,31 +138,95 @@ class _InFlight:
 class WarmEngine:
     """Warm-executable cache over ``parallel.step`` for a fixed mesh."""
 
-    def __init__(self, mesh=None, capacity: int = 16, fallback: bool = True):
+    def __init__(self, mesh=None, capacity: int = 16, fallback: bool = True,
+                 plans=None):
         from parallel_convolution_tpu.parallel.mesh import make_grid_mesh
 
         self.mesh = mesh if mesh is not None else make_grid_mesh()
         self.capacity = max(1, int(capacity))
         self.fallback = fallback
+        # The plan cache backend="auto" keys resolve through: a
+        # tuning.PlanCache, a path to a plan file, or None (ambient
+        # PCTPU_PLAN_FILE, else pure cost model).
+        if isinstance(plans, str):
+            from parallel_convolution_tpu.tuning import PlanCache
+
+            plans = PlanCache.load(plans)
+        self.plans = plans
         self._lock = threading.Lock()
         self._entries: OrderedDict[EngineKey, _Entry] = OrderedDict()
         self._inflight: dict[EngineKey, _InFlight] = {}
+        # Resolution provenance per auto-resolved key (stamped into the
+        # entry at build time; explicit keys default to 'explicit').
+        self._plan_sources: dict[EngineKey, str] = {}
         self.stats = {
             "hits": 0, "misses": 0, "compiles": 0, "evictions": 0,
             "single_flight_waits": 0, "batches": 0, "images": 0,
         }
 
     # -- key construction ---------------------------------------------------
-    def key_for(self, shape, **kw) -> EngineKey:
-        """An :class:`EngineKey` for this engine's mesh; clamps fuse the
-        way ``_build_iterate`` will, so equal executables get equal keys."""
+    def resolve_key(self, shape, **kw) -> tuple[EngineKey, str]:
+        """``(EngineKey, plan_source)`` for this engine's mesh; clamps
+        fuse the way ``_build_iterate`` will, so equal executables get
+        equal keys.
+
+        ``backend="auto"`` (with ``fuse=None``/``tile=None`` meaning
+        'tune it') resolves through the tuning subsystem HERE — against
+        this engine's plan cache — so an auto request and an explicit
+        request for the tuned config produce the SAME key and share one
+        warm executable.  ``plan_source`` is THIS call's provenance
+        ('explicit' for named configs): responses must stamp per-request
+        provenance, because an auto and an explicit request can share a
+        key (and an entry) while having different origins.
+        """
         from parallel_convolution_tpu.parallel.mesh import grid_shape
 
+        kw = dict(kw)
+        plan_source = "explicit"
+        if kw.get("backend") == "auto":
+            from parallel_convolution_tpu import tuning
+
+            res = tuning.resolve(
+                self.mesh, get_filter(kw.get("filter_name", "blur3")),
+                tuple(int(s) for s in shape),
+                storage=kw.get("storage", "f32"),
+                quantize=bool(kw.get("quantize", True)),
+                boundary=kw.get("boundary", "zero"),
+                fuse=kw.get("fuse"), tile=kw.get("tile"),
+                plans=self.plans)
+            kw["backend"] = res.backend
+            kw["fuse"], kw["tile"] = res.fuse, res.tile
+            plan_source = res.source
+        if kw.get("fuse") is None and "fuse" in kw:
+            # Same contract as RunConfig/ConvolutionModel: fuse=None
+            # means 'tune it', which needs backend='auto' — silently
+            # running an explicit backend at fuse=1 would accept here
+            # what every other entry point rejects as invalid.
+            raise ValueError(
+                "fuse=None means 'tune it' and needs backend='auto'")
+        if kw.get("tile") is not None:
+            kw["tile"] = tuple(int(v) for v in kw["tile"])
         key = EngineKey(shape=tuple(int(s) for s in shape),
                         grid=grid_shape(self.mesh), **kw)
         if key.fuse > max(1, key.iters):
             key = dataclasses.replace(key, fuse=max(1, key.iters))
-        return key
+        if plan_source != "explicit":
+            with self._lock:
+                self._plan_sources[key] = plan_source
+                # Bounded independently of _entries: keys stamped here can
+                # be rejected before any entry exists (queue_full, block
+                # validation), so LRU eviction alone would never trim
+                # them — adversarially varied auto traffic must not grow
+                # this side table forever.  FIFO is fine: a dropped note
+                # is re-stamped by the next resolve_key for that key.
+                limit = max(64, 4 * self.capacity)
+                while len(self._plan_sources) > limit:
+                    self._plan_sources.pop(next(iter(self._plan_sources)))
+        return key, plan_source
+
+    def key_for(self, shape, **kw) -> EngineKey:
+        """:meth:`resolve_key` without the provenance (compat surface)."""
+        return self.resolve_key(shape, **kw)[0]
 
     # -- entry acquisition (LRU + single-flight) ----------------------------
     def entry(self, key: EngineKey) -> _Entry:
@@ -191,7 +271,10 @@ class WarmEngine:
                 self._entries[key] = entry
                 self._entries.move_to_end(key)
                 while len(self._entries) > self.capacity:
-                    self._entries.popitem(last=False)
+                    old_key, _ = self._entries.popitem(last=False)
+                    # Drop the provenance note too (re-resolved on the
+                    # next auto key_for); keeps the side table bounded.
+                    self._plan_sources.pop(old_key, None)
                     self.stats["evictions"] += 1
                 self._inflight.pop(key, None)
             fl.event.set()
@@ -212,8 +295,24 @@ class WarmEngine:
             effective = degrade.resolve_backend(
                 self.mesh, get_filter(key.filter_name), key.backend,
                 quantize=key.quantize, fuse=key.fuse, boundary=key.boundary,
-                storage=key.storage, block_hw=self._block_hw(key))
-        entry = _Entry(key, effective)
+                tile=key.tile, storage=key.storage,
+                block_hw=self._block_hw(key))
+        # Cost-model figure for the config actually compiled: every
+        # response carries predicted-vs-measured visibility, so a silent
+        # mistune (or a degraded tier) shows in per-request artifacts.
+        from parallel_convolution_tpu.tuning import costmodel, search
+        from parallel_convolution_tpu.tuning.plans import Workload
+
+        predicted = costmodel.predict_gpx_per_chip(search.predict(
+            Workload.from_mesh(self.mesh, get_filter(key.filter_name),
+                               key.shape, storage=key.storage,
+                               quantize=key.quantize,
+                               boundary=key.boundary),
+            search.Candidate(effective, key.fuse, key.tile)))
+        with self._lock:
+            source = self._plan_sources.get(key, "explicit")
+        entry = _Entry(key, effective, plan_source=source,
+                       predicted_gpx=round(predicted, 3))
         self._compile_batch(entry, 1)
         return entry
 
@@ -241,7 +340,7 @@ class WarmEngine:
             fn = step_lib._build_iterate(
                 self.mesh, filt, key.iters, key.quantize, valid_hw,
                 block_hw, entry.effective_backend, key.fuse, key.boundary,
-                None, False)
+                key.tile, False)
             # Trace + XLA-compile NOW (jit compiles on first call): warm
             # means the request path never sees compilation.
             import jax
@@ -255,7 +354,16 @@ class WarmEngine:
     # -- warmup -------------------------------------------------------------
     def warmup(self, keys) -> list[str]:
         """Pre-compile declared configs (batch size 1); returns the
-        effective backend per key, in order."""
+        effective backend per key, in order.
+
+        No plan-file parameter ON PURPOSE: ``keys`` are already-resolved
+        :class:`EngineKey` values, so arming the plan cache here would be
+        too late to affect them (the trap is real: an auto key built
+        before the plans load resolves against the cost model).  Arm
+        ``self.plans`` (constructor ``plans=``, or
+        ``ConvolutionService.warmup(plan_file=...)`` which loads BEFORE
+        building keys) and then call this.
+        """
         return [self.entry(k).effective_backend for k in keys]
 
     # -- execution ----------------------------------------------------------
@@ -301,6 +409,8 @@ class WarmEngine:
             self.stats["images"] += B
         info = {
             "effective_backend": entry.effective_backend,
+            "plan_source": entry.plan_source,
+            "predicted_gpx_per_chip": entry.predicted_gpx,
             "batch_size": B,
             "phases": {name: t.wall(name)
                        for name in ("compile", "copy_in", "device",
@@ -319,6 +429,10 @@ class WarmEngine:
                     {"filter": k.filter_name, "shape": list(k.shape),
                      "backend": k.backend,
                      "effective_backend": e.effective_backend,
+                     "fuse": k.fuse,
+                     "tile": list(k.tile) if k.tile else None,
+                     "plan_source": e.plan_source,
+                     "predicted_gpx_per_chip": e.predicted_gpx,
                      "batch_sizes": sorted(e.fns)}
                     for k, e in self._entries.items()
                 ],

@@ -74,7 +74,9 @@ def decode_request(body: dict) -> Request:
             iters=int(body.get("iters", 1)),
             backend=body.get("backend", "shifted"),
             storage=body.get("storage", "f32"),
-            fuse=int(body.get("fuse", 1)),
+            # fuse: null means 'tune it' (backend="auto"); absent means 1.
+            fuse=(None if body.get("fuse", 1) is None
+                  else int(body.get("fuse", 1))),
             boundary=body.get("boundary", "zero"),
             quantize=bool(body.get("quantize", True)),
             deadline_s=(float(deadline_ms) / 1e3
@@ -99,6 +101,8 @@ def encode_response(result) -> tuple[int, dict]:
             np.ascontiguousarray(result.image).tobytes()).decode("ascii"),
         "effective_backend": result.effective_backend,
         "backend": result.backend,
+        "plan_source": result.plan_source,
+        "predicted_gpx_per_chip": result.predicted_gpx_per_chip,
         "request_id": result.request_id,
         "batch_size": result.batch_size,
         "phases": result.phases,

@@ -49,9 +49,10 @@ class Request:
     image: np.ndarray
     filter_name: str = "blur3"
     iters: int = 1
-    backend: str = "shifted"
+    backend: str = "shifted"         # or "auto": plan-cache/cost-model
+    #                                  resolved (engine.key_for)
     storage: str = "f32"
-    fuse: int = 1
+    fuse: int | None = 1             # None = tune it (backend="auto")
     boundary: str = "zero"
     quantize: bool = True
     deadline_s: float | None = None
@@ -69,6 +70,10 @@ class Response:
     request_id: str
     batch_size: int                  # how many requests shared the program
     phases: dict
+    plan_source: str = "explicit"    # explicit|measured|interpolated|
+    #                                  predicted (auto-resolution origin)
+    predicted_gpx_per_chip: float | None = None  # cost-model figure for
+    #                                  the served config (vs measured)
 
     ok = True
 
@@ -98,10 +103,11 @@ class ConvolutionService:
     def __init__(self, mesh=None, *, capacity: int = 16,
                  max_batch: int = 8, max_delay_s: float = 0.005,
                  max_queue: int = 64, fallback: bool = True,
-                 retry_policy=None, start: bool = True):
+                 retry_policy=None, start: bool = True, plans=None):
         from parallel_convolution_tpu.resilience.retry import RetryPolicy
 
-        self.engine = WarmEngine(mesh, capacity=capacity, fallback=fallback)
+        self.engine = WarmEngine(mesh, capacity=capacity, fallback=fallback,
+                                 plans=plans)
         self.retry_policy = retry_policy or RetryPolicy(
             max_attempts=3, base_delay=0.05, max_delay=2.0)
         self.batcher = MicroBatcher(
@@ -121,8 +127,11 @@ class ConvolutionService:
         with self._lock:
             self.stats[counter] += n
 
-    def _validate(self, req: Request) -> tuple[EngineKey, np.ndarray]:
-        """Terminal ValueError on any contract violation (→ ``invalid``)."""
+    def _validate(self, req: Request) -> tuple[EngineKey, str, np.ndarray]:
+        """Terminal ValueError on any contract violation (→ ``invalid``).
+
+        Returns ``(key, plan_source, planar)`` — provenance is
+        per-REQUEST (an auto and an explicit request can share a key)."""
         from parallel_convolution_tpu.ops.filters import get_filter
         from parallel_convolution_tpu.utils import imageio
 
@@ -133,9 +142,11 @@ class ConvolutionService:
                 f"image must be uint8 (H, W) or (H, W, 3), got "
                 f"{img.dtype} {img.shape}")
         planar = imageio.interleaved_to_planar(img).astype(np.float32)
-        key = self.engine.key_for(
+        key, plan_source = self.engine.resolve_key(
             planar.shape, filter_name=req.filter_name, storage=req.storage,
-            iters=int(req.iters), fuse=int(req.fuse), boundary=req.boundary,
+            iters=int(req.iters),
+            fuse=None if req.fuse is None else int(req.fuse),
+            boundary=req.boundary,
             quantize=bool(req.quantize), backend=req.backend)
         key.validate()
         filt = get_filter(key.filter_name)
@@ -150,7 +161,7 @@ class ConvolutionService:
                 planar.shape[1] % R or planar.shape[2] % C):
             raise ValueError(
                 "periodic boundary requires grid-divisible dimensions")
-        return key, planar
+        return key, plan_source, planar
 
     def submit(self, req: Request, wait: bool = True,
                timeout: float | None = None):
@@ -163,14 +174,14 @@ class ConvolutionService:
         rid = req.request_id or f"r{next(self._ids)}"
         self._bump("submitted")
         try:
-            key, planar = self._validate(req)
+            key, plan_source, planar = self._validate(req)
         except Exception as e:  # noqa: BLE001 — contract errors are typed
             self._bump("rejected_invalid")
             return Rejected("invalid", rid, detail=str(e))
         deadline_at = (time.monotonic() + req.deadline_s
                        if req.deadline_s is not None else None)
         payload = {"planar": planar, "rid": rid, "rgb": req.image.ndim == 3,
-                   "backend": req.backend}
+                   "backend": req.backend, "plan_source": plan_source}
         slot = self.batcher.try_submit(key, payload, deadline_at)
         if slot is None:
             self._bump("rejected_queue_full")
@@ -242,26 +253,43 @@ class ConvolutionService:
                 request_id=it.payload["rid"],
                 batch_size=info["batch_size"],
                 phases=per,
+                # Per-REQUEST provenance from admission time: an auto and
+                # an explicit request can share this entry, so the
+                # entry's build-time note cannot label them both.
+                plan_source=it.payload.get(
+                    "plan_source", info.get("plan_source", "explicit")),
+                predicted_gpx_per_chip=info.get("predicted_gpx_per_chip"),
             ))
             self._bump("completed")
 
     # -- lifecycle / introspection -------------------------------------------
-    def warmup(self, configs) -> list[str]:
+    def warmup(self, configs, plan_file: str | None = None) -> list[str]:
         """Pre-compile declared configs before taking traffic.
 
         ``configs`` are dicts with ``rows``/``cols``/``mode`` plus any
         :class:`Request` knobs (filter, iters, backend, storage, fuse,
-        boundary, quantize); returns each config's effective backend.
+        boundary, quantize — plus ``tile``); returns each config's
+        effective backend.  ``backend="auto"`` configs (and later auto
+        requests) resolve through ``plan_file`` when given (the tuner's
+        emitted plans — the service boots already tuned), else the
+        ambient/engine plan cache, else the cost model.
         """
+        if plan_file is not None:
+            from parallel_convolution_tpu.tuning import PlanCache
+
+            self.engine.plans = PlanCache.load(plan_file)
         keys = []
         for c in configs:
             channels = 3 if c.get("mode", "grey") == "rgb" else 1
+            fuse = c.get("fuse", 1)
+            tile = c.get("tile")
             keys.append(self.engine.key_for(
                 (channels, int(c["rows"]), int(c["cols"])),
                 filter_name=c.get("filter", c.get("filter_name", "blur3")),
                 storage=c.get("storage", "f32"),
                 iters=int(c.get("iters", 1)),
-                fuse=int(c.get("fuse", 1)),
+                fuse=None if fuse is None else int(fuse),
+                tile=None if tile is None else tuple(int(v) for v in tile),
                 boundary=c.get("boundary", "zero"),
                 quantize=bool(c.get("quantize", True)),
                 backend=c.get("backend", "shifted")))

@@ -1,0 +1,154 @@
+"""Autotuning subsystem: cost model, measured search, persistent plans.
+
+The three parts (see each module's docstring):
+
+* :mod:`tuning.costmodel` — the DESIGN.md roofline as ranking functions
+  (pure math, no jax);
+* :mod:`tuning.search` — legal candidate enumeration + model-pruned
+  measured refinement over ``utils.bench``;
+* :mod:`tuning.plans` — the schema-versioned persistent plan cache with
+  the exact -> nearest-bucket -> cost-model fallback ladder.
+
+This package root owns :func:`resolve` — the ``backend="auto"`` entry
+point the rest of the framework calls (``parallel/step.py``,
+``ConvolutionModel``, ``utils.bench``, the serving engine, the CLI).
+Resolution order, by construction *before* any resilience machinery:
+
+  1. plan cache (exact key, else nearest same-chip size bucket),
+  2. cost model over the legal candidate space,
+
+and the winner's provenance — ``measured | interpolated | predicted`` —
+travels with it (``Resolution.source``) so every bench/serving row can
+stamp ``plan_source`` and a silent mistune is visible in artifacts.
+The resilience degrade walk (``resilience/degrade.py``) then applies to
+the *resolved* backend exactly as it would to an explicitly-named one:
+auto picks the tier, degradation still guards the launch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from parallel_convolution_tpu.tuning import costmodel, search
+from parallel_convolution_tpu.tuning.plans import (
+    PLAN_FILE_ENV, PLAN_SCHEMA, Plan, PlanCache, Workload, canonical_key,
+    default_cache, default_plan_path,
+)
+
+AUTO = "auto"
+
+__all__ = [
+    "AUTO", "PLAN_FILE_ENV", "PLAN_SCHEMA", "Plan", "PlanCache",
+    "Resolution", "Workload", "canonical_key", "costmodel",
+    "default_cache", "default_plan_path", "last_resolution", "resolve",
+    "search",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Resolution:
+    """What ``backend="auto"`` resolved to, with provenance.
+
+    ``source`` is the ``plan_source`` rows stamp: ``measured`` (exact
+    plan-cache hit), ``interpolated`` (nearest same-chip size bucket),
+    or ``predicted`` (cost model only) — plus the stored provenance of
+    a hit plan, which may itself be ``predicted`` when the plan file
+    was emitted by a dry-run tune.
+    """
+
+    backend: str
+    fuse: int
+    tile: tuple[int, int] | None
+    source: str
+    predicted_gpx: float | None
+    key: str
+
+
+# The most recent resolution per process, for entry points that label
+# their output after the fact (mirrors degrade._LAST_RESOLVED).
+_LAST: list[Resolution] = []
+
+
+def last_resolution() -> Resolution | None:
+    return _LAST[-1] if _LAST else None
+
+
+def _legal_plan_knobs(w: Workload, plan: Plan) -> tuple[int, object]:
+    """Clamp a (possibly other-bucket) plan's knobs to THIS workload's
+    legality: fuse to the block/RDMA bounds, tile to alignment+VMEM —
+    an interpolated plan from a larger bucket must never hand an
+    impossible launch to the kernels."""
+    fuse = plan.fuse
+    legal_f = search._legal_fuses(w, plan.backend, (fuse,))
+    if fuse not in legal_f:
+        allf = search._legal_fuses(w, plan.backend, search.FUSE_MENU)
+        fuse = max((f for f in allf if f <= fuse), default=min(allf))
+    tile = plan.tile
+    if tile is not None and tile not in search._legal_tiles(
+            w, plan.backend, (tile,), fuse=fuse):
+        tile = None
+    return fuse, tile
+
+
+def resolve(mesh, filt, shape, *, storage: str = "f32",
+            quantize: bool = True, boundary: str = "zero",
+            fuse: int | None = None, tile: tuple[int, int] | None = None,
+            plans: PlanCache | None = None) -> Resolution:
+    """Resolve ``backend="auto"`` (and unset fuse/tile) for one workload.
+
+    ``fuse``/``tile`` passed non-None are pins: the plan/model fills
+    only the unset knobs, and a pinned value is honored verbatim (a pin
+    that is illegal for EVERY backend dies loudly in the candidate
+    enumeration — never silently remeasured as fuse=1/default tile).
+    ``plans=None`` consults
+    the ambient cache (``PCTPU_PLAN_FILE``); pass an explicit
+    :class:`PlanCache` (e.g. the serving engine's) to override.
+
+    Deterministic by construction: the candidate space, the model, and
+    every tie-break are pure functions of the workload — two processes
+    on the same platform resolve identically (pinned in tier-1).
+    """
+    w = Workload.from_mesh(mesh, filt, shape, storage=storage,
+                           quantize=quantize, boundary=boundary)
+    cache = plans if plans is not None else default_cache()
+    plan = cache.best_plan(w) if len(cache) else None
+    if plan is not None and fuse is not None and not search._legal_fuses(
+            w, plan.backend, (int(fuse),), strict=True):
+        # Same error surface as the no-plan path (candidate enumeration
+        # rejects the pin there) — resolution behavior must not depend
+        # on whether a plan file happens to be armed.
+        raise ValueError(
+            f"no legal candidates: pinned fuse={fuse} fails legality for "
+            f"{w.filter_name} {w.shape} on grid {w.grid}")
+    if plan is not None and tile is not None and not search._legal_tiles(
+            w, plan.backend, (tuple(tile),), strict=True):
+        raise ValueError(
+            f"no legal candidates: pinned tile={tuple(tile)} fails "
+            f"legality for {w.filter_name} {w.shape} on grid {w.grid}")
+    if plan is not None:
+        p_fuse, p_tile = _legal_plan_knobs(w, plan)
+        res = Resolution(
+            backend=plan.backend,
+            fuse=int(fuse) if fuse is not None else p_fuse,
+            tile=tile if tile is not None else p_tile,
+            source=plan.source,
+            predicted_gpx=plan.predicted_gpx,
+            key=w.key(),
+        )
+    else:
+        result = search.tune(
+            w, mesh=None, dry_run=True,
+            fuses=[int(fuse)] if fuse is not None else None,
+            tiles=[tuple(tile)] if tile is not None else None)
+        p = result.plan
+        res = Resolution(
+            backend=p.backend,
+            fuse=int(fuse) if fuse is not None else p.fuse,
+            tile=tile if tile is not None else p.tile,
+            source="predicted",
+            predicted_gpx=p.predicted_gpx,
+            key=w.key(),
+        )
+    _LAST.append(res)
+    del _LAST[:-4]  # bounded history; only the last is ever read
+    return res

@@ -1,0 +1,267 @@
+"""The DESIGN.md roofline as code (autotuning analytical prior).
+
+DESIGN.md "Roofline: an iterated stencil is bandwidth, not FLOPs" states
+the performance model behind every knob this framework exposes — carry
+bytes per pixel per iteration as a function of storage dtype and fusion
+depth, a shrinking-rim recompute tax for fused kernels, and a per-round
+collective cost for the halo exchange.  Until now that model lived only
+in prose (and in a human running ``scripts/tune_pallas.py`` on silicon
+and pasting the winner into ``ops/pallas_stencil.DEFAULT_TILE``).  This
+module is the same model as *ranking functions*: the autotuner
+(``tuning.search``) uses it to order the candidate space and to prune
+measurement down to a handful of compiles, and ``backend="auto"`` uses
+it as the zero-measurement fallback when no plan file exists.
+
+Everything here is pure arithmetic on python ints/floats — no jax, no
+device access — so the model runs identically on a dev laptop, in CI,
+and on the chip host, and is trivially testable (monotonicity pins in
+``tests/test_tuning.py``).
+
+Accuracy contract: the model RANKS, it does not promise walls.  The
+constants come from measured v5e rows (BASELINE.md / DESIGN.md round-4
+cross-validated readings) but a factor-of-two absolute error is fine as
+long as ordering survives; every number derived from the model is
+stamped ``plan_source="predicted"`` so it can never masquerade as a
+measurement (the round-4/5 evidence rule applied to predictions).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# Mirrors parallel.step.STORAGE_DTYPES widths without importing jax.
+STORAGE_BYTES = {"f32": 4, "bf16": 2, "u8": 1}
+
+# Mirrors ops.pallas_stencil._sublane: second-minor HBM/VMEM tile extent.
+SUBLANE = {"f32": 8, "bf16": 16, "u8": 32}
+LANE = 128
+
+# Mirrors ops.pallas_stencil defaults (kept in sync by a tier-1 test).
+DEFAULT_TILE = (256, 512)
+SEP_TILE = (1024, 512)
+
+# Mirrors ops.pallas_rdma._TILED_VMEM_BYTES: monolithic-kernel budget
+# before the RDMA tier auto-switches to the HBM-pad windowed variant.
+RDMA_TILED_VMEM_BYTES = 10 * 2**20
+
+# Mosaic's scoped-VMEM stack limit (the 2D tap loop keeps ~k^2 live
+# (th, tw) f32 temporaries; 1024x512 f32 failed compile at 25.3 MB vs
+# this bound — DESIGN.md round-1 lesson 2).
+SCOPED_VMEM_BYTES = 16 * 2**20
+
+PALLAS_BACKENDS = ("pallas", "pallas_sep", "pallas_rdma")
+
+# Pallas kernels off-TPU run under the interpreter — hundreds to
+# thousands of times slower than compiled XLA.  The exact factor is
+# irrelevant; it only needs to dominate every legitimate difference so
+# ``backend="auto"`` on a CPU mesh deterministically picks a compiled
+# XLA tier.
+INTERPRET_PENALTY = 1e4
+
+
+@dataclasses.dataclass(frozen=True)
+class HardwareModel:
+    """Roofline constants for one chip kind.
+
+    ``hbm_gbps``/``flop_gops`` are the streaming-bandwidth and
+    FMA-slot-throughput ceilings the roofline maxes over;
+    ``exchange_lat_s`` is the per-phase collective launch latency and
+    ``ici_gbps`` the neighbor-link bandwidth (both from the
+    scaling-model defaults, DESIGN.md "Scale path").
+    ``interpret_pallas`` marks platforms where Pallas kernels execute
+    under the interpreter rather than Mosaic.
+    """
+
+    name: str
+    hbm_gbps: float
+    flop_gops: float
+    exchange_lat_s: float
+    ici_gbps: float
+    interpret_pallas: bool = False
+
+
+# v5e-class constants.  flop_gops is the measured VPU rate (1 469.8
+# Gflop/s f32, DESIGN.md "Cross-validated instrument readings").
+# hbm_gbps is the ACHIEVED streaming rate of the XLA-orchestrated
+# iteration loop, not the ~800 GB/s spec sheet: the measured pallas
+# bf16 fuse=1 row (11.6 Gpx/s at ~8 charged bytes/px, BASELINE round 1)
+# implies ~93 GB/s through the pad/exchange/kernel round trips, and it
+# is that effective rate that makes the model reproduce the measured
+# ~4x fusion lever (spec-sheet bandwidth never binds and would rank
+# fuse=1 first, contradicting silicon).  ICI: 45 GB/s + 5 us/phase
+# (the scaling-model assumption, labeled as such there).
+TPU_V5E = HardwareModel("tpu-v5e", hbm_gbps=100.0, flop_gops=1470.0,
+                        exchange_lat_s=5e-6, ici_gbps=45.0)
+
+# Generic-host constants.  Absolute values are rough; on CPU the model
+# only has to (a) crush interpreted Pallas via the penalty and (b) rank
+# compiled XLA tiers against each other, where op count dominates.
+CPU_HOST = HardwareModel("cpu", hbm_gbps=20.0, flop_gops=50.0,
+                         exchange_lat_s=20e-6, ici_gbps=20.0,
+                         interpret_pallas=True)
+
+
+def hardware_for(platform: str, device_kind: str = "") -> HardwareModel:
+    """The :class:`HardwareModel` for a jax platform/device_kind pair.
+
+    Unknown TPU generations get the v5e constants (right order of
+    magnitude, and ranking is what matters); anything that is not a TPU
+    gets the generic host model with the interpret penalty armed.
+    """
+    if platform == "tpu":
+        return dataclasses.replace(
+            TPU_V5E, name=device_kind.strip() or "tpu")
+    return dataclasses.replace(CPU_HOST, name=platform or "cpu")
+
+
+def effective_tile(backend: str, tile: tuple[int, int] | None,
+                   ) -> tuple[int, int] | None:
+    """The kernel output tile a launch will actually use.
+
+    ``None`` for backends with no tile concept; the per-kernel module
+    default when the caller passed None — the value ``utils.bench``
+    stamps so evidence rows can never disagree with the executable.
+    """
+    if backend not in PALLAS_BACKENDS:
+        return None
+    if tile is not None:
+        return (int(tile[0]), int(tile[1]))
+    return SEP_TILE if backend == "pallas_sep" else DEFAULT_TILE
+
+
+def rdma_is_tiled(shape: tuple[int, int, int], block_hw: tuple[int, int],
+                  radius: int, fuse: int, storage: str) -> bool:
+    """Whether ``pallas_rdma`` auto-selects its tiled (HBM-pad) kernel.
+
+    Mirrors ``ops.pallas_rdma.fused_rdma_step``'s ``tiled=None``
+    auto-select: monolithic f32 padded buffer + storage-dtype output
+    over ``RDMA_TILED_VMEM_BYTES`` switches to the windowed variant.
+    """
+    C = shape[0]
+    h, w = block_hw
+    d = radius * max(1, fuse)
+    mono = (C * (h + 2 * d) * (w + 2 * d) * 4
+            + C * h * w * STORAGE_BYTES[storage])
+    return mono > RDMA_TILED_VMEM_BYTES
+
+
+def rim_overhead(fuse: int, tile_hw: tuple[int, int], radius: int) -> float:
+    """Extra-compute fraction from recomputing the shrinking overlap rim.
+
+    A fused kernel computes level ``s`` (1-based) of a (th, tw) output
+    tile on the extended extent (th + 2r(T-s))(tw + 2r(T-s)); the sum
+    over levels, normalized by T*th*tw, minus 1, is the recompute tax
+    (DESIGN.md knob 3: ~6% at th=256, tw=512, r=1, T=8).
+    """
+    T = max(1, int(fuse))
+    th, tw = tile_hw
+    total = sum((th + 2 * radius * (T - s)) * (tw + 2 * radius * (T - s))
+                for s in range(1, T + 1))
+    return total / (T * th * tw) - 1.0
+
+
+def hbm_bytes_per_px_iter(backend: str, storage: str, fuse: int,
+                          tile: tuple[int, int] | None,
+                          block_hw: tuple[int, int], radius: int,
+                          shape: tuple[int, int, int] = (1, 0, 0)) -> float:
+    """Predicted HBM bytes moved per pixel per iteration.
+
+    The DESIGN.md table as a function: carry width B from the storage
+    dtype.  The ppermute+Pallas tiers pay, once per T levels, the
+    halo-pad materialization (XLA writes the padded block, one
+    read+write pair = 2B), the kernel's windowed input read (grown by
+    the 2r*T ghost rim), and one output write — so bytes fall as ~4B/T
+    plus the rim term, the fused-kernel win of DESIGN.md knob 3.  The
+    XLA tiers re-materialize and re-stream every level (charged 4B per
+    iteration, fuse-invariant: fusion only saves them collective
+    rounds).  The RDMA tier skips the pad materialization entirely
+    (ghosts land by remote DMA); its monolithic form holds everything
+    in VMEM and streams the block exactly once per T.
+    """
+    B = STORAGE_BYTES[storage]
+    T = max(1, int(fuse))
+    if backend not in PALLAS_BACKENDS:
+        return 4.0 * B
+    if backend == "pallas_rdma" and not rdma_is_tiled(
+            shape, block_hw, radius, T, storage):
+        return 2.0 * B / T
+    th, tw = effective_tile(backend, tile)
+    # Windows are clamped to the block: a tile bigger than the block
+    # degenerates to one whole-block window.
+    th = min(th, max(1, block_hw[0]))
+    tw = min(tw, max(1, block_hw[1]))
+    d = radius * T
+    window = (th + 2 * d) * (tw + 2 * d)
+    pad_rt = 0.0 if backend == "pallas_rdma" else 2.0
+    return B * (pad_rt + window / (th * tw) + 1.0) / T
+
+
+def flops_per_px_iter(k: int, separable: bool, quantize: bool,
+                      fuse: int, rim_tile: tuple[int, int],
+                      radius: int) -> float:
+    """Predicted f32 FMA-slot work per pixel per iteration.
+
+    Separable kernels do 2k MACs/px, the 2D tap loop k^2 (DESIGN.md
+    knob set); quantize mode adds the two round-adds (the round-5 magic
+    rounding — the measured 8-slot floor for the separable form).  The
+    whole count is inflated by the fused rim-recompute tax evaluated on
+    ``rim_tile`` (the kernel tile for Pallas, the device block for the
+    ppermute-fused XLA path).
+    """
+    macs = 2 * k if separable else k * k
+    slots = 2.0 * macs + (2.0 if quantize else 0.0)
+    return slots * (1.0 + rim_overhead(fuse, rim_tile, radius))
+
+
+def exchange_seconds_per_px_iter(grid: tuple[int, int],
+                                 block_hw: tuple[int, int], radius: int,
+                                 fuse: int, storage: str,
+                                 hw: HardwareModel) -> float:
+    """Per-pixel-iteration cost of the halo exchange, amortized over T.
+
+    Two phases (rows then columns) of launch latency plus the four
+    ghost slabs (depth r*T) over the neighbor links; a 1x1 grid has no
+    collective and costs zero (the statically-elided exchange).
+    """
+    if grid[0] * grid[1] == 1:
+        return 0.0
+    T = max(1, int(fuse))
+    B = STORAGE_BYTES[storage]
+    bh, bw = block_hw
+    d = radius * T
+    slab_bytes = 2.0 * (bh + bw) * d * B
+    per_round = 2.0 * hw.exchange_lat_s + slab_bytes / (hw.ici_gbps * 1e9)
+    return per_round / (T * bh * bw)
+
+
+def predict_seconds_per_px_iter(backend: str, storage: str, fuse: int,
+                                tile: tuple[int, int] | None,
+                                shape: tuple[int, int, int],
+                                block_hw: tuple[int, int],
+                                grid: tuple[int, int], k: int,
+                                separable: bool, quantize: bool,
+                                hw: HardwareModel) -> float:
+    """Roofline time: max(bandwidth, compute) + exchange, per px-iter."""
+    radius = k // 2
+    T = max(1, int(fuse))
+    tile_eff = effective_tile(backend, tile)
+    rim_tile = tile_eff if tile_eff is not None else block_hw
+    if backend == "pallas_rdma" and not rdma_is_tiled(
+            shape, block_hw, radius, T, storage):
+        rim_tile = block_hw  # monolithic: levels run on the whole block
+    sep = separable and backend in ("separable", "pallas_sep")
+    t_hbm = hbm_bytes_per_px_iter(
+        backend, storage, T, tile, block_hw, radius, shape
+    ) / (hw.hbm_gbps * 1e9)
+    t_flop = flops_per_px_iter(
+        k, sep, quantize, T, rim_tile, radius) / (hw.flop_gops * 1e9)
+    t = max(t_hbm, t_flop) + exchange_seconds_per_px_iter(
+        grid, block_hw, radius, T, storage, hw)
+    if backend in PALLAS_BACKENDS and hw.interpret_pallas:
+        t *= INTERPRET_PENALTY
+    return t
+
+
+def predict_gpx_per_chip(seconds_per_px_iter: float) -> float:
+    """Gpixels/sec/chip implied by a per-px-iter time (the bench unit)."""
+    return 1.0 / (seconds_per_px_iter * 1e9)

@@ -117,7 +117,8 @@ def bench_iterate(
     backend: str = "shifted",
     quantize: bool = True,
     storage: str = "f32",
-    fuse: int = 1,
+    fuse: int | None = 1,
+    boundary: str = "zero",
     reps: int = 3,
     tile: tuple[int, int] | None = None,
     interior_split: bool = False,
@@ -137,7 +138,18 @@ def bench_iterate(
     additionally walks the degradation chain (resilience.degrade) on a
     transient compile/launch failure, and the row then records the
     backend that ACTUALLY produced the number, with the requested one
-    still under ``backend``."""
+    still under ``backend``.
+
+    ``backend="auto"`` (optionally with ``fuse=None``/``tile=None``)
+    resolves through the tuning subsystem BEFORE the degrade walk; the
+    row's ``plan_source`` records where the plan came from
+    (measured|interpolated|predicted — 'explicit' for named configs),
+    and ``fuse``/``tile`` always record the values the executable was
+    ACTUALLY compiled with (post-resolution, post-clamp), never the
+    caller-passed ones — an evidence row can no longer disagree with
+    the program it timed.  ``predicted_gpx_per_chip`` is the cost
+    model's figure for the same config, so a silent mistune shows as a
+    measured-vs-predicted gap in every row."""
     if mesh is None:
         mesh = make_grid_mesh()
     reps = max(1, reps)  # reps=0 would leave the slope path's median empty
@@ -151,19 +163,22 @@ def bench_iterate(
     # dtype and sharding are invariant, exactly the double-buffer reuse the
     # real pipeline gets.
     xs, valid_hw, block_hw = step_lib._prepare(x, mesh, filt.radius, storage)
-    effective = backend
+    effective, fuse, tile, plan_source = step_lib._resolve_auto(
+        mesh, filt, backend, fuse, tile, storage, quantize, boundary,
+        valid_hw, channels)
+    plan_source = plan_source or "explicit"
     if fallback:
         from parallel_convolution_tpu.resilience import degrade
 
         # Probe on the REAL block geometry + storage: kernel selection
         # (e.g. pallas_rdma tiled-vs-monolithic) depends on both.
         effective = degrade.resolve_backend(
-            mesh, filt, backend, quantize=quantize, fuse=fuse,
-            tile=tile, interior_split=interior_split, storage=storage,
-            block_hw=block_hw)
+            mesh, filt, effective, quantize=quantize, fuse=fuse,
+            boundary=boundary, tile=tile, interior_split=interior_split,
+            storage=storage, block_hw=block_hw)
     fn = step_lib._build_iterate(mesh, filt, iters, quantize, valid_hw,
-                                 block_hw, effective, fuse, tile=tile,
-                                 interior_split=interior_split)
+                                 block_hw, effective, fuse, boundary,
+                                 tile, interior_split)
     out = fence(fn(xs))  # compile + warmup
 
     # The fence itself can cost a large constant on tunnel platforms
@@ -218,18 +233,39 @@ def bench_iterate(
     n_dev = mesh.size
     gpx = H * W * channels * iters / secs / 1e9
     dev0 = mesh.devices.flat[0]
+    # Stamp what was COMPILED, not what was passed: the same clamp
+    # _build_iterate applies, and the kernel tile the launch actually
+    # used (explicit/auto-resolved value, else the per-kernel module
+    # default for Pallas tiers; None for backends with no tile).
+    from parallel_convolution_tpu.tuning import costmodel, search
+    from parallel_convolution_tpu.tuning.plans import Workload
+
+    compiled_fuse = max(1, min(fuse, iters or 1))
+    compiled_tile = costmodel.effective_tile(effective, tile)
+    if effective == "pallas_rdma" and not costmodel.rdma_is_tiled(
+            (channels, H, W), block_hw, filt.radius, compiled_fuse, storage):
+        compiled_tile = None  # monolithic kernel: no output tile exists
+    predicted = costmodel.predict_gpx_per_chip(search.predict(
+        Workload.from_mesh(mesh, filt, (channels, H, W), storage=storage,
+                           quantize=quantize, boundary=boundary),
+        search.Candidate(effective, compiled_fuse, compiled_tile)))
     return {
         "workload": f"{filt.name} {H}x{W}x{channels} {iters} iters",
         "backend": backend,
         # The backend that ACTUALLY produced this number (differs from
-        # 'backend' only when fallback degraded it) and the hardware it
-        # ran on — a silent CPU fallback or tier downgrade can no longer
-        # masquerade as the requested configuration in published rows.
+        # 'backend' only when fallback degraded it, or when 'auto' was
+        # resolved) and the hardware it ran on — a silent CPU fallback
+        # or tier downgrade can no longer masquerade as the requested
+        # configuration in published rows.
         "effective_backend": effective,
         "platform": dev0.platform,
         "device_kind": getattr(dev0, "device_kind", "") or "",
         "storage": storage,
-        "fuse": fuse,
+        "fuse": compiled_fuse,
+        "tile": (f"{compiled_tile[0]}x{compiled_tile[1]}"
+                 if compiled_tile else None),
+        "plan_source": plan_source,
+        "predicted_gpx_per_chip": round(predicted, 3),
         "mesh": "x".join(str(s) for s in grid_shape(mesh)),
         "devices": n_dev,
         "wall_s": round(secs, 4),

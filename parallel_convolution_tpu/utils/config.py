@@ -17,6 +17,11 @@ import json
 # from them.
 BACKENDS = ("shifted", "xla_conv", "pallas", "separable", "pallas_sep",
             "pallas_rdma")
+# The autotuning sentinel: not an implementation — entry points resolve it
+# through parallel_convolution_tpu.tuning (plan cache, else cost model)
+# BEFORE anything that needs a concrete backend name sees it.
+AUTO = "auto"
+BACKEND_CHOICES = BACKENDS + (AUTO,)
 STORAGES = ("f32", "bf16", "u8")
 BOUNDARIES = ("zero", "periodic")
 
@@ -31,9 +36,10 @@ class RunConfig:
     filter_name: str = "blur3"
     iters: int = 100
     mesh_shape: tuple[int, int] | None = None   # None = all devices
-    backend: str = "shifted"       # any of parallel.step.BACKENDS
+    backend: str = "shifted"       # any of parallel.step.BACKENDS, or
+    #                                "auto" (plan-cache/cost-model resolved)
     storage: str = "f32"           # f32 | bf16
-    fuse: int = 1
+    fuse: int | None = 1           # None = tune it (backend="auto" only)
     tile: tuple[int, int] | None = None   # Pallas kernel tile (TH, TW)
     boundary: str = "zero"
     quantize: bool = True
@@ -51,7 +57,7 @@ class RunConfig:
         if self.storage not in STORAGES:
             raise ValueError(
                 f"storage must be one of {STORAGES}, got {self.storage!r}")
-        if self.backend not in BACKENDS:
+        if self.backend not in BACKEND_CHOICES:
             raise ValueError(f"unknown backend {self.backend!r}")
         if self.boundary not in BOUNDARIES:
             raise ValueError(
@@ -60,8 +66,12 @@ class RunConfig:
             # u8 carries can only hold the quantized integer states; a float
             # Jacobi iterate would be silently truncated every iteration.
             raise ValueError("storage='u8' requires quantize=True")
-        if self.rows <= 0 or self.cols <= 0 or self.iters < 0 or self.fuse < 1:
+        if (self.rows <= 0 or self.cols <= 0 or self.iters < 0
+                or (self.fuse is not None and self.fuse < 1)):
             raise ValueError("rows/cols must be positive, iters >= 0, fuse >= 1")
+        if self.fuse is None and self.backend != AUTO:
+            raise ValueError(
+                "fuse=None means 'tune it' and needs backend='auto'")
         if self.mesh_shape is not None:
             self.mesh_shape = tuple(self.mesh_shape)
         if self.tile is not None:

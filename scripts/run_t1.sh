@@ -13,7 +13,22 @@
 #                                      Row lands in evidence/serving_smoke.json
 #                                      (the supervisor leg's done_file —
 #                                      see scripts/t1_legs.json).
+#   scripts/run_t1.sh --tuning-smoke   dry-run (model-only) tune on the 2x4
+#                                      CPU mesh: emits a plan file, then
+#                                      proves backend='auto' resolves FROM
+#                                      it (auto_ok in the summary row —
+#                                      evidence/tuning_smoke.json, the
+#                                      supervisor leg's done_file).
 cd "$(dirname "$0")/.." || exit 1
+
+if [ "${1:-}" = "--tuning-smoke" ]; then
+  exec timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python scripts/tune.py --rows 48 --cols 64 --mode grey \
+      --filter blur3 --iters 2 --mesh 2x4 --dry-run \
+      --emit-plans --out evidence/tuning_smoke_plans.json \
+      --verify-auto --summary-out evidence/tuning_smoke.json
+fi
 
 if [ "${1:-}" = "--serving-smoke" ]; then
   exec timeout -k 10 600 env JAX_PLATFORMS=cpu \

@@ -48,6 +48,11 @@ def main() -> int:
                     help="admission bound: deeper queues shed load")
     ap.add_argument("--no-fallback", action="store_true",
                     help="disable the per-key backend degradation ladder")
+    ap.add_argument("--plans", default=None, metavar="PLANS_JSON",
+                    help="tuner-emitted plan file (scripts/tune.py "
+                         "--emit-plans): backend='auto' warm configs and "
+                         "requests resolve through it, so the service "
+                         "boots already tuned")
     ap.add_argument("--warm", action="append", default=[],
                     metavar="JSON", help="config to pre-compile at startup "
                     '(repeatable), e.g. \'{"rows": 512, "cols": 512, '
@@ -77,9 +82,12 @@ def main() -> int:
     service = ConvolutionService(
         mesh, capacity=args.capacity, max_batch=args.max_batch,
         max_delay_s=args.max_delay_ms / 1e3, max_queue=args.max_queue,
-        fallback=not args.no_fallback)
+        fallback=not args.no_fallback, plans=args.plans)
     warm_cfgs = [json.loads(w) for w in args.warm]
     if warm_cfgs:
+        # The engine's plan cache was already armed by the constructor
+        # (plans=args.plans) — no plan_file here, or it would be parsed
+        # twice with two code paths to keep consistent.
         effective = service.warmup(warm_cfgs)
         for cfg, eff in zip(warm_cfgs, effective):
             print(json.dumps({"warmed": cfg, "effective_backend": eff}),

@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Tune a workload and (optionally) persist the plan file.
+
+The autotuning front door (``parallel_convolution_tpu/tuning/``):
+enumerate the legal candidate space, rank it with the roofline cost
+model, optionally refine with on-device measurement, and emit the
+winning plan — which ``backend="auto"`` (CLI runs, ``ConvolutionModel``,
+``utils.bench`` rows, ``scripts/serve.py --plans`` warmup) then resolves
+through.
+
+  # model-only (any machine, zero device work), merged into plans.json
+  python scripts/tune.py --rows 4096 --cols 4096 --iters 20 \\
+      --dry-run --emit-plans --out plans.json
+
+  # measured on the real mesh (O(dozens) of compiles, model-pruned)
+  python scripts/tune.py --rows 8192 --cols 8192 --storage bf16 \\
+      --iters 20 --emit-plans --out plans.json
+
+  # boot the service already tuned
+  python scripts/serve.py --plans plans.json \\
+      --warm '{"rows": 8192, "cols": 8192, "iters": 20, "backend": "auto"}'
+
+One summary JSON row goes to stdout (and ``--summary-out``); with
+``--verify-auto`` the row additionally proves the emitted file round-
+trips — ``backend="auto"`` re-resolved against it must return the
+just-written plan with its provenance (``auto_ok``), which is the
+``run_t1.sh --tuning-smoke`` gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import _path  # noqa: F401  (repo root + JAX_PLATFORMS re-apply)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rows", type=int, required=True)
+    ap.add_argument("--cols", type=int, required=True)
+    ap.add_argument("--mode", default="grey", choices=["grey", "rgb"])
+    ap.add_argument("--filter", default="blur3", dest="filter_name")
+    ap.add_argument("--iters", type=int, default=8,
+                    help="iterations per measured rep")
+    ap.add_argument("--storage", default="f32",
+                    choices=["f32", "bf16", "u8"])
+    ap.add_argument("--boundary", default="zero",
+                    choices=["zero", "periodic"])
+    ap.add_argument("--no-quantize", action="store_true")
+    ap.add_argument("--mesh", default=None,
+                    help="RxC grid (default: all devices, near-square)")
+    ap.add_argument("--backends", default=None,
+                    help="comma list restricting the candidate backends")
+    ap.add_argument("--fuses", default=None,
+                    help="comma list restricting fusion depths")
+    ap.add_argument("--tiles", default=None,
+                    help="comma list of HxW tiles restricting the menu")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="cost model only — no compiles, no device work; "
+                         "the emitted plan carries source='predicted'")
+    ap.add_argument("--reps", type=int, default=2)
+    ap.add_argument("--max-measure", type=int, default=8,
+                    help="measured-refinement budget (model-pruned)")
+    ap.add_argument("--emit-plans", action="store_true",
+                    help="write/merge the winning plan into --out (atomic; "
+                         "existing other-key plans are preserved)")
+    ap.add_argument("--out", default="plans.json",
+                    help="plan-cache file for --emit-plans")
+    ap.add_argument("--verify-auto", action="store_true",
+                    help="after emitting, resolve backend='auto' against "
+                         "the plan file and record auto_ok in the summary "
+                         "(requires --emit-plans)")
+    ap.add_argument("--summary-out", default=None,
+                    help="also write the summary row to this path")
+    args = ap.parse_args()
+    if args.verify_auto and not args.emit_plans:
+        ap.error("--verify-auto requires --emit-plans")
+
+    from parallel_convolution_tpu.ops.filters import get_filter
+    from parallel_convolution_tpu.parallel.mesh import mesh_from_spec
+    from parallel_convolution_tpu.tuning import (
+        PlanCache, Workload, resolve, search,
+    )
+    from parallel_convolution_tpu.utils.platform import enable_compile_cache
+
+    if not args.dry_run:
+        enable_compile_cache()
+    mesh = mesh_from_spec(args.mesh)
+    filt = get_filter(args.filter_name)
+    channels = 3 if args.mode == "rgb" else 1
+    shape = (channels, args.rows, args.cols)
+    quantize = not args.no_quantize
+    w = Workload.from_mesh(mesh, filt, shape, storage=args.storage,
+                           quantize=quantize, boundary=args.boundary)
+
+    backends = args.backends.split(",") if args.backends else None
+    fuses = ([int(v) for v in args.fuses.split(",")]
+             if args.fuses else None)
+    tiles = ([tuple(int(x) for x in t.split("x"))
+              for t in args.tiles.split(",")] if args.tiles else None)
+
+    result = search.tune(
+        w, mesh=mesh, dry_run=args.dry_run, backends=backends,
+        fuses=fuses, tiles=tiles, iters=args.iters, reps=args.reps,
+        max_measure=args.max_measure)
+    for row in result.rows:
+        print(json.dumps(row), file=sys.stderr, flush=True)
+
+    plan = result.plan
+    summary = {
+        "workload": {"shape": list(shape), "filter": filt.name,
+                     "storage": args.storage, "quantize": quantize,
+                     "boundary": args.boundary,
+                     "mesh": f"{w.grid[0]}x{w.grid[1]}",
+                     "platform": w.platform,
+                     "device_kind": w.device_kind},
+        "plan": plan.to_record(),
+        "plan_key": w.key(),
+        "measured_points": sum(1 for r in result.rows if "error" not in r),
+        "errors": sum(1 for r in result.rows if "error" in r),
+    }
+
+    if args.emit_plans:
+        cache = PlanCache()
+        cache.put(w, plan)
+        summary["plan_file"] = cache.merge_save(args.out)
+        summary["plans_in_file"] = len(cache)
+
+    if args.verify_auto:
+        # Round-trip proof: auto against the just-written file must hand
+        # back this plan, provenance intact — the tuning-smoke gate.
+        res = resolve(mesh, filt, shape, storage=args.storage,
+                      quantize=quantize, boundary=args.boundary,
+                      plans=PlanCache.load(args.out))
+        summary["auto_resolved"] = {
+            "backend": res.backend, "fuse": res.fuse,
+            "tile": list(res.tile) if res.tile else None,
+            "plan_source": res.source,
+        }
+        summary["auto_ok"] = bool(
+            res.backend == plan.backend and res.source == plan.source)
+
+    line = json.dumps(summary)
+    print(line, flush=True)
+    if args.summary_out:
+        import os
+
+        os.makedirs(os.path.dirname(os.path.abspath(args.summary_out)),
+                    exist_ok=True)
+        with open(args.summary_out, "w", encoding="utf-8") as f:
+            f.write(line + "\n")
+    if args.verify_auto and not summary["auto_ok"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,9 +1,12 @@
 #!/usr/bin/env python
-"""Tile-size tuner for the Pallas stencil kernels (run on a real TPU).
+"""Tile/fuse sweep for the Pallas stencil kernels (run on a real TPU).
 
-Sweeps (tile_h, tile_w) and fusion depth T on a fixed workload, printing a
-JSON row per point and the winner. Use the winner to update
-``ops/pallas_stencil.DEFAULT_TILE`` / ``SEP_TILE`` and the bench fuse depth.
+Since round 9 this is a thin CLI over ``tuning.search`` — the sweep
+loop, candidate legality, and the winner pick live there (shared with
+``backend="auto"`` and ``scripts/tune.py``), not here.  Flags are
+unchanged from the round-1 tool.  Prints a JSON row per measured point
+(resolved tile/fuse stamped by ``utils.bench``) and the winner; to
+persist the winner as a plan file use ``scripts/tune.py --emit-plans``.
 
   python scripts/tune_pallas.py --size 8192 --iters 20
 """
@@ -25,7 +28,9 @@ def main() -> int:
     ap.add_argument("--backend", default="pallas",
                     choices=["pallas", "pallas_sep"])
     ap.add_argument("--tiles", default=None,
-                    help="comma list of HxW tiles, e.g. 1024x512,128x512")
+                    help="comma list of HxW tiles, e.g. 1024x512,128x512 "
+                         "(default: the tuning.search menu, legality-"
+                         "filtered)")
     ap.add_argument("--fuses", default=None,
                     help="comma list of fusion depths, e.g. 16,32,64")
     ap.add_argument("--isplit", action="store_true",
@@ -34,55 +39,68 @@ def main() -> int:
     args = ap.parse_args()
 
     import jax
-    import numpy as np
 
     from parallel_convolution_tpu.ops.filters import get_filter
     from parallel_convolution_tpu.parallel.mesh import make_grid_mesh
-    from parallel_convolution_tpu.utils import bench
+    from parallel_convolution_tpu.tuning import Workload, search
 
     mesh = make_grid_mesh(jax.devices()[:1], (1, 1))
     filt = get_filter("blur3")
-    H = W = args.size
-    results = []
+    w = Workload.from_mesh(mesh, filt, (1, args.size, args.size),
+                           storage=args.storage)
 
-    tiles = [(128, 512), (256, 256), (256, 512), (256, 1024),
-             (512, 512), (512, 1024), (1024, 512)]
+    tiles = None
     if args.tiles:
         tiles = [tuple(int(v) for v in t.split("x"))
                  for t in args.tiles.split(",")]
-    fuses = (1, 2, 4, 8, 16)
+    fuses = None
     if args.fuses:
         fuses = tuple(int(v) for v in args.fuses.split(","))
     if args.isplit:
         # The split only exists on the fused (fuse > 1) kernel path; a
         # fuse=1 row stamped isplit:true would record a fabricated no-op
         # "measurement" in the evidence file.
+        fuses = fuses if fuses is not None else search.FUSE_MENU
         dropped = [f for f in fuses if f <= 1]
         fuses = tuple(f for f in fuses if f > 1)
         if dropped:
             print(f"# --isplit: dropped fuse{dropped} (split needs fuse>1)",
                   file=sys.stderr)
-    for tile in tiles:
-        for fuse in fuses:
-            # tile is threaded through as an explicit static jit argument —
-            # monkeypatching the module defaults does NOT reach
-            # already-traced kernels (each (tile, fuse) point gets its own
-            # compile this way).
-            try:
-                row = bench.bench_iterate(
-                    (H, W), filt, args.iters, mesh=mesh, backend=args.backend,
-                    storage=args.storage, fuse=fuse, reps=2, tile=tile,
-                    interior_split=args.isplit,
-                )
-                row.update(tile=f"{tile[0]}x{tile[1]}")
-                if args.isplit:
-                    row.update(isplit=True)
-                results.append(row)
-                print(json.dumps(row), flush=True)
-            except Exception as e:
-                print(json.dumps({"tile": f"{tile[0]}x{tile[1]}",
-                                  "fuse": fuse, "error": repr(e)[:150]}),
-                      flush=True)
+
+    candidates = search.enumerate_candidates(
+        w, backends=[args.backend], fuses=fuses, tiles=tiles)
+    # A requested point the legality filter dropped must leave a row —
+    # the pre-round-9 tool benched it and recorded the compile error;
+    # silently incomplete evidence is worse than either.
+    legal_tiles = {c.tile for c in candidates}
+    legal_fuses = {c.fuse for c in candidates}
+    for t in (tiles or []):
+        if tuple(t) not in legal_tiles:
+            print(json.dumps({"tile": f"{t[0]}x{t[1]}", "error":
+                              "dropped: fails (sublane,128) alignment, "
+                              "scoped-VMEM budget, or block-size legality"}),
+                  flush=True)
+    for f in (fuses or []):
+        if f not in legal_fuses:
+            print(json.dumps({"fuse": f, "error":
+                              "dropped: fails block>=r*T (or the tiled-"
+                              "RDMA r*T<=sublane bound)"}), flush=True)
+    results = []
+    # tile/fuse thread through as explicit static jit arguments inside
+    # search.measure -> bench_iterate — monkeypatching module defaults
+    # does NOT reach already-traced kernels.
+    for _, c in search.rank(w, candidates):
+        try:
+            row = search.measure(w, c, mesh, iters=args.iters, reps=2,
+                                 interior_split=args.isplit)
+            if args.isplit:
+                row.update(isplit=True)
+            results.append(row)
+            print(json.dumps(row), flush=True)
+        except Exception as e:  # noqa: BLE001 — an illegal point is data
+            print(json.dumps({
+                "tile": f"{c.tile[0]}x{c.tile[1]}" if c.tile else None,
+                "fuse": c.fuse, "error": repr(e)[:150]}), flush=True)
 
     if results:
         best = max(results, key=lambda r: r["gpixels_per_s_per_chip"])
