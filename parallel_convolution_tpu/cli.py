@@ -39,7 +39,12 @@ def _add_perf_args(p: argparse.ArgumentParser) -> None:
 
     p.add_argument("--filter", default="blur3", dest="filter_name")
     p.add_argument("--mesh", default=None,
-                   help="RxC grid, e.g. 2x4 (default: all devices)")
+                   help="RxC grid, e.g. 2x4 (default: $PCTPU_MESH if set "
+                        "— the supervisor's reshape env — else all "
+                        "devices).  A --checkpoint resume accepts a "
+                        "DIFFERENT grid than the one that wrote the "
+                        "snapshot: shards reshard transparently, bytes "
+                        "are unchanged (elastic recovery)")
     p.add_argument("--backend", default=None, choices=list(BACKEND_CHOICES),
                    help="correlate implementation (default: shifted, the "
                         "normative XLA path).  'auto' resolves backend — "
@@ -132,6 +137,9 @@ def _resolve_perf_knobs(args, mesh) -> None:
 def _mesh_from_flag(spec: str | None):
     from parallel_convolution_tpu.parallel.mesh import mesh_from_spec
 
+    # An unset --mesh falls back to the supervisor's reshape env
+    # (PCTPU_MESH) inside mesh_from_spec — every entry point that routes
+    # here inherits elastic re-gridding for free.
     return mesh_from_spec(spec)
 
 

@@ -49,6 +49,15 @@ class JacobiSolver:
         if self.mesh is None:
             self.mesh = make_grid_mesh()
 
+    def set_mesh(self, mesh) -> "JacobiSolver":
+        """Swap the device mesh (elastic recovery) — same contract as
+        ``ConvolutionModel.set_mesh``: solver config and compiled state
+        for other meshes are untouched, results are mesh-invariant."""
+        from parallel_convolution_tpu.parallel.mesh import mesh_from_spec
+
+        self.mesh = mesh_from_spec(mesh) if isinstance(mesh, str) else mesh
+        return self
+
     def solve(self, x) -> tuple[np.ndarray, int]:
         """(C, H, W) f32 field → (smoothed field, iterations run)."""
         out, iters = step_lib.sharded_converge(

@@ -77,6 +77,25 @@ class ConvolutionModel:
         self.effective_backend: str | None = None
         self.plan_source: str = "explicit"
 
+    def set_mesh(self, mesh) -> "ConvolutionModel":
+        """Swap the device mesh mid-object (elastic recovery).
+
+        ``mesh`` is a Mesh or an ``"RxC"`` spec string.  Only mesh-scoped
+        state resets (the recorded effective backend / plan provenance —
+        both are per-mesh verdicts); everything else, including compiled
+        runners for OTHER meshes, is untouched: ``parallel.step``'s build
+        caches and ``resilience.degrade``'s probe cache both key on the
+        mesh, so swapping back later reuses the old executables with zero
+        re-tracing.  Output bytes are mesh-invariant by the framework's
+        core contract, so a swap never changes results — only topology.
+        """
+        from parallel_convolution_tpu.parallel.mesh import mesh_from_spec
+
+        self.mesh = mesh_from_spec(mesh) if isinstance(mesh, str) else mesh
+        self.effective_backend = None
+        self.plan_source = "explicit"
+        return self
+
     def _resolved_knobs(self, hw: tuple[int, int],
                         channels: int = 1) -> tuple[str, int, object]:
         """Resolve for the REAL (H, W) workload: the probe must compile

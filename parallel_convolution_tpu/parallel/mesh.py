@@ -62,9 +62,18 @@ def make_grid_mesh(
 
 def mesh_from_spec(spec: str | None) -> Mesh:
     """Build the mesh a CLI ``--mesh`` flag names: ``"RxC"`` takes the
-    first R*C devices; None/empty means all devices near-square.  The ONE
-    parser for this grammar (cli.py, scripts/serve.py, scripts/loadgen.py
-    all route here, so the entry points cannot drift)."""
+    first R*C devices; None/empty falls back to the supervisor's reshape
+    env (``PCTPU_MESH``, resilience.elastic) and then to all devices
+    near-square.  The ONE parser for this grammar (cli.py,
+    scripts/serve.py, scripts/loadgen.py all route here, so the entry
+    points cannot drift — and a reshape-aware supervised leg can re-grid
+    ANY of them through the env without argv edits)."""
+    if not spec:
+        import os
+
+        from parallel_convolution_tpu.resilience import elastic
+
+        spec = os.environ.get(elastic.MESH_ENV)
     if not spec:
         return make_grid_mesh()
     try:

@@ -27,6 +27,7 @@ from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -47,7 +48,7 @@ from parallel_convolution_tpu.utils.config import (  # canonical registries
 from parallel_convolution_tpu.utils.jax_compat import shard_map
 
 __all__ = ["BACKENDS", "STORAGE_DTYPES", "sharded_iterate", "sharded_converge",
-           "iterate_prepared"]
+           "iterate_prepared", "reshard_prepared"]
 
 
 def _valid_mask(valid_hw, block_hw, margin: int = 0):
@@ -434,6 +435,34 @@ def _prepare(x, mesh: Mesh, r: int, storage: str = "f32"):
     return x, (H, W), (Hp // R, Wp // Cc)
 
 
+def reshard_prepared(xs, valid_hw, mesh: Mesh):
+    """Move an already-prepared padded (C, Hp, Wp) array onto a DIFFERENT
+    mesh: crop to the valid extent, re-pad to the new grid's block
+    multiples, and re-shard (elastic recovery's in-memory counterpart of
+    the checkpoint reshard — e.g. a serving engine shrinking mid-process
+    without a disk round-trip).
+
+    Bit-exact by the masking invariant: positions outside ``valid_hw``
+    are zero on every grid, so crop + zero-re-pad reproduces exactly the
+    state ``_prepare`` would have built on ``mesh`` from the valid
+    pixels.  Compiled state for other meshes is untouched — the build
+    caches key on the mesh, so swapping BACK later reuses the old
+    executables.
+
+    Materializes ONE host copy of the cropped state (a few MB at serving
+    sizes); huge-image states should reshard through the checkpoint path
+    instead (``utils.checkpoint.load_state``), which streams per-shard
+    files and never holds the full image on one host.
+    """
+    H, W = (int(v) for v in valid_hw)
+    R, Cc = grid_shape(mesh)
+    Hp, Wp = padded_extent(H, R), padded_extent(W, Cc)
+    x = xs[:, :H, :W]
+    if (Hp, Wp) != (H, W):
+        x = jnp.pad(x, ((0, 0), (0, Hp - H), (0, Wp - W)))
+    return jax.device_put(np.asarray(x), block_sharding(mesh))
+
+
 def _norm_tile(tile) -> tuple[int, int] | None:
     """Normalize a (TH, TW) kernel-tile override to a hashable tuple."""
     if tile is None:
@@ -453,7 +482,7 @@ def _storage_name(dtype) -> str:
 
 
 def _resolve_auto(mesh, filt, backend, fuse, tile, storage, quantize,
-                  boundary, valid_hw, channels):
+                  boundary, valid_hw, channels, check_every=None):
     """``backend='auto'`` -> concrete ``(backend, fuse, tile, source)``.
 
     Resolution goes through the tuning subsystem (plan cache if a
@@ -462,6 +491,11 @@ def _resolve_auto(mesh, filt, backend, fuse, tile, storage, quantize,
     fallback probe then guards the resolved launch exactly as it guards
     an explicitly-named one.  Explicit backends pass through untouched
     (``fuse=None`` then just normalizes to 1, the historical default).
+
+    ``check_every`` (the convergence path only) is part of the tuning
+    identity: it bounds the legal fusion depth (a chunk fuses at most
+    its n-1 pre-pair iterations) and keys the plan cache, so a tuned
+    convergence run resolves its own plan rather than a fixed-count one.
     """
     if backend != AUTO:
         return backend, (1 if fuse is None else int(fuse)), tile, None
@@ -470,7 +504,7 @@ def _resolve_auto(mesh, filt, backend, fuse, tile, storage, quantize,
     res = tuning.resolve(
         mesh, filt, (channels, valid_hw[0], valid_hw[1]), storage=storage,
         quantize=quantize, boundary=boundary, fuse=fuse,
-        tile=_norm_tile(tile))
+        tile=_norm_tile(tile), check_every=check_every)
     return res.backend, res.fuse, res.tile, res.source
 
 
@@ -602,7 +636,7 @@ def sharded_converge(x, filt: Filter, tol: float, max_iters: int,
     xs, valid_hw, block_hw = _prepare(x, mesh, filt.radius, storage)
     backend, fuse, tile, _ = _resolve_auto(
         mesh, filt, backend, fuse, tile, storage, quantize, boundary,
-        tuple(valid_hw), xs.shape[0])
+        tuple(valid_hw), xs.shape[0], check_every=int(check_every))
     if fallback:
         backend = _resolve_fallback(mesh, filt, backend, quantize, fuse,
                                     boundary, _norm_tile(tile),

@@ -22,13 +22,21 @@ outage modes into a first-class, deterministic, replayable mechanism:
   the requested tier (the effective backend is stamped into bench rows).
 * :mod:`~parallel_convolution_tpu.resilience.supervisor` — the leg-queue
   runner behind ``scripts/run_supervised.py``: per-leg completion
-  predicates, terminal-failure sentinel file, JSON status ledger.
+  predicates, terminal-failure sentinel file, JSON status ledger, and
+  (round 10) reshape-aware legs that walk a mesh ladder when an attempt
+  dies with a device-loss signature.
+* :mod:`~parallel_convolution_tpu.resilience.elastic` — elastic mesh
+  recovery: device-set change detection (child-process health probe),
+  the shrink ladder, and new-mesh construction — the glue between
+  grid-agnostic checkpoints, the supervisor's reshape legs, and the
+  serving engine's mid-process ``reshape()``.
 
 Everything here except ``degrade``'s probe is jax-free and import-light,
 so hooks can live in modules (``utils.platform``) that must parse
 ``--help`` without paying backend startup.
 """
 
+from parallel_convolution_tpu.resilience import elastic  # noqa: F401
 from parallel_convolution_tpu.resilience.faults import (  # noqa: F401
     InjectedFault,
     KNOWN_SITES,
@@ -47,7 +55,7 @@ from parallel_convolution_tpu.resilience.retry import (  # noqa: F401
 )
 
 __all__ = [
-    "InjectedFault", "KNOWN_SITES", "fault_point", "injected",
+    "InjectedFault", "KNOWN_SITES", "elastic", "fault_point", "injected",
     "install_plan", "plan_from_env", "plan_from_spec", "uninstall_plan",
     "RetryExhausted", "RetryPolicy", "classify", "with_retry",
 ]

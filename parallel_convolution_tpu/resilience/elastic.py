@@ -1,0 +1,145 @@
+"""Elastic mesh recovery: survive a change in the device set.
+
+The reference's ``MPI_Cart_create`` grid is a death pact — lose one rank
+and the communicator, the decomposition, and every buffer keyed on it
+are gone.  Round 7 could already heal onto a slower *backend*; this
+module heals onto a smaller (or different) *grid*: detect that the
+device set changed, propose a new mesh spec that fits what is alive,
+and let every layer re-bind —
+
+* checkpoints reshard onto the new grid (``utils.checkpoint``:
+  grid-shape-agnostic ``load_state``),
+* the supervisor walks a leg's mesh ladder on a device-loss signature
+  (``resilience.supervisor``: ``mesh_env``/``meshes``/``reshape_pattern``),
+* the serving engine drains, invalidates, and re-warms its executable
+  cache mid-process (``serving.engine.WarmEngine.reshape``).
+
+"Persistent and Partitioned MPI for Stencil Communication" (PAPERS.md)
+shows halo pipelines re-binding to changed communicator layouts cheaply;
+here the re-bind is a fresh ``shard_map`` compile for the new grid while
+everything keyed on other meshes stays warm (``parallel.step``'s build
+caches key on the mesh object).
+
+jax-free and import-light: device probing happens in a child process
+(``utils.platform.probe_device_count``), so the supervisor can consult
+health without initializing a backend in its own process.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# The env var reshape-aware legs read their mesh spec from (the
+# supervisor writes it per attempt; entry points parse it with
+# ``mesh_from_spec``).  One name, so drills and legs cannot drift.
+MESH_ENV = "PCTPU_MESH"
+
+
+def parse_spec(spec: str) -> tuple[int, int]:
+    """``"RxC"`` -> (R, C); the grammar of ``mesh.mesh_from_spec``."""
+    try:
+        r, c = (int(v) for v in spec.lower().split("x"))
+    except ValueError as e:
+        raise ValueError(f"mesh spec must be RxC, got {spec!r}") from e
+    if r < 1 or c < 1:
+        raise ValueError(f"mesh spec must be positive, got {spec!r}")
+    return r, c
+
+
+def format_spec(grid: tuple[int, int]) -> str:
+    return f"{grid[0]}x{grid[1]}"
+
+
+def grid_ladder(start: tuple[int, int]) -> list[str]:
+    """The shrink ladder from ``start`` down to 1x1, halving the larger
+    axis each step — e.g. (2, 4) -> ["2x4", "2x2", "2x1", "1x1"].
+
+    Each rung needs at most half the previous rung's devices, so ANY
+    shrink of the device set lands on some rung; the ladder is what
+    reshape-aware supervisor legs and the soak drill walk.
+    """
+    out = [format_spec(start)]
+    r, c = start
+    while (r, c) != (1, 1):
+        if c >= r:
+            c = max(1, c // 2)
+        else:
+            r = max(1, r // 2)
+        out.append(format_spec((r, c)))
+    return out
+
+
+def next_fit(specs: list[str], start: int, live: int | None) -> int:
+    """The index of the next spec in ``specs[start:]`` that fits ``live``
+    devices (first one when ``live`` is None — health unknown, just step
+    down one rung).  Falls back to the last (smallest) spec when nothing
+    fits; clamps into range so callers can pass ``idx + 1`` blindly.
+    """
+    if not specs:
+        return 0
+    start = min(max(0, start), len(specs) - 1)
+    if live is None:
+        return start
+    for i in range(start, len(specs)):
+        r, c = parse_spec(specs[i])
+        if r * c <= max(1, live):
+            return i
+    return len(specs) - 1
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshChange:
+    """A detected change in the usable device set."""
+
+    old_grid: tuple[int, int]
+    live: int                      # devices the probe can see now
+    new_spec: str | None           # proposed RxC that fits, None = none fits
+
+    @property
+    def lost(self) -> int:
+        return self.old_grid[0] * self.old_grid[1] - self.live
+
+
+def detect_change(mesh, timeout: float = 60.0) -> MeshChange | None:
+    """Probe device health; None when the mesh's devices all still fit.
+
+    A shrink proposes the first rung of :func:`grid_ladder` that fits
+    the live count (near-square is NOT forced: keeping the aspect of the
+    original decomposition keeps block shapes — and any tuned plans for
+    them — closer to the original run's).  A probe failure (None count)
+    also returns None: "health unknown" must not trigger a reshape.
+    """
+    from parallel_convolution_tpu.parallel.mesh import grid_shape
+    from parallel_convolution_tpu.utils.platform import probe_device_count
+
+    grid = grid_shape(mesh)
+    n = grid[0] * grid[1]
+    live = probe_device_count(timeout=timeout)
+    if live is None or live >= n:
+        return None
+    ladder = grid_ladder(grid)
+    idx = next_fit(ladder, 1, live)
+    spec = ladder[idx]
+    r, c = parse_spec(spec)
+    return MeshChange(old_grid=grid, live=live,
+                      new_spec=spec if r * c <= live else None)
+
+
+def reshape_mesh(spec_or_grid, devices=None):
+    """Build the post-change mesh: ``"RxC"`` (or a grid tuple) over the
+    first R*C live devices.  The elastic counterpart of
+    ``mesh.mesh_from_spec`` that also accepts an explicit device list
+    (e.g. the survivors after filtering a dead chip out)."""
+    import jax
+
+    from parallel_convolution_tpu.parallel.mesh import make_grid_mesh
+
+    grid = (parse_spec(spec_or_grid) if isinstance(spec_or_grid, str)
+            else (int(spec_or_grid[0]), int(spec_or_grid[1])))
+    devices = list(devices) if devices is not None else jax.devices()
+    n = grid[0] * grid[1]
+    if len(devices) < n:
+        raise ValueError(
+            f"mesh {format_spec(grid)} needs {n} devices, "
+            f"only {len(devices)} available")
+    return make_grid_mesh(devices[:n], grid)

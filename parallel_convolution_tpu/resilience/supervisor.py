@@ -51,6 +51,16 @@ class Leg:
     terminal_pattern: str | None = None
     timeout: float | None = None
     env: dict | None = None
+    # Reshape-aware legs (elastic recovery): ``meshes`` is the mesh-spec
+    # ladder to walk (e.g. ["2x4", "2x2", "1x2", "1x1"]), ``mesh_env``
+    # the env var the current rung is exported through (default
+    # elastic.MESH_ENV), and ``reshape_pattern`` the regex that marks an
+    # attempt as "a device died" — matching output advances the ladder
+    # (to the first rung that fits the probed live-device count) instead
+    # of retrying the same doomed grid.
+    meshes: list | None = None
+    mesh_env: str | None = None
+    reshape_pattern: str | None = None
 
     @classmethod
     def from_dict(cls, d: dict) -> "Leg":
@@ -60,6 +70,14 @@ class Leg:
         leg = cls(**d)
         if not leg.name or not leg.cmd:
             raise ValueError("leg needs a name and a non-empty cmd")
+        if leg.reshape_pattern and not leg.meshes:
+            raise ValueError(
+                f"leg {leg.name!r}: reshape_pattern needs a meshes ladder")
+        if leg.meshes:
+            from parallel_convolution_tpu.resilience import elastic
+
+            for spec in leg.meshes:
+                elastic.parse_spec(str(spec))  # loud on a typo'd rung
         return leg
 
     def is_complete(self) -> bool:
@@ -114,13 +132,16 @@ class Supervisor:
             leg.name, {"state": "pending", "attempts": 0})
 
     # -- execution ---------------------------------------------------------
-    def _attempt(self, leg: Leg) -> tuple[int | None, str]:
+    def _attempt(self, leg: Leg,
+                 extra_env: dict | None = None) -> tuple[int | None, str]:
         """One subprocess attempt; returns (rc or None on timeout, text)."""
         out = self.state_dir / f"{leg.name}.out"
         err = self.state_dir / f"{leg.name}.err"
         env = dict(os.environ)
         if leg.env:
             env.update({k: str(v) for k, v in leg.env.items()})
+        if extra_env:
+            env.update({k: str(v) for k, v in extra_env.items()})
         try:
             with open(out, "wb") as fo, open(err, "wb") as fe:
                 p = subprocess.run(leg.cmd, stdout=fo, stderr=fe,
@@ -138,6 +159,23 @@ class Supervisor:
             except OSError:
                 pass
         return rc, text
+
+    def _next_mesh_idx(self, leg: Leg, idx: int) -> int:
+        """The ladder rung after ``idx`` that fits current device health
+        (elastic.next_fit).  The probe runs in a child process and is
+        best-effort: any failure means "health unknown" — step one rung."""
+        from parallel_convolution_tpu.resilience import elastic
+
+        live = None
+        try:
+            from parallel_convolution_tpu.utils.platform import (
+                probe_device_count,
+            )
+
+            live = probe_device_count(timeout=30.0)
+        except Exception:  # noqa: BLE001 — a broken probe must not halt
+            live = None
+        return elastic.next_fit([str(s) for s in leg.meshes], idx + 1, live)
 
     def _halt(self, leg: Leg, reason: str) -> None:
         self._status["halt"] = {"leg": leg.name, "reason": reason}
@@ -171,11 +209,20 @@ class Supervisor:
             # One RNG drawn exactly like RetryPolicy.delays()/with_retry:
             # the same policy must produce the same schedule everywhere.
             rng = random.Random(self.policy.seed)
+            mesh_idx = 0
             for attempt in range(1, self.policy.max_attempts + 1):
                 st["state"] = "running"
                 st["attempts"] = attempt
+                extra_env = None
+                if leg.meshes:
+                    from parallel_convolution_tpu.resilience import elastic
+
+                    spec = str(leg.meshes[min(mesh_idx,
+                                              len(leg.meshes) - 1)])
+                    extra_env = {leg.mesh_env or elastic.MESH_ENV: spec}
+                    st["mesh"] = spec
                 self._write_ledger()
-                rc, text = self._attempt(leg)
+                rc, text = self._attempt(leg, extra_env)
                 st["last_rc"] = rc
                 if leg.terminal_pattern and re.search(leg.terminal_pattern,
                                                       text):
@@ -196,6 +243,19 @@ class Supervisor:
                     break
                 st["last_error"] = ("timeout" if rc is None
                                     else f"rc={rc}, incomplete")
+                if (leg.reshape_pattern and leg.meshes
+                        and mesh_idx < len(leg.meshes) - 1
+                        and re.search(leg.reshape_pattern, text)):
+                    # Device-loss signature: retrying the same grid is
+                    # doomed — advance the ladder to the first rung that
+                    # fits the probed live-device count (health-unknown
+                    # probes just step down one rung).
+                    mesh_idx = self._next_mesh_idx(leg, mesh_idx)
+                    st["reshapes"] = st.get("reshapes", 0) + 1
+                    self._log(
+                        f"supervisor: leg {leg.name!r} hit device-loss "
+                        f"pattern; reshaping onto "
+                        f"{leg.meshes[mesh_idx]}")
                 self._write_ledger()
                 if attempt < self.policy.max_attempts:
                     d = self.policy.delay(attempt, rng)

@@ -162,6 +162,59 @@ class WarmEngine:
         self.stats = {
             "hits": 0, "misses": 0, "compiles": 0, "evictions": 0,
             "single_flight_waits": 0, "batches": 0, "images": 0,
+            "reshapes": 0,
+        }
+
+    def grid(self) -> tuple[int, int]:
+        from parallel_convolution_tpu.parallel.mesh import grid_shape
+
+        return grid_shape(self.mesh)
+
+    # -- elastic recovery ---------------------------------------------------
+    def reshape(self, mesh) -> dict:
+        """Re-bind the engine onto a different mesh MID-PROCESS.
+
+        The serve-through-shrink leg: drop every warm entry (they were
+        compiled for the old grid), swap the mesh, and re-warm the
+        previously-resident keys on the new grid — so the first request
+        after a shrink hits a warm executable, not a cold compile.  A key
+        whose image cannot fit the new grid (block < radius*fuse) is
+        SKIPPED with a warning, never fatal: serve-through-shrink must
+        not die because one tiny config has no home on the smaller mesh.
+
+        The caller must quiesce execution first — the service drains its
+        batcher before calling this (``ConvolutionService.reshape``), so
+        no in-flight ``run_batch`` can straddle the swap; a stale-grid
+        key reaching :meth:`run_batch` afterwards raises (terminal), it
+        can never silently run on the wrong decomposition.
+        """
+        import warnings
+
+        from parallel_convolution_tpu.parallel.mesh import grid_shape
+
+        new_grid = grid_shape(mesh)
+        with self._lock:
+            old_grid = self.grid()
+            old_keys = list(self._entries)
+            self._entries.clear()
+            self._plan_sources.clear()
+            self._inflight.clear()
+            self.mesh = mesh
+            self.stats["reshapes"] += 1
+        rewarmed, skipped = [], []
+        for k in old_keys:
+            nk = dataclasses.replace(k, grid=new_grid)
+            try:
+                self.entry(nk)
+                rewarmed.append(nk)
+            except Exception as e:  # noqa: BLE001 — per-key, never fatal
+                skipped.append((nk, repr(e)[:200]))
+                warnings.warn(
+                    f"reshape: key {k.filter_name}/{k.shape} has no home "
+                    f"on grid {new_grid}: {e}", stacklevel=2)
+        return {
+            "old_grid": old_grid, "grid": new_grid,
+            "rewarmed": len(rewarmed), "skipped": len(skipped),
         }
 
     # -- key construction ---------------------------------------------------
@@ -387,6 +440,13 @@ class WarmEngine:
         if (C, H, W) != key.shape:
             raise ValueError(
                 f"batch shape {(C, H, W)} does not match key {key.shape}")
+        if key.grid != self.grid():
+            # A key compiled for a pre-reshape grid must never execute on
+            # the new decomposition (the service re-keys requests after
+            # its drain, so this only fires on a caller bug).
+            raise ValueError(
+                f"stale key grid {key.grid}: engine mesh is now "
+                f"{self.grid()} (resharded mid-process)")
         with t.phase("compile"):
             entry = self.entry(key)
             fn = entry.fns.get(B) or self._compile_batch(entry, B)
@@ -409,6 +469,7 @@ class WarmEngine:
             self.stats["images"] += B
         info = {
             "effective_backend": entry.effective_backend,
+            "effective_grid": f"{key.grid[0]}x{key.grid[1]}",
             "plan_source": entry.plan_source,
             "predicted_gpx_per_chip": entry.predicted_gpx,
             "batch_size": B,
@@ -425,6 +486,7 @@ class WarmEngine:
             return {
                 "stats": dict(self.stats),
                 "capacity": self.capacity,
+                "grid": "x".join(str(v) for v in self.grid()),
                 "resident": [
                     {"filter": k.filter_name, "shape": list(k.shape),
                      "backend": k.backend,

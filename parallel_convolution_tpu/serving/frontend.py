@@ -17,11 +17,13 @@ Wire format (POST ``/v1/convolve``)::
      "boundary": "zero", "quantize": true, "deadline_ms": 500}
 
     200 -> {"ok": true, "image_b64": ..., "effective_backend": ...,
-            "backend": ..., "request_id": ..., "batch_size": ...,
+            "effective_grid": "RxC", "backend": ..., "request_id": ...,
+            "batch_size": ...,
             "phases": {"queue": s, "compile": s, "device": s,
                        "copy_in": s, "copy_out": s, "total": s}}
     400 -> {"ok": false, "rejected": "invalid",    "detail": ...}
-    429 -> {"ok": false, "rejected": "queue_full"|"deadline"|"error", ...}
+    429 -> {"ok": false,
+            "rejected": "queue_full"|"deadline"|"error"|"resharding", ...}
 
 ``GET /healthz`` returns ``{"ok": true}`` plus the service snapshot;
 ``GET /stats`` returns the snapshot alone.  Rejections map to HTTP 429
@@ -43,7 +45,7 @@ __all__ = ["InProcessClient", "decode_request", "encode_response",
            "make_http_server"]
 
 _REJECT_STATUS = {"invalid": 400, "queue_full": 429, "deadline": 429,
-                  "error": 429, "timeout": 504}
+                  "error": 429, "resharding": 429, "timeout": 504}
 
 
 def decode_request(body: dict) -> Request:
@@ -100,6 +102,7 @@ def encode_response(result) -> tuple[int, dict]:
         "image_b64": base64.b64encode(
             np.ascontiguousarray(result.image).tobytes()).decode("ascii"),
         "effective_backend": result.effective_backend,
+        "effective_grid": result.effective_grid,
         "backend": result.backend,
         "plan_source": result.plan_source,
         "predicted_gpx_per_chip": result.predicted_gpx_per_chip,

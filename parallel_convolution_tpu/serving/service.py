@@ -74,6 +74,9 @@ class Response:
     #                                  predicted (auto-resolution origin)
     predicted_gpx_per_chip: float | None = None  # cost-model figure for
     #                                  the served config (vs measured)
+    effective_grid: str = ""         # "RxC" mesh grid that produced the
+    #                                  bytes (changes after an elastic
+    #                                  reshape mid-process)
 
     ok = True
 
@@ -82,7 +85,7 @@ class Response:
 class Rejected:
     """A typed non-result: load shed, deadline miss, or failed execution."""
 
-    reason: str                      # queue_full | deadline | invalid | error
+    reason: str   # queue_full | deadline | invalid | error | resharding
     request_id: str
     detail: str = ""
 
@@ -115,11 +118,14 @@ class ConvolutionService:
             max_delay_s=max_delay_s, max_queue=max_queue, start=start)
         self._ids = itertools.count(1)
         self._lock = threading.Lock()
+        self._reshape_lock = threading.Lock()
+        self._reshaping = False
         self.stats = {
             "submitted": 0, "completed": 0, "retries": 0,
             "rejected_queue_full": 0, "rejected_deadline": 0,
             "rejected_invalid": 0, "rejected_error": 0,
-            "client_timeouts": 0,
+            "rejected_resharding": 0, "client_timeouts": 0,
+            "reshapes": 0,
         }
 
     # -- admission -----------------------------------------------------------
@@ -173,6 +179,12 @@ class ConvolutionService:
         """
         rid = req.request_id or f"r{next(self._ids)}"
         self._bump("submitted")
+        if self._reshaping:
+            # The mesh is being swapped under us: shed with a typed,
+            # retryable reason (the window is one drain + re-warm long).
+            self._bump("rejected_resharding")
+            return Rejected("resharding", rid,
+                            detail="mesh reshape in progress; retry")
         try:
             key, plan_source, planar = self._validate(req)
         except Exception as e:  # noqa: BLE001 — contract errors are typed
@@ -217,6 +229,18 @@ class ConvolutionService:
                 live.append(it)
         if not live:
             return
+        if key.grid != self.engine.grid():
+            # The submit-vs-reshape race: a request that passed the
+            # _reshaping check keyed against the old grid, then landed on
+            # the post-swap batcher.  Shed it typed-and-retryable — the
+            # stale-grid ValueError in run_batch must stay a caller-bug
+            # backstop, never a client-visible "error".
+            self._bump("rejected_resharding", len(live))
+            for it in live:
+                it.slot.set(Rejected(
+                    "resharding", it.payload["rid"],
+                    detail="mesh resharded while queued; retry"))
+            return
         stacked = np.stack([it.payload["planar"] for it in live])
         timer = PhaseTimer()
 
@@ -259,8 +283,60 @@ class ConvolutionService:
                 plan_source=it.payload.get(
                     "plan_source", info.get("plan_source", "explicit")),
                 predicted_gpx_per_chip=info.get("predicted_gpx_per_chip"),
+                effective_grid=info.get("effective_grid", ""),
             ))
             self._bump("completed")
+
+    # -- elastic recovery ----------------------------------------------------
+    def reshape(self, mesh) -> dict:
+        """Shrink (or otherwise re-grid) the serving mesh WITHOUT a
+        process restart — the serve-through-shrink leg of elastic
+        recovery.  ``mesh`` is a Mesh or an ``"RxC"`` spec string.
+
+        Sequence, in order (each step's invariant):
+
+        1. flag ``resharding`` — new submissions shed with a typed,
+           retryable ``Rejected("resharding")`` (never an error, never a
+           hang);
+        2. drain the batcher — every in-flight/queued request completes
+           on the OLD grid (its response stamps the old
+           ``effective_grid``), and the single worker thread exits, so
+           no execution can straddle the swap;
+        3. ``engine.reshape`` — warm entries drop, the mesh swaps, the
+           previously-resident keys re-warm on the new grid;
+        4. a fresh batcher starts and admission reopens.
+
+        Requests admitted afterwards re-key against the new mesh in
+        ``_validate`` (``engine.resolve_key`` reads the live grid), so
+        their responses stamp the new ``effective_grid``.
+        """
+        from parallel_convolution_tpu.parallel.mesh import (
+            grid_shape, mesh_from_spec,
+        )
+
+        if isinstance(mesh, str):
+            mesh = mesh_from_spec(mesh)
+        grid_shape(mesh)  # malformed mesh dies HERE, before any teardown
+        with self._reshape_lock:
+            self._reshaping = True
+            try:
+                old = self.batcher
+                old.close(drain=True)
+                try:
+                    info = self.engine.reshape(mesh)
+                finally:
+                    # Admission must reopen even if the engine swap blew
+                    # up (per-key re-warm failures are absorbed inside
+                    # reshape; anything else must not wedge the service
+                    # behind a closed batcher forever).
+                    self.batcher = MicroBatcher(
+                        self._execute_batch, max_batch=old.max_batch,
+                        max_delay_s=old.max_delay_s,
+                        max_queue=old.max_queue, start=True)
+                self._bump("reshapes")
+            finally:
+                self._reshaping = False
+        return info
 
     # -- lifecycle / introspection -------------------------------------------
     def warmup(self, configs, plan_file: str | None = None) -> list[str]:

@@ -93,7 +93,8 @@ def _legal_plan_knobs(w: Workload, plan: Plan) -> tuple[int, object]:
 def resolve(mesh, filt, shape, *, storage: str = "f32",
             quantize: bool = True, boundary: str = "zero",
             fuse: int | None = None, tile: tuple[int, int] | None = None,
-            plans: PlanCache | None = None) -> Resolution:
+            plans: PlanCache | None = None,
+            check_every: int | None = None) -> Resolution:
     """Resolve ``backend="auto"`` (and unset fuse/tile) for one workload.
 
     ``fuse``/``tile`` passed non-None are pins: the plan/model fills
@@ -104,12 +105,25 @@ def resolve(mesh, filt, shape, *, storage: str = "f32",
     the ambient cache (``PCTPU_PLAN_FILE``); pass an explicit
     :class:`PlanCache` (e.g. the serving engine's) to override.
 
+    ``check_every`` marks a convergence-path workload: it joins the plan
+    key (a convergence tune never drives the fixed-count program, and
+    vice versa) and bounds the legal fusion depth to ``check_every - 1``
+    (the chunk's final iteration is always unfused — it forms the
+    (prev, cur) convergence pair).
+
     Deterministic by construction: the candidate space, the model, and
     every tie-break are pure functions of the workload — two processes
     on the same platform resolve identically (pinned in tier-1).
     """
+    if check_every is not None and fuse is not None:
+        # Mirror step._build_converge's clamp (a chunk fuses at most its
+        # n-1 pre-pair iterations) so a pinned fuse resolves to the depth
+        # the runner will actually execute, same surface as the no-plan
+        # path.
+        fuse = max(1, min(int(fuse), max(1, int(check_every) - 1)))
     w = Workload.from_mesh(mesh, filt, shape, storage=storage,
-                           quantize=quantize, boundary=boundary)
+                           quantize=quantize, boundary=boundary,
+                           check_every=check_every)
     cache = plans if plans is not None else default_cache()
     plan = cache.best_plan(w) if len(cache) else None
     if plan is not None and fuse is not None and not search._legal_fuses(

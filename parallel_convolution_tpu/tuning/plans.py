@@ -86,6 +86,11 @@ class Workload:
     storage: str = "f32"
     quantize: bool = True
     boundary: str = "zero"
+    # Convergence-path identity (None = the fixed-count path).  Part of
+    # the key because check_every bounds the legal fusion depth (a chunk
+    # fuses at most its n-1 pre-pair iterations) — a plan tuned for the
+    # fixed-count program must not silently drive the convergence one.
+    check_every: int | None = None
 
     @property
     def block_hw(self) -> tuple[int, int]:
@@ -96,6 +101,7 @@ class Workload:
     @classmethod
     def from_mesh(cls, mesh, filt, shape, *, storage: str = "f32",
                   quantize: bool = True, boundary: str = "zero",
+                  check_every: int | None = None,
                   ) -> "Workload":
         """Build the identity for ``shape`` (C, H, W) on ``mesh``."""
         from parallel_convolution_tpu.parallel.mesh import grid_shape
@@ -114,12 +120,18 @@ class Workload:
             storage=storage,
             quantize=bool(quantize),
             boundary=boundary,
+            check_every=None if check_every is None else int(check_every),
         )
 
     def key_fields(self) -> dict:
-        """The plan-key field dict (bucketed sizes, no derived values)."""
+        """The plan-key field dict (bucketed sizes, no derived values).
+
+        ``check_every`` appears only when set: fixed-count keys are
+        byte-identical to the pre-round-10 schema, so existing plan
+        files stay valid without a schema bump.
+        """
         C, H, W = self.shape
-        return {
+        fields = {
             "schema": PLAN_SCHEMA,
             "platform": self.platform,
             "device_kind": self.device_kind,
@@ -132,6 +144,9 @@ class Workload:
             "quantize": self.quantize,
             "boundary": self.boundary,
         }
+        if self.check_every is not None:
+            fields["check_every"] = int(self.check_every)
+        return fields
 
     def key(self) -> str:
         return canonical_key(self.key_fields())
@@ -183,6 +198,11 @@ class Plan:
 def _area_of_bucket(bucket_hw: str) -> float:
     h, w = (int(v) for v in bucket_hw.split("x"))
     return float(h) * float(w)
+
+
+def _ndev_of_grid(grid: str) -> float:
+    r, c = (int(v) for v in grid.split("x"))
+    return float(r) * float(c)
 
 
 class PlanCache:
@@ -274,32 +294,49 @@ class PlanCache:
         return self._plan_of(rec) if rec else None
 
     def best_plan(self, workload: Workload) -> Plan | None:
-        """The fallback ladder: exact -> nearest same-chip size bucket
-        (provenance rewritten to 'interpolated') -> None."""
+        """The fallback ladder: exact -> nearest same-chip size bucket ->
+        nearest same-chip GRID (elastic recovery: a resharded resume on
+        a shrunken mesh still resolves the run's tuned plan instead of
+        silently falling back to the cost model) -> None.  Every
+        non-exact hit's provenance is rewritten to 'interpolated', and
+        the resolver re-clamps interpolated knobs to the target grid's
+        legality (``tuning._legal_plan_knobs``).
+        """
         hit = self.exact(workload)
         if hit is not None:
             return hit
         want = workload.key_fields()
         want_area = _area_of_bucket(want["bucket_hw"])
-        best: tuple[float, str, dict] | None = None
+        want_ndev = _ndev_of_grid(want["grid"])
+        # rank: same-grid tier before cross-grid, then grid distance
+        # (|log2 device-count ratio|), bucket distance, key string —
+        # fully deterministic.
+        best: tuple[tuple, dict] | None = None
         for key, rec in self.records.items():
             have = rec.get("key_fields")
-            if not have:
+            # Field-set parity: a record carrying fields the workload
+            # lacks (e.g. a convergence plan's check_every against a
+            # fixed-count resolve) is a different identity, not a
+            # neighbor.
+            if not have or set(have) != set(want):
                 continue
-            if any(have.get(f) != want[f] for f in want
-                   if f != "bucket_hw"):
+            diff = {f for f in want if have.get(f) != want[f]}
+            if not diff <= {"bucket_hw", "grid"}:
                 continue
             try:
-                dist = abs(math.log2(_area_of_bucket(have["bucket_hw"]))
-                           - math.log2(want_area))
+                bucket_dist = abs(
+                    math.log2(_area_of_bucket(have["bucket_hw"]))
+                    - math.log2(want_area))
+                grid_dist = abs(math.log2(_ndev_of_grid(have["grid"]))
+                                - math.log2(want_ndev))
             except (KeyError, ValueError):
                 continue
-            # Deterministic: distance first, then key string.
-            if best is None or (dist, key) < (best[0], best[1]):
-                best = (dist, key, rec)
+            rank = ("grid" in diff, grid_dist, bucket_dist, key)
+            if best is None or rank < best[0]:
+                best = (rank, rec)
         if best is None:
             return None
-        plan = self._plan_of(best[2])
+        plan = self._plan_of(best[1])
         if plan is None:
             return None
         plan.source = "interpolated"

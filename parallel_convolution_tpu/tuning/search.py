@@ -82,10 +82,17 @@ def _legal_fuses(w: Workload, backend: str, menu,
     remeasured as fuse=1; the default menu falls back to the always-
     legal unfused depth."""
     bh, bw = w.block_hw
+    # Convergence workloads fuse at most their n-1 pre-pair iterations
+    # (step._build_converge clamps to check_every - 1); enumerating past
+    # that would tune a depth the runner can never execute.
+    ce = getattr(w, "check_every", None)
+    fuse_cap = None if ce is None else max(1, int(ce) - 1)
     out = []
     for T in menu:
         T = int(T)
         if T < 1 or w.radius * T > min(bh, bw):
+            continue
+        if fuse_cap is not None and T > fuse_cap:
             continue
         if backend == "pallas_rdma":
             if costmodel.rdma_is_tiled(w.shape, w.block_hw, w.radius, T,

@@ -24,6 +24,21 @@ that does validate instead of crashing or, worse, resuming from garbage.
 Injection sites ``checkpoint_write_shard`` / ``checkpoint_write_meta``
 (resilience.faults) let tests kill a save between any two writes and
 prove the resumed run byte-identical (tests/test_resilience.py).
+
+Elastic recovery (round 10): snapshots are **grid-shape-agnostic**.  A
+snapshot written on any ``(P, Q)`` mesh grid loads onto any ``(P', Q')``
+grid: per-shard blocks are sliced and reassembled through the same
+index maps that wrote them (``_coords`` / ``block_sharding``), the
+pad-to-multiple rim is re-derived for the target grid via
+``padded_extent``, and each target shard reads only the source shards it
+overlaps — gather-free, no host buffer ever holds the full image.  Grid
+therefore left the resume-compatibility config: losing a chip (or
+getting handed a smaller slice) no longer strands every snapshot.
+Validation failures are a **quarantine policy**: a corrupt shard marks
+that snapshot degraded — the :class:`CheckpointWarning` names the
+snapshot, the shard, and the cause (missing / truncated / checksum
+mismatch / unreadable / torn meta) — and ``fallback=True`` reshards
+around it from the newest snapshot that still validates.
 """
 
 from __future__ import annotations
@@ -39,8 +54,12 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-from parallel_convolution_tpu.parallel.mesh import block_sharding, grid_shape
-from parallel_convolution_tpu.resilience.faults import fault_point
+from parallel_convolution_tpu.parallel.mesh import (
+    block_sharding, grid_shape, padded_extent,
+)
+from parallel_convolution_tpu.resilience.faults import (
+    InjectedFault, fault_point,
+)
 
 META_NAME = "meta.json"
 LATEST_NAME = "LATEST"
@@ -48,11 +67,24 @@ KEEP_SNAPSHOTS = 2
 
 
 class CheckpointCorrupt(RuntimeError):
-    """A snapshot's meta exists but its shard set is incomplete/damaged."""
+    """A snapshot's meta exists but its shard set is incomplete/damaged.
+
+    ``snap`` names the snapshot directory; ``problems`` is the per-shard
+    diagnosis — ``(cause, shard_name)`` pairs with cause one of
+    ``missing | truncated | checksum | unreadable | torn-meta`` — so the
+    quarantine warnings can say exactly what was wrong, not just "torn".
+    """
+
+    def __init__(self, msg: str, snap: str = "",
+                 problems: tuple = ()):  # (cause, shard) pairs
+        super().__init__(msg)
+        self.snap = snap
+        self.problems = tuple(problems)
 
 
 class CheckpointWarning(UserWarning):
-    """A corrupt snapshot was skipped in favor of an older (or fresh) state."""
+    """A corrupt snapshot was quarantined (skipped in favor of an older or
+    fresh state), or a snapshot was resharded onto a different grid."""
 
 
 def _coords(index, block_hw) -> tuple[int, int]:
@@ -68,24 +100,51 @@ def _latest_snap(ckpt_dir) -> Path | None:
     p = Path(ckpt_dir) / LATEST_NAME
     if not p.exists():
         return None
-    snap = Path(ckpt_dir) / p.read_text().strip()
+    try:
+        snap = Path(ckpt_dir) / p.read_text().strip()
+    except OSError:  # pointer pruned/replaced mid-read by a sibling host
+        return None
     return snap if (snap / META_NAME).exists() else None
 
 
 def _candidate_snaps(ckpt_dir) -> list[Path]:
     """Snapshots to try, newest-claim first: the LATEST pointer's target,
-    then every other ``it_*`` dir with a meta, newest iteration first."""
+    then every other ``it_*`` dir with a meta, newest iteration first.
+
+    Robust against a concurrent writer/pruner: directory entries that
+    vanish between the listing and the existence check simply drop out
+    (the prune-vs-read race is benign by construction — a pruned
+    snapshot was never the newest)."""
     d = Path(ckpt_dir)
     first = _latest_snap(d)
     out = [first] if first is not None else []
-    if d.exists():
-        rest = sorted(
-            (p for p in d.iterdir() if p.is_dir()
-             and p.name.startswith("it_") and (p / META_NAME).exists()),
-            key=lambda p: p.name, reverse=True,
-        )
-        out += [p for p in rest if first is None or p.name != first.name]
+    rest: list[Path] = []
+    try:
+        for p in d.iterdir():
+            try:
+                if (p.is_dir() and p.name.startswith("it_")
+                        and (p / META_NAME).exists()):
+                    rest.append(p)
+            except OSError:
+                continue  # entry pruned mid-check
+    except OSError:
+        pass  # ckpt dir itself missing/unreadable: only LATEST's claim
+    rest.sort(key=lambda p: p.name, reverse=True)
+    out += [p for p in rest if first is None or p.name != first.name]
     return out
+
+
+def _read_meta(snap: Path) -> dict:
+    """Parse a snapshot's meta; unreadable/invalid JSON (a torn write or
+    a dir pruned mid-read) raises :class:`CheckpointCorrupt` with cause
+    ``torn-meta`` so the fallback walk can quarantine and continue."""
+    try:
+        return json.loads((snap / META_NAME).read_text())
+    except (OSError, ValueError) as e:
+        raise CheckpointCorrupt(
+            f"snapshot {snap.name} is torn: unreadable meta ({e})",
+            snap=snap.name, problems=(("torn-meta", META_NAME),),
+        ) from e
 
 
 def _expected_shards(meta: dict) -> list[str]:
@@ -100,37 +159,54 @@ def _validate_snapshot(snap: Path, meta: dict) -> None:
     Shards without a CRC record (a legacy snapshot, or — multi-host —
     shards another host wrote under its own meta) degrade to a header
     parse: presence + a readable ``.npy`` is the best that host can check.
+
+    Each shard's verdict carries a cause — ``missing`` / ``truncated`` /
+    ``checksum`` / ``unreadable`` — that the quarantine warnings surface
+    verbatim; an I/O failure mid-read (``io_read`` fault site) counts as
+    ``unreadable``, it quarantines the snapshot rather than killing the
+    recovery walk.
     """
-    problems = []
+    problems: list[tuple[str, str]] = []   # (cause, shard)
+    notes: list[str] = []
     recorded = meta.get("shards", {})
     for name in _expected_shards(meta):
         p = snap / name
         if not p.exists():
-            problems.append(f"missing {name}")
+            problems.append(("missing", name))
+            notes.append(f"missing {name}")
             continue
         rec = recorded.get(name)
         if rec is not None:
             # Stream the CRC in chunks: shards can be device-block-sized
             # (hundreds of MB at 65536² scale) — never a whole-file read.
             crc, n = 0, 0
-            with open(p, "rb") as f:
-                while chunk := f.read(1 << 20):
-                    crc = zlib.crc32(chunk, crc)
-                    n += len(chunk)
+            try:
+                fault_point("io_read")  # one consult per shard validation
+                with open(p, "rb") as f:
+                    while chunk := f.read(1 << 20):
+                        crc = zlib.crc32(chunk, crc)
+                        n += len(chunk)
+            except (OSError, InjectedFault) as e:
+                problems.append(("unreadable", name))
+                notes.append(f"unreadable {name} ({e})")
+                continue
             if n != rec["bytes"]:
-                problems.append(
-                    f"truncated {name} ({n} != {rec['bytes']} bytes)")
+                problems.append(("truncated", name))
+                notes.append(f"truncated {name} ({n} != {rec['bytes']} bytes)")
             elif crc != rec["crc32"]:
-                problems.append(f"checksum mismatch in {name}")
+                problems.append(("checksum", name))
+                notes.append(f"checksum mismatch in {name}")
         else:
             try:
                 np.load(p, mmap_mode="r")
             except Exception:
-                problems.append(f"unreadable {name} (no CRC recorded)")
+                problems.append(("unreadable", name))
+                notes.append(f"unreadable {name} (no CRC recorded)")
     if problems:
         raise CheckpointCorrupt(
-            f"snapshot {snap.name} is torn: {'; '.join(problems[:8])}"
-            + (f" (+{len(problems) - 8} more)" if len(problems) > 8 else "")
+            f"snapshot {snap.name} is torn: {'; '.join(notes[:8])}"
+            + (f" (+{len(notes) - 8} more)" if len(notes) > 8 else ""),
+            snap=snap.name, problems=problems,
         )
 
 
@@ -178,11 +254,19 @@ def save_state(ckpt_dir, arr: jax.Array, meta: dict) -> None:
     ptr_tmp.write_text(snap.name)
     os.replace(ptr_tmp, d / LATEST_NAME)
     # prune old snapshots (multi-host: every host holds its own shards, so
-    # each prunes the same dirs; missing-file races are ignored)
-    snaps = sorted(p for p in d.iterdir()
-                   if p.is_dir() and p.name.startswith("it_"))
+    # each prunes the same dirs; missing-file AND missing-dir races are
+    # ignored — a sibling host may have pruned the same dir already)
+    try:
+        snaps = sorted(p for p in d.iterdir()
+                       if p.is_dir() and p.name.startswith("it_"))
+    except OSError:
+        snaps = []
     for old in snaps[:-KEEP_SNAPSHOTS]:
-        for f in old.iterdir():
+        try:
+            entries = list(old.iterdir())
+        except OSError:
+            continue  # dir already pruned by a sibling
+        for f in entries:
             try:
                 f.unlink()
             except OSError:
@@ -199,22 +283,77 @@ def load_meta(ckpt_dir) -> dict | None:
     snap = _latest_snap(ckpt_dir)
     if snap is None:
         return None
-    return json.loads((snap / META_NAME).read_text())
+    return _read_meta(snap)
+
+
+def _valid_hw_of(meta: dict) -> tuple[int, int]:
+    """The snapshot's valid (unpadded) image extent.  ``valid_hw`` is in
+    every meta :func:`run_checkpointed` writes; hand-rolled metas without
+    it fall back to the saved padded extent (every pixel treated valid —
+    exact when the source dims divided its grid)."""
+    vh = meta.get("valid_hw")
+    if vh:
+        return int(vh[0]), int(vh[1])
+    return int(meta["shape"][1]), int(meta["shape"][2])
+
+
+def _reshard_callback(snap: Path, meta: dict, target_shape):
+    """Per-target-shard assembly from a snapshot written on another grid.
+
+    Gather-free: each target shard opens only the source ``.npy`` blocks
+    it overlaps (memmap windows — never a full-file read) and fills its
+    pad rim with zeros, re-deriving the target grid's pad-to-multiple
+    extents.  The source's own pad rim is never read: positions outside
+    the valid image are zero on BOTH grids by the masking invariant, so
+    resharding preserves bytes exactly.
+    """
+    src_grid = tuple(meta["grid"])
+    src_shape = tuple(meta["shape"])
+    sbh = src_shape[1] // src_grid[0]
+    sbw = src_shape[2] // src_grid[1]
+    H, W = _valid_hw_of(meta)
+    C, Hp, Wp = target_shape
+    dtype = np.load(snap / "shard_0_0.npy", mmap_mode="r").dtype
+
+    def cb(index):
+        rs, cs = index[1], index[2]
+        r0, r1 = rs.start or 0, rs.stop or Hp
+        c0, c1 = cs.start or 0, cs.stop or Wp
+        out = np.zeros((C, r1 - r0, c1 - c0), dtype)
+        vr1, vc1 = min(r1, H), min(c1, W)  # only in-image pixels exist
+        if vr1 <= r0 or vc1 <= c0:
+            return out  # target shard lies entirely in the new pad rim
+        for sr in range(r0 // sbh, (vr1 - 1) // sbh + 1):
+            for sc in range(c0 // sbw, (vc1 - 1) // sbw + 1):
+                blk = np.load(snap / f"shard_{sr}_{sc}.npy", mmap_mode="r")
+                gr0, gr1 = max(r0, sr * sbh), min(vr1, (sr + 1) * sbh)
+                gc0, gc1 = max(c0, sc * sbw), min(vc1, (sc + 1) * sbw)
+                out[:, gr0 - r0:gr1 - r0, gc0 - c0:gc1 - c0] = (
+                    blk[:, gr0 - sr * sbh:gr1 - sr * sbh,
+                        gc0 - sc * sbw:gc1 - sc * sbw])
+        return out
+
+    return cb
 
 
 def load_state(ckpt_dir, mesh: Mesh,
                fallback: bool = False) -> tuple[jax.Array, dict]:
-    """Restore the sharded array (each device reads only its own shard).
+    """Restore the sharded array onto ``mesh`` — ANY mesh grid.
 
     Validates snapshot completeness + per-shard CRC32 before any device
     read; a torn snapshot raises :class:`CheckpointCorrupt` — unless
-    ``fallback=True``, in which case the walk continues to the newest
-    OLDER snapshot that validates (with a :class:`CheckpointWarning`
-    naming what was skipped).  Returns ``(array, meta)`` of the snapshot
-    actually loaded, so the caller resumes from its true iteration count.
+    ``fallback=True``, in which case the snapshot is *quarantined* (a
+    :class:`CheckpointWarning` naming the snapshot, the shard, and the
+    cause) and the walk continues to the newest OLDER snapshot that
+    validates.  Returns ``(array, meta)`` of the snapshot actually
+    loaded, so the caller resumes from its true iteration count.
 
-    A grid mismatch is a config error, not corruption: it raises
-    ``ValueError`` immediately, fallback or not.
+    Grid-shape-agnostic (round 10): when the snapshot's grid differs
+    from ``mesh``'s, shards are sliced and reassembled per target shard
+    (:func:`_reshard_callback`) with the pad rim re-derived for the new
+    grid — ``meta['resharded_from']`` then records the source grid.
+    When the grids match, each device reads exactly its own shard file,
+    as before.
     """
     candidates = _candidate_snaps(ckpt_dir)
     if not candidates:
@@ -222,27 +361,37 @@ def load_state(ckpt_dir, mesh: Mesh,
     grid = grid_shape(mesh)
     last_err: CheckpointCorrupt | None = None
     for snap in candidates:
-        meta = json.loads((snap / META_NAME).read_text())
-        if tuple(meta["grid"]) != grid:
-            raise ValueError(
-                f"checkpoint grid {meta['grid']} != mesh grid {list(grid)}"
-            )
         try:
+            meta = _read_meta(snap)
             _validate_snapshot(snap, meta)
         except CheckpointCorrupt as e:
             if not fallback:
                 raise
-            warnings.warn(f"skipping torn snapshot: {e}", CheckpointWarning,
-                          stacklevel=2)
+            warnings.warn(
+                f"quarantined torn snapshot {e.snap or snap.name}: {e}",
+                CheckpointWarning, stacklevel=2)
             last_err = e
             continue
-        shape = tuple(meta["shape"])
-        block_hw = (shape[1] // grid[0], shape[2] // grid[1])
+        src_grid = tuple(meta["grid"])
+        if src_grid == grid:
+            shape = tuple(meta["shape"])
+            block_hw = (shape[1] // grid[0], shape[2] // grid[1])
 
-        def cb(index, snap=snap, block_hw=block_hw):
-            r, c = _coords(index, block_hw)
-            return np.load(snap / f"shard_{r}_{c}.npy")
+            def cb(index, snap=snap, block_hw=block_hw):
+                r, c = _coords(index, block_hw)
+                return np.load(snap / f"shard_{r}_{c}.npy")
 
+        else:
+            H, W = _valid_hw_of(meta)
+            shape = (int(meta["shape"][0]),
+                     padded_extent(H, grid[0]), padded_extent(W, grid[1]))
+            cb = _reshard_callback(snap, meta, shape)
+            meta = dict(meta, resharded_from=list(src_grid),
+                        grid=list(grid), shape=list(shape))
+            warnings.warn(
+                f"resharding snapshot {snap.name} from grid "
+                f"{src_grid[0]}x{src_grid[1]} onto {grid[0]}x{grid[1]}",
+                CheckpointWarning, stacklevel=2)
         arr = jax.make_array_from_callback(shape, block_sharding(mesh), cb)
         return arr, meta
     raise CheckpointCorrupt(
@@ -276,13 +425,19 @@ def run_checkpointed(
     Resume is resilient by default: a torn LATEST snapshot falls back to
     the newest valid one (:func:`load_state` with ``fallback=True``), and
     if *no* snapshot validates the run restarts from ``xs`` with a
-    :class:`CheckpointWarning` — never from corrupt bytes.  ``fallback``
+    :class:`CheckpointWarning` — never from corrupt bytes.  The mesh may
+    have a DIFFERENT grid than the one that wrote the checkpoint
+    (elastic recovery: resume a 2x4 run on whatever slice survives) —
+    shards reshard transparently and bytes stay identical.  ``fallback``
     here is the *backend* degradation knob, threaded to
     ``step.iterate_prepared`` (resilience.degrade).
     """
     from parallel_convolution_tpu.parallel import step as step_lib
 
     grid = grid_shape(mesh)
+    # Resume-compatibility config.  Grid is deliberately NOT part of it:
+    # the grid is a property of the hardware you resume on, not of the
+    # run — snapshots reshard onto whatever mesh is alive.
     config = {
         "filter": filt.name,
         "quantize": quantize,
@@ -290,13 +445,15 @@ def run_checkpointed(
         "fuse": fuse,
         "boundary": boundary,
         "valid_hw": list(valid_hw),
-        "grid": list(grid),
     }
     # Gate on the config FIRST (one small JSON read): a mismatch must not
     # cost shard validation + a full device load before raising.  All
     # snapshots in a dir come from one run, so the latest meta speaks for
     # every fallback candidate too.
-    meta0 = load_meta(ckpt_dir)
+    try:
+        meta0 = load_meta(ckpt_dir)
+    except CheckpointCorrupt:
+        meta0 = None  # torn meta: the validated walk below handles it
     if meta0 is not None:
         saved_cfg = {k: meta0.get(k) for k in config}
         if saved_cfg != config:
@@ -350,6 +507,7 @@ def run_checkpointed(
         if done < total_iters:  # final state is the caller's to persist
             save_state(
                 ckpt_dir, xs,
-                {**config, "iters_done": done, "shape": list(xs.shape)},
+                {**config, "grid": list(grid), "iters_done": done,
+                 "shape": list(xs.shape)},
             )
     return xs

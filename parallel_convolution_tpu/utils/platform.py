@@ -140,6 +140,62 @@ def ensure_live_backend(timeout: float = 120.0) -> str | None:
             "chip record")
 
 
+# Simulation override for the device-health probe: elastic-recovery
+# drills on a CPU host can't actually lose a device, so
+# PCTPU_SIM_DEVICES=N makes the probe report N live devices without
+# spawning a child (documented in DESIGN.md "Elastic recovery").
+SIM_DEVICES_ENV = "PCTPU_SIM_DEVICES"
+
+# Child source for the health probe: re-applies JAX_PLATFORMS like
+# _PROBE_SRC, then reports the live-device count on the last line.
+_COUNT_SRC = _PROBE_SRC + """
+print(len(jax.devices()))
+"""
+
+
+def probe_device_count(timeout: float = 60.0) -> int | None:
+    """How many devices the backend can actually enumerate right now.
+
+    The elastic-recovery health probe: run in a CHILD process (the same
+    dead-tunnel discipline as :func:`ensure_live_backend` — a flapping
+    accelerator tunnel makes the first in-process ``jax.devices()`` hang
+    forever), inheriting env + site hook so the child reproduces the
+    parent's backend selection.  Returns the live count, or ``None``
+    when the probe hangs/fails (callers treat None as "health unknown"
+    and keep their current mesh).  ``PCTPU_SIM_DEVICES=N`` short-circuits
+    to N — the simulation knob reshape drills use on CPU hosts, where a
+    device cannot really disappear.
+
+    Consults the ``device_probe`` fault site (resilience.faults), like
+    :func:`ensure_live_backend`.
+    """
+    sim = os.environ.get(SIM_DEVICES_ENV)
+    if sim:
+        try:
+            return max(0, int(sim))
+        except ValueError:
+            print(f"pconv-tpu: ignoring non-integer {SIM_DEVICES_ENV}="
+                  f"{sim!r}", file=sys.stderr)
+    import subprocess
+
+    from parallel_convolution_tpu.resilience.faults import fault_point
+
+    fault_point("device_probe")
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", _COUNT_SRC],
+            timeout=timeout, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if p.returncode != 0:
+        return None
+    try:
+        return int(p.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return None
+
+
 def device_on_tpu(d) -> bool:
     """True when ``d`` is real TPU silicon.
 

@@ -260,6 +260,7 @@ def main() -> int:
         for p in phase_names
     } if completed else {}
     effective = sorted({r.get("effective_backend", "") for _, r in completed})
+    grids = sorted({r.get("effective_grid", "") for _, r in completed})
     batch_sizes = [r.get("batch_size", 1) for _, r in completed]
 
     row = {
@@ -272,6 +273,7 @@ def main() -> int:
         "backend": args.backend,
         "effective_backend": (effective[0] if len(effective) == 1
                               else effective),
+        "effective_grid": grids[0] if len(grids) == 1 else grids,
         "completed": len(completed),
         "rejected": rejected,
         "non_rejected_failures": non_rejected_failures,

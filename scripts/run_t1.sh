@@ -19,7 +19,22 @@
 #                                      it (auto_ok in the summary row —
 #                                      evidence/tuning_smoke.json, the
 #                                      supervisor leg's done_file).
+#   scripts/run_t1.sh --elastic-smoke  reshape round-trip on the CPU mesh:
+#                                      crash a checkpointed run on 2x4,
+#                                      resume the snapshot on 1x2 / 2x2 /
+#                                      1x1 (grid-agnostic reshard), every
+#                                      output byte-compared to the oracle.
+#                                      Summary row (failures: 0) lands in
+#                                      evidence/elastic_smoke.json (the
+#                                      supervisor leg's done_file).
 cd "$(dirname "$0")/.." || exit 1
+
+if [ "${1:-}" = "--elastic-smoke" ]; then
+  exec timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python scripts/soak.py --reshape 2 --seed 0 \
+      --summary-out evidence/elastic_smoke.json
+fi
 
 if [ "${1:-}" = "--tuning-smoke" ]; then
   exec timeout -k 10 300 env JAX_PLATFORMS=cpu \

@@ -34,6 +34,17 @@ probe cache cleared, fresh service) and requires clean service.  This
 extends ``PCTPU_FAULTS`` coverage to the serving layer:
 
   python scripts/soak.py --serve --faults 8 --seed 0
+
+``--reshape N`` is the ELASTIC-RECOVERY drill (round 10): each trial
+crashes a checkpointed run on the 2x4 CPU mesh at a random injected
+fault site, then resumes the crash's snapshot dir on each of the 1x2,
+2x2, and 1x1 meshes — the checkpoint resharding path — and requires
+every resumed output byte-identical to the single-device oracle.
+Trials run as supervised legs like ``--faults``; ``--summary-out``
+lands the summary row in a file (the ``--elastic-smoke`` tier-1 leg's
+done_file):
+
+  python scripts/soak.py --reshape 8 --seed 0
 """
 
 from __future__ import annotations
@@ -321,6 +332,88 @@ def run_serve_trial(spec: str, seed: int, out_path: str) -> int:
     return 0 if ok else 1
 
 
+RESHAPE_TARGETS = [(1, 2), (2, 2), (1, 1)]
+
+
+def run_reshape_trial(spec: str, seed: int, out_path: str) -> int:
+    """One elastic-recovery drill: crash on 2x4, resume on every shrink.
+
+    Phase 1 installs ``spec`` (a random checkpoint/compile/exchange
+    fault) and runs a checkpointed job on the 2x4 CPU mesh until the
+    injected crash.  Phase 2 copies the post-crash checkpoint dir once
+    per target grid (1x2 / 2x2 / 1x1) and resumes each INDEPENDENTLY
+    from whatever — possibly torn — state the crash left: the
+    grid-agnostic reshard + quarantine walk must land every one
+    byte-identical to the single-device oracle.  Exit 0 iff all do.
+    """
+    import shutil
+    import warnings
+
+    import jax
+    import numpy as np
+
+    from parallel_convolution_tpu.ops import filters, oracle
+    from parallel_convolution_tpu.parallel import mesh as mesh_lib, step
+    from parallel_convolution_tpu.resilience import faults
+    from parallel_convolution_tpu.utils import checkpoint, imageio
+
+    rng = random.Random(seed)
+    filt = filters.get_filter(rng.choice(["blur3", "gaussian5", "sharpen3"]))
+    H, W = rng.randrange(33, 70), rng.randrange(33, 70)
+    total, every = rng.randrange(6, 11), rng.randrange(2, 4)
+    fuse = rng.choice([1, 2, 2])  # biased fused: mid-fuse resumes matter
+    mesh8 = mesh_lib.make_grid_mesh(jax.devices()[:8], (2, 4))
+    img = imageio.generate_test_image(H, W, "grey", seed=seed)
+    x = imageio.interleaved_to_planar(img).astype(np.float32)
+    ck = tempfile.mkdtemp(prefix="pctpu_reshape_trial_")
+
+    crashed = None
+    with faults.injected(spec, seed=seed) as plan:
+        try:
+            xs, valid_hw, _ = step._prepare(x, mesh8, filt.radius)
+            checkpoint.run_checkpointed(xs, filt, total, mesh8, valid_hw,
+                                        ckpt_dir=ck, every=every, fuse=fuse)
+        except Exception as e:  # noqa: BLE001 — the injected crash
+            crashed = repr(e)
+        fired = plan.fired
+    want = oracle.run_serial_u8(img, filt, total)
+    targets, ok = {}, True
+    for shape in RESHAPE_TARGETS:
+        name = "x".join(map(str, shape))
+        tdir = f"{ck}_resume_{name}"
+        shutil.copytree(ck, tdir, dirs_exist_ok=True)
+        tmesh = mesh_lib.make_grid_mesh(
+            jax.devices()[: shape[0] * shape[1]], shape)
+        xs2, valid_hw, _ = step._prepare(x, tmesh, filt.radius)
+        try:
+            meta = checkpoint.load_meta(tdir)
+            resumed_from = None if meta is None else int(meta["iters_done"])
+        except checkpoint.CheckpointCorrupt:
+            resumed_from = "torn"
+        with warnings.catch_warnings():
+            # Reshard notes + quarantine warnings are this drill's
+            # expected operation, not anomalies to surface per trial.
+            warnings.simplefilter("ignore", checkpoint.CheckpointWarning)
+            out = checkpoint.run_checkpointed(
+                xs2, filt, total, tmesh, valid_hw, ckpt_dir=tdir,
+                every=every, fuse=fuse)
+        got = np.asarray(out)[:, : valid_hw[0], : valid_hw[1]]
+        t_ok = bool(np.array_equal(got[0].astype(np.uint8), want))
+        targets[name] = {"ok": t_ok, "resumed_from": resumed_from}
+        ok &= t_ok
+    row = {
+        "ok": ok, "mode": "reshape", "spec": spec, "seed": seed,
+        "crashed": crashed, "fired": [list(f) for f in fired],
+        "filter": filt.name, "H": H, "W": W, "total": total,
+        "every": every, "fuse": fuse, "source_mesh": "2x4",
+        "targets": targets,
+    }
+    Path(out_path).parent.mkdir(parents=True, exist_ok=True)
+    Path(out_path).write_text(json.dumps(row))
+    print(json.dumps(row), flush=True)
+    return 0 if ok else 1
+
+
 def _sample_serve_fault_spec(rng: random.Random) -> str:
     """A random transient compile/exchange plan for the serving drill.
 
@@ -332,6 +425,28 @@ def _sample_serve_fault_spec(rng: random.Random) -> str:
     site = rng.choice(["backend_compile", "backend_compile",
                        "halo_exchange"])
     return f"{site}:{rng.randrange(1, 4)}"
+
+
+def _sample_reshape_fault_spec(rng: random.Random, n_shards: int) -> str:
+    """A crash that lands AFTER the first save completes.
+
+    The reshape drill's point is resuming a REAL snapshot on a different
+    grid, so every sampled (site, hit) leaves snapshot 1 intact: shard
+    hits span the second save (tearing it additionally exercises the
+    quarantine walk mid-reshard), meta hits 3/4 are the second save's
+    meta/LATEST writes, and exchange hit 2 is a later chunk's compile.
+    With short runs some hits never fire — the run then completes clean
+    and the resume still reshards from its snapshots.
+    """
+    site = rng.choice(["checkpoint_write_shard"] * 3
+                      + ["checkpoint_write_meta"] * 2 + ["halo_exchange"])
+    if site == "checkpoint_write_shard":
+        hit = rng.randrange(n_shards + 1, 2 * n_shards + 1)
+    elif site == "checkpoint_write_meta":
+        hit = rng.randrange(3, 5)
+    else:
+        hit = 2
+    return f"{site}:{hit}"
 
 
 def _sample_fault_spec(rng: random.Random, n_shards: int) -> str:
@@ -358,9 +473,14 @@ def run_fault_soak(args) -> int:
 
     rng = random.Random(args.seed)
     state = Path(args.state_dir or tempfile.mkdtemp(prefix="pctpu_fault_soak_"))
+    n_trials = args.reshape or args.faults
     legs = []
-    for i in range(args.faults):
-        if args.serve:
+    for i in range(n_trials):
+        if args.reshape:
+            # Post-first-save crash sites, resumed across grids.
+            spec = _sample_reshape_fault_spec(rng, n_shards=8)
+            trial_flag = "--reshape-trial"
+        elif args.serve:
             spec = _sample_serve_fault_spec(rng)
             trial_flag = "--serve-trial"
         else:
@@ -388,12 +508,22 @@ def run_fault_soak(args) -> int:
             print(p.read_text().strip(), flush=True)
         if not leg.is_complete():
             fails += 1
-    print(json.dumps({
-        "summary": "fault-soak", "mode": "serve" if args.serve else "batch",
-        "n": args.faults, "seed": args.seed,
+    summary = {
+        "summary": "reshape-soak" if args.reshape else "fault-soak",
+        "mode": ("reshape" if args.reshape
+                 else "serve" if args.serve else "batch"),
+        "n": n_trials, "seed": args.seed,
         "failures": fails, "state_dir": str(state), "supervisor_rc": rc,
         "wall_s": round(time.time() - t0, 1),
-    }), flush=True)
+    }
+    if args.reshape:
+        summary["targets"] = ["x".join(map(str, s))
+                              for s in RESHAPE_TARGETS]
+    if args.summary_out:
+        p = Path(args.summary_out)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(json.dumps(summary) + "\n")
+    print(json.dumps(summary), flush=True)
     return 1 if (fails or rc) else 0
 
 
@@ -414,11 +544,20 @@ def main() -> int:
                          "injected compile/exchange faults into "
                          "byte-identical responses; then a clean restart "
                          "must serve the requested tier)")
+    ap.add_argument("--reshape", type=int, default=0, metavar="N",
+                    help="elastic-recovery mode: run N crash-on-2x4 / "
+                         "resume-on-1x2,2x2,1x1 reshard drills through "
+                         "the supervised runner; every resumed output "
+                         "must byte-match the single-device oracle")
+    ap.add_argument("--summary-out", default=None, metavar="FILE",
+                    help="also write the final summary row to FILE "
+                         "(the tier-1 --elastic-smoke leg's done_file)")
     ap.add_argument("--state-dir", default=None,
                     help="--faults: supervisor state dir (default: mkdtemp)")
     # Hidden: one drill in a child process (the supervisor's leg cmd).
     ap.add_argument("--fault-trial", default=None, help=argparse.SUPPRESS)
     ap.add_argument("--serve-trial", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--reshape-trial", default=None, help=argparse.SUPPRESS)
     ap.add_argument("--trial-seed", type=int, default=0,
                     help=argparse.SUPPRESS)
     ap.add_argument("--trial-out", default=None, help=argparse.SUPPRESS)
@@ -430,9 +569,14 @@ def main() -> int:
     if args.serve_trial:
         return run_serve_trial(args.serve_trial, args.trial_seed,
                                args.trial_out)
+    if args.reshape_trial:
+        return run_reshape_trial(args.reshape_trial, args.trial_seed,
+                                 args.trial_out)
     if args.serve and not args.faults:
         ap.error("--serve requires --faults N")
-    if args.faults:
+    if args.reshape and args.faults:
+        ap.error("--reshape and --faults are separate modes")
+    if args.faults or args.reshape:
         return run_fault_soak(args)
 
     import jax

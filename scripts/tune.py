@@ -49,6 +49,12 @@ def main() -> int:
     ap.add_argument("--boundary", default="zero",
                     choices=["zero", "periodic"])
     ap.add_argument("--no-quantize", action="store_true")
+    ap.add_argument("--check-every", type=int, default=None,
+                    help="tune the CONVERGENCE-path program with this "
+                         "check cadence: the cadence joins the plan key "
+                         "and caps legal fusion at check_every-1 (the "
+                         "chunk's final iteration forms the convergence "
+                         "pair unfused)")
     ap.add_argument("--mesh", default=None,
                     help="RxC grid (default: all devices, near-square)")
     ap.add_argument("--backends", default=None,
@@ -93,7 +99,8 @@ def main() -> int:
     shape = (channels, args.rows, args.cols)
     quantize = not args.no_quantize
     w = Workload.from_mesh(mesh, filt, shape, storage=args.storage,
-                           quantize=quantize, boundary=args.boundary)
+                           quantize=quantize, boundary=args.boundary,
+                           check_every=args.check_every)
 
     backends = args.backends.split(",") if args.backends else None
     fuses = ([int(v) for v in args.fuses.split(",")]
@@ -113,6 +120,7 @@ def main() -> int:
         "workload": {"shape": list(shape), "filter": filt.name,
                      "storage": args.storage, "quantize": quantize,
                      "boundary": args.boundary,
+                     "check_every": args.check_every,
                      "mesh": f"{w.grid[0]}x{w.grid[1]}",
                      "platform": w.platform,
                      "device_kind": w.device_kind},
@@ -133,6 +141,7 @@ def main() -> int:
         # back this plan, provenance intact — the tuning-smoke gate.
         res = resolve(mesh, filt, shape, storage=args.storage,
                       quantize=quantize, boundary=args.boundary,
+                      check_every=args.check_every,
                       plans=PlanCache.load(args.out))
         summary["auto_resolved"] = {
             "backend": res.backend, "fuse": res.fuse,

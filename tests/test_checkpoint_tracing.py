@@ -71,15 +71,22 @@ def test_checkpoint_config_mismatch_raises(tmp_path, grey_small):
         checkpoint.run_checkpointed(None, filt, 10, m, valid_hw, ck, 2)
 
 
-def test_checkpoint_grid_mismatch_raises(tmp_path, grey_small):
+def test_checkpoint_grid_mismatch_reshards(tmp_path, grey_small):
+    # Round 10 (elastic recovery): a grid mismatch is no longer an error —
+    # the snapshot reshards onto the requested mesh, bytes unchanged.
     filt = filters.get_filter("blur3")
     m = _mesh((2, 2))
     xs, valid_hw, _ = _prepare(grey_small, m, filt)
     checkpoint.save_state(tmp_path, xs, {
         "grid": [2, 2], "shape": list(xs.shape), "iters_done": 0,
+        "valid_hw": list(valid_hw),
     })
-    with pytest.raises(ValueError, match="grid"):
-        checkpoint.load_state(tmp_path, _mesh((1, 4)))
+    with pytest.warns(checkpoint.CheckpointWarning, match="resharding"):
+        arr, meta = checkpoint.load_state(tmp_path, _mesh((1, 4)))
+    assert meta["resharded_from"] == [2, 2] and meta["grid"] == [1, 4]
+    np.testing.assert_array_equal(
+        np.asarray(arr)[:, : valid_hw[0], : valid_hw[1]],
+        np.asarray(xs)[:, : valid_hw[0], : valid_hw[1]])
 
 
 def test_phase_timer(tmp_path):

@@ -548,3 +548,84 @@ def test_runconfig_accepts_auto():
     assert rt.backend == "auto" and rt.fuse is None
     with pytest.raises(ValueError, match="auto"):
         RunConfig(rows=48, cols=64, backend="shifted", fuse=None)
+
+
+# --------------------------------------------- round 10: elastic tuning
+def test_plan_key_check_every_identity():
+    """check_every joins the key ONLY when set: fixed-count keys are
+    byte-identical to the pre-round-10 schema (existing plan files stay
+    valid), convergence keys are distinct per cadence."""
+    base = _workload()
+    assert "check_every" not in base.key_fields()
+    ce5 = _workload(check_every=5)
+    ce9 = _workload(check_every=9)
+    assert ce5.key() != base.key() and ce5.key() != ce9.key()
+    assert ce5.key_fields()["check_every"] == 5
+
+
+def test_check_every_bounds_legal_fuse():
+    """A convergence chunk fuses at most its n-1 pre-pair iterations, so
+    the candidate space (and a pinned fuse) must respect check_every."""
+    w = _workload(check_every=3)
+    assert max(search._legal_fuses(w, "shifted", search.FUSE_MENU)) == 2
+    assert search._legal_fuses(_workload(check_every=1), "shifted",
+                               search.FUSE_MENU) == [1]
+    res = tuning.resolve(_mesh(), get_filter("blur3"), (1, 48, 64),
+                         fuse=8, check_every=3)
+    assert res.fuse == 2  # pinned depth clamped as _build_converge would
+
+
+def test_cross_grid_plan_interpolates(tmp_path):
+    """Elastic recovery: a resharded resume on a new grid resolves the
+    run's tuned plan (provenance 'interpolated'), not the cost model —
+    and a same-grid neighbor still beats a cross-grid one."""
+    w_old = _workload(mesh_shape=(2, 4))           # the grid that tuned
+    w_new = _workload(mesh_shape=(1, 2))           # the survivor grid
+    cache = PlanCache()
+    cache.put(w_old, Plan("xla_conv", fuse=4, source="measured"))
+    hit = cache.best_plan(w_new)
+    assert hit is not None and hit.backend == "xla_conv"
+    assert hit.source == "interpolated"
+    res = tuning.resolve(_mesh((1, 2)), get_filter("blur3"), (1, 48, 64),
+                         plans=cache)
+    assert res.backend == "xla_conv" and res.source == "interpolated"
+    # A same-grid different-bucket plan outranks any cross-grid one.
+    w_new_big = _workload(shape=(1, 200, 200), mesh_shape=(1, 2))
+    cache.put(w_new_big, Plan("separable", fuse=2, source="measured"))
+    assert cache.best_plan(w_new).backend == "separable"
+    # Field-set parity: a convergence-tuned plan never drives the
+    # fixed-count path (and vice versa).
+    conv_only = PlanCache()
+    conv_only.put(_workload(check_every=5),
+                  Plan("pallas", fuse=2, source="measured"))
+    assert conv_only.best_plan(_workload()) is None
+    assert conv_only.best_plan(_workload(check_every=5)) is not None
+
+
+def test_converge_auto_resolves_from_cross_grid_plan(tmp_path, monkeypatch):
+    """End to end: sharded_converge(backend='auto', check_every=...) on a
+    SHRUNKEN mesh resolves through a plan file tuned on the big mesh —
+    the resharded-resume scenario — and stays byte-identical to the
+    explicit backend."""
+    from parallel_convolution_tpu.utils import imageio
+
+    filt = get_filter("jacobi3")
+    cache = PlanCache()
+    cache.put(Workload.from_mesh(_mesh((2, 4)), filt, (1, 40, 48),
+                                 quantize=False, check_every=4),
+              Plan("xla_conv", fuse=2, source="measured"))
+    plan_file = tmp_path / "plans.json"
+    cache.save(str(plan_file))
+    monkeypatch.setenv(tuning.PLAN_FILE_ENV, str(plan_file))
+    img = imageio.generate_test_image(40, 48, "grey", seed=7)
+    x = img[None].astype(np.float32)
+    got, it_auto = step_lib.sharded_converge(
+        x, filt, tol=0.05, max_iters=24, check_every=4, mesh=_mesh((1, 2)),
+        quantize=False, backend="auto", fuse=None)
+    assert tuning.last_resolution().source == "interpolated"
+    assert tuning.last_resolution().backend == "xla_conv"
+    want, it_ref = step_lib.sharded_converge(
+        x, filt, tol=0.05, max_iters=24, check_every=4, mesh=_mesh((1, 2)),
+        quantize=False, backend="xla_conv", fuse=2)
+    assert it_auto == it_ref
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
