@@ -1,0 +1,42 @@
+"""Unified observability layer: metrics registry, event log, attribution.
+
+One telemetry spine for the whole stack (round 11).  Three parts:
+
+* :mod:`obs.metrics` — process-global typed counters / gauges /
+  fixed-bucket histograms with labeled series; ``snapshot()`` for the
+  in-process client, Prometheus text via ``render_text()`` (served at
+  ``/metrics`` on the HTTP frontend).  ``PCTPU_OBS=0`` turns every
+  mutator into a one-branch no-op (perf-tested).
+* :mod:`obs.events` — append-only JSONL structured event log (monotonic
+  ``seq``, wall+perf clocks, typed kinds) with atomic rotation;
+  installed process-globally from ``PCTPU_OBS_EVENTS`` so drills leave a
+  replayable timeline instead of scattered warnings.
+* :mod:`obs.attribution` — analytic per-direction halo-byte accounting
+  and the roofline exchange-vs-compute split, the instrumentation the
+  overlapped-halo and topology roadmap items are judged against.
+
+``scripts/obs_report.py`` folds an event log + metrics snapshot into the
+human summary (per-phase quantiles, exchange fraction per backend,
+retry/degrade/quarantine totals, predicted-vs-measured drift per plan
+key).
+
+Import discipline: ``obs.metrics``/``obs.events`` are stdlib-only and
+jax-free — safe to import from ``resilience.faults``-class modules that
+must stay cheap.  ``obs.attribution`` additionally pulls the (jax-free)
+tuning cost model.
+"""
+
+from parallel_convolution_tpu.obs import events, metrics
+
+__all__ = ["attribution", "events", "metrics"]
+
+
+def __getattr__(name):
+    # attribution imports tuning (heavier); load it on first touch so
+    # `from parallel_convolution_tpu.obs import metrics` stays light.
+    if name == "attribution":
+        import importlib
+
+        return importlib.import_module(
+            "parallel_convolution_tpu.obs.attribution")
+    raise AttributeError(name)

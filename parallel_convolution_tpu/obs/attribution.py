@@ -1,0 +1,251 @@
+"""Exchange/overlap attribution: halo bytes and exchange-vs-compute split.
+
+ROADMAP items 1 and 3 are judged on instrumentation this module owns:
+
+* "Persistent and Partitioned MPI for Stencil Communication" (PAPERS.md)
+  demonstrates its overlap wins through per-phase exchange-vs-interior
+  timing — so every step/bench/serving artifact now carries an
+  ``exchange_fraction`` attribution (the roofline model's exchange term
+  over its total, a pure function of the decomposition);
+* "Efficient Process-to-Node Mapping Algorithms for Stencil
+  Computations" (PAPERS.md) validates layouts via per-direction halo
+  *byte* accounting — :func:`halo_bytes_per_round` is that accounting as
+  an analytic formula of (grid, block, radius, fuse, dtype, boundary),
+  tested against an independent derivation in ``tests/test_obs.py``.
+
+The byte formula mirrors ``parallel/halo.halo_exchange`` exactly:
+
+* phase 1 (rows): each sending device moves a ``channels × d × bw`` slab
+  per direction, ``d = radius*fuse`` (temporal fusion widens the ghost
+  band); with zero boundaries only ``R-1`` of the ``R`` rows send each
+  way, with periodic all ``R`` do — and a 1-long axis moves NOTHING
+  (``halo._shift`` short-circuits to zeros/self, no collective);
+* phase 2 (cols): slabs are cut from the already row-padded block, so
+  their height is ``bh + 2d`` — the corner bytes ride the column phase,
+  which is exactly how the two-hop corner propagation pays for skipping
+  the reference's diagonal messages.
+
+The same ghost bands (same depth, same directions) are what the RDMA
+kernels DMA in-kernel, so the accounting is backend-independent by
+construction: it prices the *decomposition*, not the transport.
+
+jax-free: everything here is arithmetic over ints, reusing the tuning
+cost model's calibrated constants for the time split.
+"""
+
+from __future__ import annotations
+
+from parallel_convolution_tpu.obs import events, metrics
+from parallel_convolution_tpu.tuning import costmodel
+
+__all__ = [
+    "exchange_rounds", "halo_bytes_per_round", "halo_bytes_total",
+    "predicted_exchange_fraction", "record_drift", "record_step",
+]
+
+DIRECTIONS = ("north", "south", "east", "west")
+
+
+def halo_bytes_per_round(grid: tuple[int, int], block_hw: tuple[int, int],
+                         radius: int, fuse: int, channels: int,
+                         storage: str, boundary: str = "zero") -> dict:
+    """Per-direction bytes crossing device links in ONE exchange round,
+    summed over the whole mesh.
+
+    A "round" is one ``halo_exchange`` at ghost depth ``d = radius*fuse``
+    (the fused-chunk exchange).  Directions name where the data travels:
+    ``south`` = toward higher row index, ``east`` = toward higher column
+    index.  Zero-boundary edges send nothing outward (there is no
+    neighbor); periodic boundaries close the ring — except on a 1-long
+    axis, where the wrap is the identity and no collective exists.
+    """
+    R, C = (int(g) for g in grid)
+    bh, bw = (int(b) for b in block_hw)
+    d = int(radius) * max(1, int(fuse))
+    B = costmodel.STORAGE_BYTES[storage]
+    periodic = boundary == "periodic"
+    row_senders = (R if periodic else R - 1) if R > 1 else 0
+    col_senders = (C if periodic else C - 1) if C > 1 else 0
+    row_slab = channels * d * bw * B          # phase 1: (C, d, bw)
+    col_slab = channels * d * (bh + 2 * d) * B  # phase 2: row-padded height
+    out = {
+        "south": row_senders * C * row_slab,
+        "north": row_senders * C * row_slab,
+        "east": col_senders * R * col_slab,
+        "west": col_senders * R * col_slab,
+    }
+    out["total"] = sum(out.values())
+    return out
+
+
+def exchange_rounds(iters: int, fuse: int) -> tuple[int, int]:
+    """``(full_rounds, tail_iters)`` of the fused iteration schedule: the
+    runner exchanges once per ``fuse``-iteration chunk plus once for the
+    remainder chunk (at its own shallower depth)."""
+    fuse = max(1, min(int(fuse), max(1, int(iters))))
+    return int(iters) // fuse, int(iters) % fuse
+
+
+def halo_bytes_total(grid, block_hw, radius: int, fuse: int, iters: int,
+                     channels: int, storage: str,
+                     boundary: str = "zero") -> dict:
+    """Per-direction bytes for a whole ``iters``-iteration run — full
+    fused rounds at depth ``radius*fuse`` plus the tail round at its own
+    depth (``radius * (iters % fuse)``), exactly the schedule
+    ``step._build_iterate`` compiles."""
+    full, rem = exchange_rounds(iters, fuse)
+    total = {d: 0 for d in (*DIRECTIONS, "total")}
+    per = halo_bytes_per_round(grid, block_hw, radius, fuse, channels,
+                               storage, boundary)
+    for k in total:
+        total[k] += full * per[k]
+    if rem:
+        tail = halo_bytes_per_round(grid, block_hw, radius, rem, channels,
+                                    storage, boundary)
+        for k in total:
+            total[k] += tail[k]
+    total["rounds"] = full + (1 if rem else 0)
+    return total
+
+
+def predicted_exchange_fraction(
+        grid, block_hw, radius: int, fuse: int, *, backend: str,
+        storage: str, shape: tuple[int, int, int],
+        tile: tuple[int, int] | None = None, quantize: bool = True,
+        separable: bool = False, platform: str = "cpu",
+        device_kind: str = "") -> float:
+    """Exchange share of one iteration's roofline time, in [0, 1].
+
+    The cost model's exchange term over ``max(bandwidth, compute) +
+    exchange`` — the same decomposition the autotuner ranks with, so the
+    attribution in rows/reports and the knob ``backend="auto"`` turns are
+    the one model (and recalibrating one recalibrates the other).  Pure
+    model attribution: the interpret penalty scales both terms, so the
+    fraction is penalty-invariant; a 1x1 grid is exactly 0.
+    """
+    hw = costmodel.hardware_for(platform, device_kind)
+    T = max(1, int(fuse))
+    k = 2 * int(radius) + 1
+    ex = costmodel.exchange_seconds_per_px_iter(
+        tuple(grid), tuple(block_hw), int(radius), T, storage, hw)
+    if ex == 0.0:
+        return 0.0
+    tile_eff = costmodel.effective_tile(backend, tile)
+    rim_tile = tile_eff if tile_eff is not None else tuple(block_hw)
+    if backend == "pallas_rdma" and not costmodel.rdma_is_tiled(
+            tuple(shape), tuple(block_hw), int(radius), T, storage):
+        rim_tile = tuple(block_hw)
+    sep = separable and backend in ("separable", "pallas_sep")
+    t_hbm = costmodel.hbm_bytes_per_px_iter(
+        backend, storage, T, tile, tuple(block_hw), int(radius),
+        tuple(shape)) / (hw.hbm_gbps * 1e9)
+    t_flop = costmodel.flops_per_px_iter(
+        k, sep, quantize, T, rim_tile, int(radius)) / (hw.flop_gops * 1e9)
+    t = max(t_hbm, t_flop) + ex
+    return min(1.0, ex / t) if t > 0 else 0.0
+
+
+# -- the step-level recorder (metrics + event, one helper, two callers) ----
+# parallel/step.iterate_prepared and serving/engine.run_batch both drive
+# compiled runners; both call record_step so exchange attribution lands in
+# the same series regardless of the entry point.
+
+def _m():
+    """Metric handles, created lazily through the global registry (so a
+    registry reset in tests re-creates them on next use)."""
+    return (
+        metrics.histogram(
+            "pctpu_step_seconds",
+            "wall of one compiled iterate call (all fused blocks)",
+            ("backend",)),
+        metrics.counter(
+            "pctpu_exchange_seconds_total",
+            "model-attributed exchange share of step walls", ("backend",)),
+        metrics.counter(
+            "pctpu_compute_seconds_total",
+            "model-attributed compute share of step walls", ("backend",)),
+        metrics.counter(
+            "pctpu_halo_bytes_total",
+            "analytic ghost-band bytes moved, per direction",
+            ("backend", "direction")),
+        metrics.counter(
+            "pctpu_halo_rounds_total", "halo exchange rounds executed",
+            ("backend",)),
+        metrics.counter(
+            "pctpu_iterations_total", "stencil iterations executed",
+            ("backend",)),
+    )
+
+
+def record_step(*, backend: str, grid, block_hw, radius: int, fuse: int,
+                iters: int, channels: int, storage: str, boundary: str,
+                wall_s: float | None, shape, quantize: bool = True,
+                tile=None, platform: str = "cpu", device_kind: str = "",
+                source: str = "step") -> dict | None:
+    """Record one compiled-iterate call: wall, halo bytes, exchange split.
+
+    ``wall_s=None`` means the caller dispatched asynchronously and has no
+    honest device wall (``iterate_prepared`` — fencing there would
+    silently serialize the library's async iterate path): the byte/round
+    counters and the event still land, but the wall histogram and the
+    exchange/compute second split are skipped rather than fed a
+    dispatch-only wall.  Callers that already fence (bench, the serving
+    device phase, the convergence path's count readback) pass the real
+    wall.
+
+    Returns the attribution dict (halo bytes + fraction) for callers that
+    stamp rows, or None when obs is disabled (nothing computed — the
+    arithmetic itself is the overhead being avoided).
+    """
+    if not metrics.enabled():
+        return None
+    sep = backend in ("separable", "pallas_sep")
+    by = halo_bytes_total(grid, block_hw, radius, fuse, iters, channels,
+                          storage, boundary)
+    frac = predicted_exchange_fraction(
+        grid, block_hw, radius, fuse, backend=backend, storage=storage,
+        shape=shape, tile=tile, quantize=quantize, separable=sep,
+        platform=platform, device_kind=device_kind)
+    wall, ex_s, comp_s, hbytes, rounds, iters_m = _m()
+    if wall_s is not None:
+        wall.observe(wall_s, backend=backend)
+        ex_s.inc(wall_s * frac, backend=backend)
+        comp_s.inc(wall_s * (1.0 - frac), backend=backend)
+    for d in DIRECTIONS:
+        hbytes.inc(by[d], backend=backend, direction=d)
+    rounds.inc(by["rounds"], backend=backend)
+    iters_m.inc(iters, backend=backend)
+    events.emit(
+        "exchange", source=source, backend=backend,
+        grid=f"{grid[0]}x{grid[1]}", block=list(block_hw),
+        radius=int(radius), fuse=int(fuse), iters=int(iters),
+        storage=storage, boundary=boundary, rounds=by["rounds"],
+        halo_bytes={d: by[d] for d in DIRECTIONS},
+        exchange_fraction=round(frac, 4),
+        **({"wall_s": round(wall_s, 6)} if wall_s is not None else {}))
+    return {"halo_bytes": by, "exchange_fraction": frac}
+
+
+def record_drift(plan_key: str, backend: str, predicted_gpx: float | None,
+                 measured_gpx: float | None) -> None:
+    """The predicted-vs-measured Gpx/s/chip drift series per plan key —
+    ROADMAP 5a's recalibration input, fed by BOTH the serving engine and
+    ``bench_iterate`` through this one helper so the series can never
+    desynchronize between producers."""
+    if (not metrics.enabled() or not predicted_gpx
+            or measured_gpx is None or measured_gpx <= 0):
+        return
+    g = metrics.gauge(
+        "pctpu_plan_gpx_per_chip",
+        "per-plan-key Gpx/s/chip, predicted vs measured",
+        ("key", "backend", "which"))
+    g.set(round(predicted_gpx, 6), key=plan_key, backend=backend,
+          which="predicted")
+    g.set(round(measured_gpx, 6), key=plan_key, backend=backend,
+          which="measured")
+    metrics.gauge(
+        "pctpu_plan_drift_ratio",
+        "measured/predicted Gpx/s per plan key (1.0 = calibrated)",
+        ("key", "backend")).set(
+        round(measured_gpx / predicted_gpx, 6), key=plan_key,
+        backend=backend)

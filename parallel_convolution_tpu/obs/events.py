@@ -1,0 +1,279 @@
+"""Append-only JSONL structured event log with atomic rotation.
+
+Every drill (``soak.py``, supervisor legs, serving smokes) previously
+left its story scattered across warnings, per-leg ``.out`` files, and
+stderr.  The event log is the replayable timeline: one JSONL line per
+event, every line carrying
+
+* ``seq``  — per-WRITER monotonic sequence number (each process is its
+  own stream, identified by the ``pid`` field — a gap within one pid's
+  stream = lost line; interleaved pids are expected when supervisor and
+  leg children share one ``PCTPU_OBS_EVENTS`` path);
+* ``ts``   — wall clock (``time.time()``, for humans and cross-process
+  merging);
+* ``perf`` — ``time.perf_counter()`` (monotonic, for intra-process
+  deltas that wall-clock steps can't corrupt);
+* ``kind`` — one of :data:`KINDS`, the typed vocabulary below;
+* free-form event fields (JSON-safe scalars/lists/dicts).
+
+Rotation is atomic: when the live file would exceed ``max_bytes`` the
+writer renames it to ``<path>.1`` (shifting older generations up, oldest
+dropped) via ``os.replace`` and starts fresh — a reader never observes a
+half-rotated file, and ``seq`` continues across generations so the
+stitched timeline stays gap-checkable.
+
+Module-level :func:`emit` consults the process-global log exactly like
+``resilience.faults.fault_point`` consults its plan: with no log
+installed (or obs disabled, ``PCTPU_OBS=0``) it is one global load and a
+test — free on hot paths.  Entry points install a log from the
+``PCTPU_OBS_EVENTS`` env (a path) via :func:`install_from_env`.
+
+stdlib-only, jax-free.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from pathlib import Path
+
+from parallel_convolution_tpu.obs import metrics as _metrics
+
+__all__ = [
+    "EVENTS_ENV", "EventLog", "KINDS", "configure", "emit", "get_log",
+    "install_from_env", "read_events", "validate_event",
+]
+
+EVENTS_ENV = "PCTPU_OBS_EVENTS"
+
+# The typed event vocabulary — one name per thing that happens to the
+# stack, mapping 1:1 onto the subsystems that emit it.  Emitting an
+# unknown kind raises: a typo'd kind would otherwise silently fork the
+# schema every report consumer depends on.
+KINDS = frozenset({
+    "compile",             # a fresh trace/compile (step build-cache miss)
+    "exchange",            # halo traffic attribution for one iterate call
+    "degrade",             # backend degradation walk resolved a lower tier
+    "retry",               # with_retry observed a transient + backoff
+    "checkpoint_save",     # snapshot written (duration + bytes)
+    "checkpoint_load",     # snapshot loaded (duration + bytes)
+    "checkpoint_reshard",  # load crossed a grid change
+    "quarantine",          # a torn snapshot was quarantined (cause per shard)
+    "reshape",             # serving engine swapped its mesh mid-process
+    "admission",           # a request was shed with a typed reason
+    "fault_trigger",       # an injected fault fired at a named site
+    "heartbeat",           # supervisor liveness tick
+    "leg",                 # supervisor leg state change (start/done/...)
+    "serve",               # service lifecycle (boot, close)
+})
+
+_REQUIRED = ("seq", "ts", "perf", "kind")
+
+
+class EventLog:
+    """One append-only JSONL event file with size-bounded rotation."""
+
+    def __init__(self, path, *, max_bytes: int = 8 << 20, keep: int = 2):
+        if max_bytes < 4096:
+            raise ValueError("max_bytes must be >= 4096")
+        if keep < 0:
+            raise ValueError("keep must be >= 0")
+        self.path = Path(path)
+        self.max_bytes = int(max_bytes)
+        self.keep = int(keep)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._size = 0
+        self._fh = None
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+
+    def _open(self) -> None:
+        self._fh = open(self.path, "a", encoding="utf-8")
+        self._size = self._fh.tell()
+
+    def _ensure_live(self) -> None:
+        """Re-open if another PROCESS rotated (or removed) the live file
+        out from under our fd — writes must land in the current
+        generation, never keep streaming into a renamed ``.1``."""
+        if self._fh is None:
+            self._open()
+            return
+        try:
+            st = os.stat(self.path)
+        except OSError:
+            st = None
+        if st is None or st.st_ino != os.fstat(self._fh.fileno()).st_ino:
+            self._fh.close()
+            self._open()
+
+    def _rotate_locked(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        if self.keep == 0:
+            try:
+                self.path.unlink()
+            except OSError:
+                pass
+        else:
+            # Shift generations up, oldest first, each step atomic.
+            for i in range(self.keep - 1, 0, -1):
+                src = self.path.with_name(f"{self.path.name}.{i}")
+                if src.exists():
+                    os.replace(src, self.path.with_name(
+                        f"{self.path.name}.{i + 1}"))
+            os.replace(self.path, self.path.with_name(f"{self.path.name}.1"))
+            # Drop anything beyond keep (the shift above may have created
+            # .keep+1 transiently — remove it).
+            extra = self.path.with_name(f"{self.path.name}.{self.keep + 1}")
+            try:
+                extra.unlink()
+            except OSError:
+                pass
+        self._open()
+
+    def emit(self, kind: str, **fields) -> dict:
+        """Append one event; returns the record written (tests assert on
+        it).  Raises ValueError on an unknown kind or a reserved field."""
+        if kind not in KINDS:
+            raise ValueError(
+                f"unknown event kind {kind!r}; known: {sorted(KINDS)}")
+        bad = set(fields) & set(_REQUIRED)
+        if bad:
+            raise ValueError(f"fields {sorted(bad)} are reserved")
+        with self._lock:
+            self._ensure_live()
+            self._seq += 1
+            rec = {"seq": self._seq, "ts": round(time.time(), 6),
+                   "perf": round(time.perf_counter(), 6),
+                   "pid": os.getpid(), "kind": kind, **fields}
+            line = json.dumps(rec, default=str) + "\n"
+            if self._size + len(line) > self.max_bytes and self._size > 0:
+                self._rotate_locked()
+            self._fh.write(line)
+            self._fh.flush()
+            self._size += len(line)
+        return rec
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    def generations(self) -> list[Path]:
+        """Existing files, oldest first (``.N`` ... ``.1``, then live)."""
+        out = []
+        for i in range(self.keep + 1, 0, -1):
+            p = self.path.with_name(f"{self.path.name}.{i}")
+            if p.exists():
+                out.append(p)
+        if self.path.exists():
+            out.append(self.path)
+        return out
+
+
+def validate_event(rec: dict) -> list[str]:
+    """Schema problems of one parsed event line ([] = valid).
+
+    The contract every consumer (obs_report, the smoke leg, tests) checks
+    instead of re-inventing: required keys present and typed, kind known,
+    seq positive."""
+    problems = []
+    if not isinstance(rec, dict):
+        return [f"not an object: {type(rec).__name__}"]
+    for k in _REQUIRED:
+        if k not in rec:
+            problems.append(f"missing {k!r}")
+    if isinstance(rec.get("seq"), bool) or not isinstance(
+            rec.get("seq"), int) or (isinstance(rec.get("seq"), int)
+                                     and rec["seq"] < 1):
+        problems.append(f"bad seq {rec.get('seq')!r}")
+    for k in ("ts", "perf"):
+        if k in rec and not isinstance(rec[k], (int, float)):
+            problems.append(f"bad {k} {rec.get(k)!r}")
+    if rec.get("kind") not in KINDS:
+        problems.append(f"unknown kind {rec.get('kind')!r}")
+    return problems
+
+
+def read_events(path, include_rotated: bool = True) -> list[dict]:
+    """Parse a JSONL event log (plus rotated generations, oldest first).
+
+    Unparseable lines raise — a torn tail is a real finding, and the
+    writer flushes per line, so one should never exist outside a crash.
+    """
+    p = Path(path)
+    paths: list[Path] = []
+    if include_rotated:
+        i = 1
+        gens = []
+        while True:
+            g = p.with_name(f"{p.name}.{i}")
+            if not g.exists():
+                break
+            gens.append(g)
+            i += 1
+        paths.extend(reversed(gens))
+    if p.exists():
+        paths.append(p)
+    out: list[dict] = []
+    for fp in paths:
+        for n, line in enumerate(fp.read_text().splitlines(), 1):
+            if not line.strip():
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError as e:
+                raise ValueError(f"{fp}:{n}: unparseable event line: {e}")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Process-global log.  Same torn-read argument as faults._PLAN: installed
+# before the workload starts; a reader sees None or a whole EventLog.
+
+_LOG: EventLog | None = None
+
+
+def configure(path, *, max_bytes: int = 8 << 20,
+              keep: int = 2) -> EventLog:
+    """Install the process-global event log (returns it)."""
+    global _LOG
+    if _LOG is not None:
+        _LOG.close()
+    _LOG = EventLog(path, max_bytes=max_bytes, keep=keep)
+    return _LOG
+
+
+def install_from_env(env: dict | None = None) -> EventLog | None:
+    """Honor ``PCTPU_OBS_EVENTS=<path>`` if set (else no-op).  Entry
+    points (serve.py, loadgen, soak, run_supervised) call this once at
+    boot so child processes inherit the timeline via the env."""
+    env = os.environ if env is None else env
+    path = env.get(EVENTS_ENV, "").strip()
+    if not path:
+        return None
+    return configure(path)
+
+
+def deconfigure() -> None:
+    global _LOG
+    if _LOG is not None:
+        _LOG.close()
+    _LOG = None
+
+
+def get_log() -> EventLog | None:
+    return _LOG
+
+
+def emit(kind: str, **fields) -> None:
+    """Emit to the process-global log — free when none is installed or
+    obs is disabled (one load + one test, the fault_point contract)."""
+    log = _LOG
+    if log is None or not _metrics.enabled():
+        return
+    log.emit(kind, **fields)

@@ -23,6 +23,7 @@ bit-identical to the serial oracle for any mesh shape.
 
 from __future__ import annotations
 
+import time
 from functools import lru_cache
 
 import jax
@@ -31,6 +32,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from parallel_convolution_tpu.obs import events as obs_events, metrics as obs_metrics
 from parallel_convolution_tpu.ops import conv
 from parallel_convolution_tpu.ops.filters import Filter
 from parallel_convolution_tpu.parallel import halo
@@ -49,6 +51,37 @@ from parallel_convolution_tpu.utils.jax_compat import shard_map
 
 __all__ = ["BACKENDS", "STORAGE_DTYPES", "sharded_iterate", "sharded_converge",
            "iterate_prepared", "reshard_prepared"]
+
+
+def _note_compile(builder: str, backend: str, grid, iters: int, fuse: int,
+                  boundary: str, block_hw) -> None:
+    """Telemetry for one fresh trace/compile (a build-cache miss): the
+    ``compile`` event + a labeled counter.  One branch when obs is off."""
+    if not obs_metrics.enabled():
+        return
+    obs_metrics.counter(
+        "pctpu_compiles_total", "fresh traces/compiles of iteration runners",
+        ("builder", "backend")).inc(builder=builder, backend=backend)
+    obs_events.emit(
+        "compile", builder=builder, backend=backend,
+        grid=f"{grid[0]}x{grid[1]}", iters=int(iters), fuse=int(fuse),
+        boundary=boundary, block=[int(b) for b in block_hw])
+
+
+def _record_step_obs(backend: str, mesh: Mesh, block_hw, radius: int,
+                     fuse: int, iters: int, channels: int, storage: str,
+                     boundary: str, wall_s: float | None, shape,
+                     quantize: bool, tile, source: str) -> None:
+    from parallel_convolution_tpu.obs import attribution
+
+    grid = grid_shape(mesh)
+    dev0 = mesh.devices.flat[0]
+    attribution.record_step(
+        backend=backend, grid=grid, block_hw=block_hw, radius=radius,
+        fuse=fuse, iters=iters, channels=channels, storage=storage,
+        boundary=boundary, wall_s=wall_s, shape=shape, quantize=quantize,
+        tile=tile, platform=dev0.platform,
+        device_kind=getattr(dev0, "device_kind", "") or "", source=source)
 
 
 def _valid_mask(valid_hw, block_hw, margin: int = 0):
@@ -238,6 +271,7 @@ def _build_iterate(mesh: Mesh, filt: Filter, iters: int, quantize: bool,
         raise ValueError(
             f"fuse={fuse} needs blocks >= {filt.radius * fuse}, got {block_hw}"
         )
+    _note_compile("iterate", backend, grid, iters, fuse, boundary, block_hw)
     interp = _mesh_interpret(mesh)
     chunk = _make_block_step(filt, grid, valid_hw, block_hw, quantize,
                              backend, fuse, boundary, tile, interp,
@@ -294,6 +328,8 @@ def _build_converge(mesh: Mesh, filt: Filter, tol: float, max_iters: int,
             f"fuse={fuse} needs blocks >= {filt.radius * fuse}, got "
             f"{block_hw}{clamp_note}"
         )
+    _note_compile("converge", backend, grid, max_iters, fuse, boundary,
+                  block_hw)
     interp = _mesh_interpret(mesh)
     step = _make_block_step(filt, grid, valid_hw, block_hw, quantize, backend,
                             boundary=boundary, tile=tile, interpret=interp)
@@ -577,7 +613,22 @@ def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
     fn = _build_iterate(mesh, filt, iters, quantize, tuple(valid_hw),
                         block_hw, backend, fuse, boundary, _norm_tile(tile),
                         interior_split)
-    return fn(xs)
+    if not obs_metrics.enabled():
+        return fn(xs)
+    # Observed mode: attribute halo bytes/rounds and emit the exchange
+    # event.  NO wall and NO fence: this entry dispatches asynchronously
+    # (callers overlap the next chunk's work with device execution), and
+    # adding a block_until_ready here would silently serialize them —
+    # wall-based series come from the callers that already fence (bench,
+    # serving, the convergence count readback).
+    channels, shape = xs.shape[0], tuple(xs.shape)
+    out = fn(xs)
+    _record_step_obs(backend, mesh, block_hw, filt.radius,
+                     max(1, min(fuse, iters or 1)), iters, channels,
+                     _storage_name(out.dtype), boundary, None, shape,
+                     quantize, _norm_tile(tile),
+                     source="iterate_prepared")
+    return out
 
 
 def sharded_iterate(x, filt: Filter, iters: int, mesh: Mesh | None = None,
@@ -647,5 +698,14 @@ def sharded_converge(x, filt: Filter, tol: float, max_iters: int,
                          int(check_every), quantize, valid_hw, block_hw,
                          backend, boundary, int(fuse), _norm_tile(tile),
                          interior_split)
+    channels, shape = xs.shape[0], tuple(xs.shape)
+    t0 = time.perf_counter()
     out, done = fn(xs)
-    return out[:, : valid_hw[0], : valid_hw[1]].astype(jnp.float32), int(done)
+    done = int(done)  # materializes the run (the convergence count)
+    if obs_metrics.enabled():
+        _record_step_obs(backend, mesh, block_hw, filt.radius,
+                         max(1, min(int(fuse), max(1, check_every - 1))),
+                         done, channels, storage, boundary,
+                         time.perf_counter() - t0, shape, quantize,
+                         _norm_tile(tile), source="sharded_converge")
+    return out[:, : valid_hw[0], : valid_hw[1]].astype(jnp.float32), done

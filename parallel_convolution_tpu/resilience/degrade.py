@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import warnings
 
+from parallel_convolution_tpu.obs import events as obs_events, metrics as obs_metrics
 from parallel_convolution_tpu.ops.filters import Filter
 from parallel_convolution_tpu.resilience.retry import (
     TERMINAL, RetryExhausted, classify,
@@ -178,12 +179,21 @@ def resolve_backend(mesh, filt: Filter, backend: str, *, quantize: bool = True,
                 raise
             last = e
             continue
-        if b != backend and warn:
-            warnings.warn(
-                f"backend {backend!r} degraded to {b!r} after transient "
-                f"failure: {last!r}",
-                BackendDegradedWarning, stacklevel=2,
-            )
+        if b != backend:
+            if warn:
+                warnings.warn(
+                    f"backend {backend!r} degraded to {b!r} after transient "
+                    f"failure: {last!r}",
+                    BackendDegradedWarning, stacklevel=2,
+                )
+            if obs_metrics.enabled():
+                obs_metrics.counter(
+                    "pctpu_degrades_total",
+                    "backend degradation walks that resolved a lower tier",
+                    ("requested", "effective")).inc(
+                    requested=backend, effective=b)
+                obs_events.emit("degrade", requested=backend, effective=b,
+                                cause=repr(last)[:200])
         _LAST_RESOLVED[backend] = b
         return b
     raise RetryExhausted(

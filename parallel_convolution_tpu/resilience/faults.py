@@ -112,6 +112,19 @@ class FaultPlan:
             if fire:
                 self._fired.append((site, hit))
         if fire:
+            # Telemetry before the raise: the timeline must show the
+            # trigger even when the fault kills the workload.  obs is
+            # stdlib-only and import-light, preserving this module's
+            # cheap-to-import contract.
+            from parallel_convolution_tpu.obs import events, metrics
+
+            if metrics.enabled():
+                metrics.counter(
+                    "pctpu_faults_fired_total",
+                    "injected faults that actually raised, per site",
+                    ("site",)).inc(site=site)
+                events.emit("fault_trigger", site=site, hit=hit,
+                            transient=not rule.terminal)
             raise InjectedFault(site, hit, transient=not rule.terminal)
 
     @property

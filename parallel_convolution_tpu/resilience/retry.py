@@ -32,6 +32,7 @@ import dataclasses
 import random
 import time
 
+from parallel_convolution_tpu.obs import events as obs_events, metrics as obs_metrics
 from parallel_convolution_tpu.resilience.faults import InjectedFault
 
 TRANSIENT = "transient"
@@ -154,6 +155,13 @@ def with_retry(fn, policy: RetryPolicy | None = None, *,
             if attempt == policy.max_attempts:
                 break
             d = policy.delay(attempt, rng)
+            if obs_metrics.enabled():
+                obs_metrics.counter(
+                    "pctpu_retries_total",
+                    "transient failures healed by with_retry backoff",
+                    ("error",)).inc(error=type(e).__name__)
+                obs_events.emit("retry", attempt=attempt,
+                                error=repr(e)[:200], delay_s=round(d, 4))
             if on_retry is not None:
                 on_retry(attempt, e, d)
             sleep(d)

@@ -26,10 +26,37 @@ import subprocess
 import time
 from pathlib import Path
 
+from parallel_convolution_tpu.obs import events as obs_events, metrics as obs_metrics
 from parallel_convolution_tpu.resilience.retry import RetryPolicy
 
 HALT_NAME = "HALT"
 LEDGER_NAME = "status.json"
+
+# status.json schema (round 11): 2 adds `schema_version` itself plus a
+# `heartbeat`/`heartbeat_unix` pair refreshed between leg polls — an
+# external watcher can now tell "running" (heartbeat advancing) from
+# "hung" (stale heartbeat, no state change).  Readers must tolerate
+# version-1 ledgers without the fields (:func:`read_ledger`).
+LEDGER_SCHEMA = 2
+
+
+def read_ledger(path) -> dict:
+    """Parse a supervisor ledger, filling pre-round-11 defaults.
+
+    Old ledgers (no ``schema_version``) read as version 1 with the
+    heartbeat falling back to ``updated`` (the best liveness signal they
+    carried).  Raises on missing/unparseable files — a watcher must see
+    the difference between "no ledger yet" and "ledger says X".
+    """
+    data = json.loads(Path(path).read_text())
+    if not isinstance(data, dict):
+        raise ValueError(f"ledger {path} is not a JSON object")
+    data.setdefault("schema_version", 1)
+    data.setdefault("heartbeat", data.get("updated"))
+    data.setdefault("heartbeat_unix", None)
+    data.setdefault("legs", {})
+    data.setdefault("halt", None)
+    return data
 
 
 @dataclasses.dataclass
@@ -99,7 +126,7 @@ class Supervisor:
 
     def __init__(self, legs: list[Leg], state_dir, *,
                  policy: RetryPolicy | None = None, sleep=time.sleep,
-                 log=None):
+                 log=None, heartbeat_every: float = 5.0):
         self.legs = list(legs)
         names = [leg.name for leg in self.legs]
         if len(set(names)) != len(names):
@@ -109,7 +136,11 @@ class Supervisor:
                                             max_delay=240.0)
         self._sleep = sleep
         self._log = log or (lambda msg: print(msg, flush=True))
-        self._status: dict = {"legs": {}, "halt": None}
+        # How often the attempt loop re-stamps the ledger heartbeat while
+        # a leg subprocess runs (the running-vs-hung watcher signal).
+        self.heartbeat_every = max(0.1, float(heartbeat_every))
+        self._status: dict = {"schema_version": LEDGER_SCHEMA,
+                              "legs": {}, "halt": None}
 
     # -- ledger ------------------------------------------------------------
     @property
@@ -120,12 +151,38 @@ class Supervisor:
     def ledger_path(self) -> Path:
         return self.state_dir / LEDGER_NAME
 
-    def _write_ledger(self) -> None:
-        self._status["updated"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
-                                                time.gmtime())
+    def _flush_ledger(self) -> None:
         tmp = self.ledger_path.with_suffix(".json.tmp")
         tmp.write_text(json.dumps(self._status, indent=2))
         os.replace(tmp, self.ledger_path)
+
+    def _stamp_heartbeat(self) -> None:
+        self._status["heartbeat"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                  time.gmtime())
+        self._status["heartbeat_unix"] = round(time.time(), 3)
+
+    def _write_ledger(self) -> None:
+        self._status["updated"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                time.gmtime())
+        self._stamp_heartbeat()
+        self._flush_ledger()
+
+    def _touch_heartbeat(self, leg_name: str = "") -> None:
+        """Refresh ONLY the liveness pair mid-attempt: ``updated`` keeps
+        meaning 'last state change', heartbeat means 'supervisor alive'.
+
+        Best-effort by contract: this runs INSIDE the attempt poll loop
+        while a leg subprocess is alive, so an I/O failure here (ENOSPC,
+        state dir pruned) must never bubble into the attempt handling —
+        it would misclassify a running leg and leak/duplicate the child."""
+        self._stamp_heartbeat()
+        try:
+            self._flush_ledger()
+            if obs_metrics.enabled():
+                obs_events.emit("heartbeat", leg=leg_name,
+                                unix=self._status["heartbeat_unix"])
+        except OSError:
+            pass
 
     def _leg_status(self, leg: Leg) -> dict:
         return self._status["legs"].setdefault(
@@ -134,7 +191,12 @@ class Supervisor:
     # -- execution ---------------------------------------------------------
     def _attempt(self, leg: Leg,
                  extra_env: dict | None = None) -> tuple[int | None, str]:
-        """One subprocess attempt; returns (rc or None on timeout, text)."""
+        """One subprocess attempt; returns (rc or None on timeout, text).
+
+        The wait is sliced into ``heartbeat_every`` polls, the ledger
+        heartbeat re-stamped between polls — a watcher reading
+        ``status.json`` can distinguish a long-running leg (heartbeat
+        advancing) from a hung supervisor (heartbeat frozen)."""
         out = self.state_dir / f"{leg.name}.out"
         err = self.state_dir / f"{leg.name}.err"
         env = dict(os.environ)
@@ -142,15 +204,42 @@ class Supervisor:
             env.update({k: str(v) for k, v in leg.env.items()})
         if extra_env:
             env.update({k: str(v) for k, v in extra_env.items()})
+        p = None
         try:
             with open(out, "wb") as fo, open(err, "wb") as fe:
-                p = subprocess.run(leg.cmd, stdout=fo, stderr=fe,
-                                   timeout=leg.timeout, env=env)
-            rc = p.returncode
-        except subprocess.TimeoutExpired:
-            rc = None
-        except OSError as e:  # unrunnable cmd: surface in the ledger
-            err.write_bytes(repr(e).encode())
+                p = subprocess.Popen(leg.cmd, stdout=fo, stderr=fe, env=env)
+                deadline = (time.monotonic() + leg.timeout
+                            if leg.timeout is not None else None)
+                while True:
+                    slice_s = self.heartbeat_every
+                    if deadline is not None:
+                        slice_s = min(slice_s,
+                                      max(0.0, deadline - time.monotonic()))
+                    try:
+                        rc = p.wait(timeout=slice_s)
+                        break
+                    except subprocess.TimeoutExpired:
+                        if (deadline is not None
+                                and time.monotonic() >= deadline):
+                            p.kill()
+                            p.wait()
+                            rc = None
+                            break
+                        # Best-effort (swallows its own I/O errors): a
+                        # failing heartbeat must not reach the handler
+                        # below while the child is alive.
+                        self._touch_heartbeat(leg.name)
+        except OSError as e:  # unrunnable cmd / capture-file failure
+            if p is not None and p.poll() is None:
+                # Never leak a live child into a "failed" attempt — the
+                # retry would double-execute the leg against the same
+                # checkpoint/evidence files.
+                p.kill()
+                p.wait()
+            try:
+                err.write_bytes(repr(e).encode())
+            except OSError:
+                pass
             rc = -1
         text = ""
         for p_ in (out, err):
@@ -177,7 +266,16 @@ class Supervisor:
             live = None
         return elastic.next_fit([str(s) for s in leg.meshes], idx + 1, live)
 
+    def _leg_event(self, leg: Leg, state: str, **fields) -> None:
+        if obs_metrics.enabled():
+            obs_metrics.counter(
+                "pctpu_supervisor_legs_total",
+                "supervisor leg state transitions",
+                ("state",)).inc(state=state)
+            obs_events.emit("leg", leg=leg.name, state=state, **fields)
+
     def _halt(self, leg: Leg, reason: str) -> None:
+        self._leg_event(leg, "terminal", reason=reason)
         self._status["halt"] = {"leg": leg.name, "reason": reason}
         self.halt_path.write_text(
             f"leg {leg.name}: {reason}\n"
@@ -237,6 +335,7 @@ class Supervisor:
                     st["completed_at"] = time.strftime(
                         "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
                     self._write_ledger()
+                    self._leg_event(leg, "done", attempt=attempt)
                     self._log(f"supervisor: leg {leg.name!r} complete "
                               f"(attempt {attempt})")
                     done = True
@@ -252,6 +351,9 @@ class Supervisor:
                     # probes just step down one rung).
                     mesh_idx = self._next_mesh_idx(leg, mesh_idx)
                     st["reshapes"] = st.get("reshapes", 0) + 1
+                    self._leg_event(leg, "reshape",
+                                    mesh=str(leg.meshes[mesh_idx]),
+                                    attempt=attempt)
                     self._log(
                         f"supervisor: leg {leg.name!r} hit device-loss "
                         f"pattern; reshaping onto "
@@ -266,6 +368,8 @@ class Supervisor:
             if not done:
                 st["state"] = "exhausted"
                 self._write_ledger()
+                self._leg_event(leg, "exhausted",
+                                attempts=self.policy.max_attempts)
                 self._log(f"supervisor: leg {leg.name!r} exhausted "
                           f"{self.policy.max_attempts} attempts; continuing")
                 exhausted = True

@@ -31,6 +31,8 @@ import threading
 import time
 from collections import deque
 
+from parallel_convolution_tpu.obs import metrics as obs_metrics
+
 __all__ = ["MicroBatcher", "Slot"]
 
 
@@ -90,8 +92,15 @@ class MicroBatcher:
         self._pending: deque[_Item] = deque()
         self._closed = False
         self._worker: threading.Thread | None = None
-        self.stats = {"enqueued": 0, "refused": 0, "flushes": 0,
-                      "flushed_items": 0, "max_observed_depth": 0}
+        # Legacy stats dict as a view over the obs registry
+        # (pctpu_batcher_stats{key=...}); dict semantics unchanged.
+        self.stats = obs_metrics.MirroredStats(obs_metrics.gauge(
+            "pctpu_batcher_stats", "micro-batcher queue/flush counters",
+            ("key",)), initial={
+            "enqueued": 0, "refused": 0, "flushes": 0,
+            "flushed_items": 0, "max_observed_depth": 0})
+        self._depth_gauge = obs_metrics.gauge(
+            "pctpu_queue_depth", "pending requests in the batcher queue")
         if start:
             self.start()
 
@@ -108,6 +117,7 @@ class MicroBatcher:
             self.stats["enqueued"] += 1
             self.stats["max_observed_depth"] = max(
                 self.stats["max_observed_depth"], len(self._pending))
+            self._depth_gauge.set(len(self._pending))
             self._cv.notify_all()
         return item.slot
 
@@ -165,6 +175,7 @@ class MicroBatcher:
             self._pending = rest
             self.stats["flushes"] += 1
             self.stats["flushed_items"] += len(batch)
+            self._depth_gauge.set(len(self._pending))
             self._cv.notify_all()
             return head.key, batch
 

@@ -48,6 +48,7 @@ from collections import OrderedDict
 
 import numpy as np
 
+from parallel_convolution_tpu.obs import events as obs_events, metrics as obs_metrics
 from parallel_convolution_tpu.ops.filters import get_filter
 from parallel_convolution_tpu.utils.config import (
     BACKENDS, BOUNDARIES, STORAGES,
@@ -110,16 +111,19 @@ class _Entry:
     """One warm key: resolved backend + compiled runners per batch size."""
 
     __slots__ = ("key", "effective_backend", "fns", "lock", "plan_source",
-                 "predicted_gpx")
+                 "predicted_gpx", "plan_key")
 
     def __init__(self, key: EngineKey, effective_backend: str,
                  plan_source: str = "explicit",
-                 predicted_gpx: float | None = None):
+                 predicted_gpx: float | None = None,
+                 plan_key: str = ""):
         self.key = key
         self.effective_backend = effective_backend
         self.plan_source = plan_source       # explicit|measured|
         #                                      interpolated|predicted
         self.predicted_gpx = predicted_gpx   # cost-model Gpx/s/chip
+        self.plan_key = plan_key             # tuning canonical key: the
+        #                                      drift series' label
         self.fns: dict[int, object] = {}   # batch size -> jitted runner
         self.lock = threading.Lock()       # per-batch-size build flight
 
@@ -159,11 +163,15 @@ class WarmEngine:
         # Resolution provenance per auto-resolved key (stamped into the
         # entry at build time; explicit keys default to 'explicit').
         self._plan_sources: dict[EngineKey, str] = {}
-        self.stats = {
+        # The legacy stats dict, now a view over the obs registry: every
+        # write mirrors into pctpu_engine_stats{key=...} (obs.metrics).
+        self.stats = obs_metrics.MirroredStats(obs_metrics.gauge(
+            "pctpu_engine_stats", "warm-engine cache/execution counters",
+            ("key",)), initial={
             "hits": 0, "misses": 0, "compiles": 0, "evictions": 0,
             "single_flight_waits": 0, "batches": 0, "images": 0,
             "reshapes": 0,
-        }
+        })
 
     def grid(self) -> tuple[int, int]:
         from parallel_convolution_tpu.parallel.mesh import grid_shape
@@ -212,10 +220,16 @@ class WarmEngine:
                 warnings.warn(
                     f"reshape: key {k.filter_name}/{k.shape} has no home "
                     f"on grid {new_grid}: {e}", stacklevel=2)
-        return {
+        info = {
             "old_grid": old_grid, "grid": new_grid,
             "rewarmed": len(rewarmed), "skipped": len(skipped),
         }
+        if obs_metrics.enabled():
+            obs_events.emit(
+                "reshape", old_grid=f"{old_grid[0]}x{old_grid[1]}",
+                grid=f"{new_grid[0]}x{new_grid[1]}",
+                rewarmed=info["rewarmed"], skipped=info["skipped"])
+        return info
 
     # -- key construction ---------------------------------------------------
     def resolve_key(self, shape, **kw) -> tuple[EngineKey, str]:
@@ -356,16 +370,16 @@ class WarmEngine:
         from parallel_convolution_tpu.tuning import costmodel, search
         from parallel_convolution_tpu.tuning.plans import Workload
 
-        predicted = costmodel.predict_gpx_per_chip(search.predict(
-            Workload.from_mesh(self.mesh, get_filter(key.filter_name),
+        w = Workload.from_mesh(self.mesh, get_filter(key.filter_name),
                                key.shape, storage=key.storage,
                                quantize=key.quantize,
-                               boundary=key.boundary),
-            search.Candidate(effective, key.fuse, key.tile)))
+                               boundary=key.boundary)
+        predicted = costmodel.predict_gpx_per_chip(search.predict(
+            w, search.Candidate(effective, key.fuse, key.tile)))
         with self._lock:
             source = self._plan_sources.get(key, "explicit")
         entry = _Entry(key, effective, plan_source=source,
-                       predicted_gpx=round(predicted, 3))
+                       predicted_gpx=round(predicted, 3), plan_key=w.key())
         self._compile_batch(entry, 1)
         return entry
 
@@ -457,9 +471,15 @@ class WarmEngine:
             xs, valid_hw, _ = step_lib._prepare(
                 folded, self.mesh, filt.radius, key.storage)
             jax.block_until_ready(xs)
+        # The timer is shared across retry ATTEMPTS (the service re-invokes
+        # run_batch with it), so telemetry must charge only THIS call's
+        # device delta — a retried batch's drift/exchange series would
+        # otherwise include the failed attempt's wall.
+        dev_before = t.wall("device")
         with t.phase("device"):
             out = fn(xs)
             jax.block_until_ready(out)
+        dev_s = t.wall("device") - dev_before
         with t.phase("copy_out"):
             out = np.asarray(
                 out[:, : valid_hw[0], : valid_hw[1]].astype(jnp.float32))
@@ -467,6 +487,8 @@ class WarmEngine:
         with self._lock:
             self.stats["batches"] += 1
             self.stats["images"] += B
+        if obs_metrics.enabled():
+            self._record_batch_obs(entry, B, filt, dev_s)
         info = {
             "effective_backend": entry.effective_backend,
             "effective_grid": f"{key.grid[0]}x{key.grid[1]}",
@@ -478,6 +500,31 @@ class WarmEngine:
                                     "copy_out")},
         }
         return out, info
+
+    def _record_batch_obs(self, entry: _Entry, B: int, filt,
+                          dev_s: float) -> None:
+        """Per-batch telemetry: halo/exchange attribution for THIS call's
+        device wall plus the predicted-vs-measured drift series per plan
+        key — the recalibration input ROADMAP item 5a consumes."""
+        from parallel_convolution_tpu.obs import attribution
+
+        key = entry.key
+        C, H, W = key.shape
+        dev0 = self.mesh.devices.flat[0]
+        attribution.record_step(
+            backend=entry.effective_backend, grid=key.grid,
+            block_hw=self._block_hw(key), radius=filt.radius,
+            fuse=max(1, min(key.fuse, key.iters)), iters=key.iters,
+            channels=B * C, storage=key.storage, boundary=key.boundary,
+            wall_s=dev_s, shape=(B * C, H, W), quantize=key.quantize,
+            tile=key.tile, platform=dev0.platform,
+            device_kind=getattr(dev0, "device_kind", "") or "",
+            source="serving")
+        if dev_s > 0:
+            attribution.record_drift(
+                entry.plan_key, entry.effective_backend,
+                entry.predicted_gpx,
+                B * C * H * W * key.iters / dev_s / self.mesh.size / 1e9)
 
     # -- introspection ------------------------------------------------------
     def snapshot(self) -> dict:

@@ -26,8 +26,12 @@ Wire format (POST ``/v1/convolve``)::
             "rejected": "queue_full"|"deadline"|"error"|"resharding", ...}
 
 ``GET /healthz`` returns ``{"ok": true}`` plus the service snapshot;
-``GET /stats`` returns the snapshot alone.  Rejections map to HTTP 429
-(load shed — retryable by the client) except contract errors (400).
+``GET /stats`` returns the snapshot alone; ``GET /metrics`` serves the
+process-global obs registry in Prometheus text exposition format 0.0.4
+(round 11 — the pull endpoint the stack never had; with ``PCTPU_OBS=0``
+it serves a comment noting obs is disabled, still a valid exposition).
+Rejections map to HTTP 429 (load shed — retryable by the client) except
+contract errors (400).
 """
 
 from __future__ import annotations
@@ -37,12 +41,22 @@ import json
 
 import numpy as np
 
+from parallel_convolution_tpu.obs import metrics as obs_metrics
 from parallel_convolution_tpu.serving.service import (
     ConvolutionService, Rejected, Request, Response,
 )
 
 __all__ = ["InProcessClient", "decode_request", "encode_response",
-           "make_http_server"]
+           "make_http_server", "metrics_text"]
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def metrics_text() -> str:
+    """The /metrics body: one renderer for both transports."""
+    if not obs_metrics.enabled():
+        return "# PCTPU_OBS disabled\n"
+    return obs_metrics.render_text()
 
 _REJECT_STATUS = {"invalid": 400, "queue_full": 429, "deadline": 429,
                   "error": 429, "resharding": 429, "timeout": 504}
@@ -135,6 +149,10 @@ class InProcessClient:
     def stats(self) -> tuple[int, dict]:
         return 200, self.service.snapshot()
 
+    def metrics(self) -> tuple[int, str]:
+        """The Prometheus text exposition (socket-free surface)."""
+        return 200, metrics_text()
+
 
 def make_http_server(service: ConvolutionService, host: str = "127.0.0.1",
                      port: int = 8080):
@@ -166,6 +184,13 @@ def make_http_server(service: ConvolutionService, host: str = "127.0.0.1",
                 self._send(*client.healthz())
             elif self.path == "/stats":
                 self._send(*client.stats())
+            elif self.path == "/metrics":
+                data = metrics_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", PROM_CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
             else:
                 self._send(404, {"ok": False, "detail": "unknown path"})
 

@@ -29,6 +29,7 @@ import time
 
 import numpy as np
 
+from parallel_convolution_tpu.obs import events as obs_events, metrics as obs_metrics
 from parallel_convolution_tpu.serving.batcher import MicroBatcher
 from parallel_convolution_tpu.serving.engine import EngineKey, WarmEngine
 from parallel_convolution_tpu.utils.tracing import PhaseTimer
@@ -120,18 +121,38 @@ class ConvolutionService:
         self._lock = threading.Lock()
         self._reshape_lock = threading.Lock()
         self._reshaping = False
-        self.stats = {
+        # The legacy stats dict, now a view over the obs registry: every
+        # write mirrors into pctpu_service_stats{key=...} (obs.metrics),
+        # so the admission-control ledger is one /metrics scrape away.
+        self.stats = obs_metrics.MirroredStats(obs_metrics.gauge(
+            "pctpu_service_stats", "service admission/completion counters",
+            ("key",)), initial={
             "submitted": 0, "completed": 0, "retries": 0,
             "rejected_queue_full": 0, "rejected_deadline": 0,
             "rejected_invalid": 0, "rejected_error": 0,
             "rejected_resharding": 0, "client_timeouts": 0,
             "reshapes": 0,
-        }
+        })
 
     # -- admission -----------------------------------------------------------
     def _bump(self, counter: str, n: int = 1) -> None:
         with self._lock:
             self.stats[counter] += n
+
+    def _shed(self, reason: str, rid: str, detail: str = "",
+              counter: str | None = None, n: int = 1) -> Rejected:
+        """One path for every typed rejection: the legacy counter bump,
+        the admission event, and the Rejected value."""
+        if counter is not None:
+            self._bump(counter, n)
+        if obs_metrics.enabled():
+            obs_metrics.counter(
+                "pctpu_admission_total",
+                "typed request outcomes at the admission boundary",
+                ("outcome",)).inc(n, outcome=reason)
+            obs_events.emit("admission", outcome=reason, request_id=rid,
+                            detail=detail[:200])
+        return Rejected(reason, rid, detail=detail)
 
     def _validate(self, req: Request) -> tuple[EngineKey, str, np.ndarray]:
         """Terminal ValueError on any contract violation (→ ``invalid``).
@@ -182,23 +203,24 @@ class ConvolutionService:
         if self._reshaping:
             # The mesh is being swapped under us: shed with a typed,
             # retryable reason (the window is one drain + re-warm long).
-            self._bump("rejected_resharding")
-            return Rejected("resharding", rid,
-                            detail="mesh reshape in progress; retry")
+            return self._shed("resharding", rid,
+                              detail="mesh reshape in progress; retry",
+                              counter="rejected_resharding")
         try:
             key, plan_source, planar = self._validate(req)
         except Exception as e:  # noqa: BLE001 — contract errors are typed
-            self._bump("rejected_invalid")
-            return Rejected("invalid", rid, detail=str(e))
+            return self._shed("invalid", rid, detail=str(e),
+                              counter="rejected_invalid")
         deadline_at = (time.monotonic() + req.deadline_s
                        if req.deadline_s is not None else None)
         payload = {"planar": planar, "rid": rid, "rgb": req.image.ndim == 3,
                    "backend": req.backend, "plan_source": plan_source}
         slot = self.batcher.try_submit(key, payload, deadline_at)
         if slot is None:
-            self._bump("rejected_queue_full")
-            return Rejected("queue_full", rid,
-                            detail=f"queue depth >= {self.batcher.max_queue}")
+            return self._shed(
+                "queue_full", rid,
+                detail=f"queue depth >= {self.batcher.max_queue}",
+                counter="rejected_queue_full")
         if not wait:
             return slot
         result = slot.result(timeout)
@@ -207,8 +229,9 @@ class ConvolutionService:
             # request may still be executing (and will later count as
             # completed).  Distinct reason + counter so an unresponsive
             # service can never reconcile as healthy load shedding.
-            self._bump("client_timeouts")
-            return Rejected("timeout", rid, detail="client wait timed out")
+            return self._shed("timeout", rid,
+                              detail="client wait timed out",
+                              counter="client_timeouts")
         return result
 
     # -- execution (batcher worker thread) ------------------------------------
@@ -220,11 +243,11 @@ class ConvolutionService:
         live = []
         for it in items:
             if it.deadline_at is not None and start > it.deadline_at:
-                self._bump("rejected_deadline")
-                it.slot.set(Rejected(
+                it.slot.set(self._shed(
                     "deadline", it.payload["rid"],
                     detail=f"queued {start - it.enqueued_at:.3f}s past "
-                           "deadline"))
+                           "deadline",
+                    counter="rejected_deadline"))
             else:
                 live.append(it)
         if not live:
@@ -235,11 +258,11 @@ class ConvolutionService:
             # the post-swap batcher.  Shed it typed-and-retryable — the
             # stale-grid ValueError in run_batch must stay a caller-bug
             # backstop, never a client-visible "error".
-            self._bump("rejected_resharding", len(live))
             for it in live:
-                it.slot.set(Rejected(
+                it.slot.set(self._shed(
                     "resharding", it.payload["rid"],
-                    detail="mesh resharded while queued; retry"))
+                    detail="mesh resharded while queued; retry",
+                    counter="rejected_resharding"))
             return
         stacked = np.stack([it.payload["planar"] for it in live])
         timer = PhaseTimer()
@@ -254,10 +277,10 @@ class ConvolutionService:
             out, info = with_retry(attempt, self.retry_policy,
                                    on_retry=on_retry)
         except Exception as e:  # noqa: BLE001 — typed result, never a hang
-            self._bump("rejected_error", len(live))
             for it in live:
-                it.slot.set(Rejected("error", it.payload["rid"],
-                                     detail=repr(e)[:500]))
+                it.slot.set(self._shed("error", it.payload["rid"],
+                                       detail=repr(e)[:500],
+                                       counter="rejected_error"))
             return
         phases = dict(info["phases"])
         u8 = np.clip(np.rint(out), 0.0, 255.0).astype(np.uint8)
@@ -286,6 +309,22 @@ class ConvolutionService:
                 effective_grid=info.get("effective_grid", ""),
             ))
             self._bump("completed")
+            if obs_metrics.enabled():
+                ph = obs_metrics.histogram(
+                    "pctpu_request_phase_seconds",
+                    "per-request serving latency by phase",
+                    ("phase", "backend"))
+                eff = info["effective_backend"]
+                for name, v in per.items():
+                    ph.observe(v, phase=name, backend=eff)
+                obs_metrics.counter(
+                    "pctpu_admission_total",
+                    "typed request outcomes at the admission boundary",
+                    ("outcome",)).inc(outcome="completed")
+        if obs_metrics.enabled():
+            obs_metrics.histogram(
+                "pctpu_batch_size", "co-batched requests per flush", (),
+                buckets=(1, 2, 4, 8, 16, 32, 64)).observe(len(live))
 
     # -- elastic recovery ----------------------------------------------------
     def reshape(self, mesh) -> dict:

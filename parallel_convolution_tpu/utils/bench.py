@@ -245,10 +245,45 @@ def bench_iterate(
     if effective == "pallas_rdma" and not costmodel.rdma_is_tiled(
             (channels, H, W), block_hw, filt.radius, compiled_fuse, storage):
         compiled_tile = None  # monolithic kernel: no output tile exists
+    w = Workload.from_mesh(mesh, filt, (channels, H, W), storage=storage,
+                           quantize=quantize, boundary=boundary)
     predicted = costmodel.predict_gpx_per_chip(search.predict(
-        Workload.from_mesh(mesh, filt, (channels, H, W), storage=storage,
-                           quantize=quantize, boundary=boundary),
-        search.Candidate(effective, compiled_fuse, compiled_tile)))
+        w, search.Candidate(effective, compiled_fuse, compiled_tile)))
+    # Exchange/overlap attribution (obs.attribution): the analytic
+    # per-direction ghost-band bytes of this decomposition and the
+    # roofline model's exchange share — the per-phase instrumentation
+    # the overlapped-halo and topology roadmap items are judged against.
+    grid = grid_shape(mesh)
+    from parallel_convolution_tpu.obs import attribution
+
+    # record_step feeds the metric series AND returns the attribution
+    # this row stamps; with obs disabled it returns None and the row's
+    # analytic fields are computed directly (pure math, always on).
+    att = attribution.record_step(
+        backend=effective, grid=grid, block_hw=block_hw,
+        radius=filt.radius, fuse=compiled_fuse, iters=iters,
+        channels=channels, storage=storage, boundary=boundary,
+        wall_s=secs, shape=(channels, H, W), quantize=quantize,
+        tile=compiled_tile, platform=dev0.platform,
+        device_kind=getattr(dev0, "device_kind", "") or "",
+        source="bench")
+    if att is None:
+        att = {
+            "halo_bytes": attribution.halo_bytes_total(
+                grid, block_hw, filt.radius, compiled_fuse, iters,
+                channels, storage, boundary),
+            "exchange_fraction": attribution.predicted_exchange_fraction(
+                grid, block_hw, filt.radius, compiled_fuse,
+                backend=effective, storage=storage,
+                shape=(channels, H, W), tile=compiled_tile,
+                quantize=quantize,
+                separable=effective in ("separable", "pallas_sep"),
+                platform=dev0.platform,
+                device_kind=getattr(dev0, "device_kind", "") or ""),
+        }
+    # The drift series (ROADMAP 5a's recalibration input): the bench
+    # measurement against the model's figure, per plan key.
+    attribution.record_drift(w.key(), effective, predicted, gpx / n_dev)
     return {
         "workload": f"{filt.name} {H}x{W}x{channels} {iters} iters",
         "backend": backend,
@@ -266,11 +301,16 @@ def bench_iterate(
                  if compiled_tile else None),
         "plan_source": plan_source,
         "predicted_gpx_per_chip": round(predicted, 3),
-        "mesh": "x".join(str(s) for s in grid_shape(mesh)),
+        "mesh": "x".join(str(s) for s in grid),
         "devices": n_dev,
         "wall_s": round(secs, 4),
         "gpixels_per_s": round(gpx, 3),
         "gpixels_per_s_per_chip": round(gpx / n_dev, 3),
+        # Exchange attribution: the model's exchange share of one
+        # iteration and the analytic ghost-band bytes this run moved
+        # (whole mesh, all rounds, per direction) — obs.attribution.
+        "exchange_fraction": round(att["exchange_fraction"], 4),
+        "halo_bytes": att["halo_bytes"],
         # Which wall scheme ACTUALLY produced this row ('slope' = chained
         # spans with the fence constant cancelled; 'fence' = plain fenced
         # spans, used on standard backends and for multi-second walls where

@@ -46,6 +46,7 @@ from __future__ import annotations
 import io
 import json
 import os
+import time
 import warnings
 import zlib
 from pathlib import Path
@@ -54,12 +55,41 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from parallel_convolution_tpu.obs import events as obs_events, metrics as obs_metrics
 from parallel_convolution_tpu.parallel.mesh import (
     block_sharding, grid_shape, padded_extent,
 )
 from parallel_convolution_tpu.resilience.faults import (
     InjectedFault, fault_point,
 )
+
+
+def _note_ckpt(op: str, wall_s: float, nbytes: int, **fields) -> None:
+    """One checkpoint op's telemetry: duration histogram, byte counter,
+    and the typed timeline event.  One branch when obs is off."""
+    if not obs_metrics.enabled():
+        return
+    obs_metrics.histogram(
+        "pctpu_checkpoint_seconds", "checkpoint operation wall time",
+        ("op",)).observe(wall_s, op=op)
+    obs_metrics.counter(
+        "pctpu_checkpoint_bytes_total", "checkpoint bytes written/read",
+        ("op",)).inc(nbytes, op=op)
+    obs_events.emit(f"checkpoint_{op}", wall_s=round(wall_s, 6),
+                    bytes=int(nbytes), **fields)
+
+
+def _note_quarantine(snap_name: str, problems) -> None:
+    if not obs_metrics.enabled():
+        return
+    c = obs_metrics.counter(
+        "pctpu_quarantines_total",
+        "shard validation failures by cause (missing/truncated/...)",
+        ("cause",))
+    for cause, _shard in problems:
+        c.inc(cause=cause)
+    obs_events.emit("quarantine", snap=snap_name,
+                    problems=[[c_, s] for c_, s in problems][:16])
 
 META_NAME = "meta.json"
 LATEST_NAME = "LATEST"
@@ -226,6 +256,7 @@ def save_state(ckpt_dir, arr: jax.Array, meta: dict) -> None:
     ``checkpoint_write_meta`` twice — before the meta write and before the
     LATEST flip — so tests can kill the save at every boundary.
     """
+    t_save0 = time.perf_counter()
     d = Path(ckpt_dir)
     d.mkdir(parents=True, exist_ok=True)
     snap = _snap_dir(d, meta["iters_done"])
@@ -253,6 +284,10 @@ def save_state(ckpt_dir, arr: jax.Array, meta: dict) -> None:
     ptr_tmp = d / (LATEST_NAME + ".tmp")
     ptr_tmp.write_text(snap.name)
     os.replace(ptr_tmp, d / LATEST_NAME)
+    _note_ckpt("save", time.perf_counter() - t_save0,
+               sum(s["bytes"] for s in shards.values()),
+               snap=snap.name, iters_done=int(meta["iters_done"]),
+               shards=len(shards))
     # prune old snapshots (multi-host: every host holds its own shards, so
     # each prunes the same dirs; missing-file AND missing-dir races are
     # ignored — a sibling host may have pruned the same dir already)
@@ -355,6 +390,7 @@ def load_state(ckpt_dir, mesh: Mesh,
     When the grids match, each device reads exactly its own shard file,
     as before.
     """
+    t_load0 = time.perf_counter()
     candidates = _candidate_snaps(ckpt_dir)
     if not candidates:
         raise FileNotFoundError(f"no checkpoint at {ckpt_dir}")
@@ -365,6 +401,7 @@ def load_state(ckpt_dir, mesh: Mesh,
             meta = _read_meta(snap)
             _validate_snapshot(snap, meta)
         except CheckpointCorrupt as e:
+            _note_quarantine(e.snap or snap.name, e.problems)
             if not fallback:
                 raise
             warnings.warn(
@@ -393,6 +430,15 @@ def load_state(ckpt_dir, mesh: Mesh,
                 f"{src_grid[0]}x{src_grid[1]} onto {grid[0]}x{grid[1]}",
                 CheckpointWarning, stacklevel=2)
         arr = jax.make_array_from_callback(shape, block_sharding(mesh), cb)
+        nbytes = sum(s.get("bytes", 0)
+                     for s in meta.get("shards", {}).values())
+        op = "load" if src_grid == grid else "reshard"
+        _note_ckpt(op, time.perf_counter() - t_load0, nbytes,
+                   snap=snap.name, iters_done=int(meta.get("iters_done", 0)),
+                   grid=f"{grid[0]}x{grid[1]}",
+                   **({"resharded_from":
+                       f"{src_grid[0]}x{src_grid[1]}"}
+                      if src_grid != grid else {}))
         return arr, meta
     raise CheckpointCorrupt(
         f"no valid snapshot in {ckpt_dir}: every candidate is torn "

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import threading
 import time
 
 import jax
@@ -35,22 +36,36 @@ class PhaseTimer:
     Phases nest: entering ``phase("device")`` inside ``phase("serve")``
     accumulates under the path ``"serve/device"`` while ``"serve"`` keeps
     the enclosing wall — so a report's top-level walls stay additive and
-    nested ones attribute where the time inside them went.  Not
-    thread-safe: use one timer per request/batch (the serving engine
-    does), not one shared across worker threads.
+    nested ones attribute where the time inside them went.
+
+    Thread-safe (round 11): the nesting stack is **thread-local** — each
+    thread nests against its own enclosing phases, never another
+    thread's — and the accumulated walls/counts are lock-protected.  A
+    timer shared between the batcher worker and HTTP handler threads
+    therefore records correct per-thread paths instead of silently
+    corrupting one shared stack (the pre-round-11 failure mode, pinned
+    by ``tests/test_obs.py::test_phase_timer_thread_safety``).
     """
 
     def __init__(self) -> None:
         self.walls: dict[str, float] = {}
         self.counts: dict[str, int] = {}
-        self._stack: list[str] = []
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+
+    def _stack(self) -> list[str]:
+        s = getattr(self._tls, "stack", None)
+        if s is None:
+            s = self._tls.stack = []
+        return s
 
     @contextlib.contextmanager
     def phase(self, name: str, fence=None):
         """Time a phase; ``fence`` (a jax value/tree) is block_until_ready'd
         before the clock stops so async device work is charged here."""
-        self._stack.append(name)
-        path = "/".join(self._stack)
+        stack = self._stack()
+        stack.append(name)
+        path = "/".join(stack)
         t0 = time.perf_counter()
         try:
             yield
@@ -63,20 +78,24 @@ class PhaseTimer:
                 # a failing phase must not corrupt the nesting stack (the
                 # fault/retry paths re-enter the same timer afterwards).
                 dt = time.perf_counter() - t0
-                self._stack.pop()
-                self.walls[path] = self.walls.get(path, 0.0) + dt
-                self.counts[path] = self.counts.get(path, 0) + 1
+                stack.pop()
+                with self._lock:
+                    self.walls[path] = self.walls.get(path, 0.0) + dt
+                    self.counts[path] = self.counts.get(path, 0) + 1
 
     def report(self) -> dict:
+        with self._lock:
+            walls = dict(self.walls)
+            counts = dict(self.counts)
         # Total sums only TOP-LEVEL phases: a nested wall is already inside
         # its parent's, so summing every path would double-count it.
-        total = sum(v for k, v in self.walls.items() if "/" not in k)
+        total = sum(v for k, v in walls.items() if "/" not in k)
         return {
             "total_s": round(total, 4),
             "phases": {
-                k: {"wall_s": round(v, 4), "calls": self.counts[k],
+                k: {"wall_s": round(v, 4), "calls": counts[k],
                     "share": round(v / total, 3) if total else 0.0}
-                for k, v in sorted(self.walls.items(), key=lambda kv: -kv[1])
+                for k, v in sorted(walls.items(), key=lambda kv: -kv[1])
             },
         }
 
@@ -89,15 +108,25 @@ class PhaseTimer:
         the key suffix left to the caller's prefix convention); the serving
         latency breakdown merges this straight into its per-request and
         loadgen rows.
+
+        Flattening can collide: the nested path ``a/b`` and a top-level
+        phase literally named ``a_b`` map to the same row key.  Colliding
+        walls are SUMMED — a collision may blur attribution between two
+        sources but can never silently drop one of them (pinned in
+        tests/test_obs.py).
         """
-        return {
-            f"{prefix}{k.replace('/', '_')}_s": round(v * scale, digits)
-            for k, v in self.walls.items()
-        }
+        with self._lock:
+            walls = dict(self.walls)
+        out: dict[str, float] = {}
+        for k, v in walls.items():
+            key = f"{prefix}{k.replace('/', '_')}_s"
+            out[key] = out.get(key, 0.0) + v * scale
+        return {k: round(v, digits) for k, v in out.items()}
 
     def wall(self, name: str) -> float:
         """Accumulated seconds for one phase path (0.0 if never entered)."""
-        return self.walls.get(name, 0.0)
+        with self._lock:
+            return self.walls.get(name, 0.0)
 
     def dump(self, path) -> None:
         with open(path, "w") as f:

@@ -104,6 +104,11 @@ def main() -> int:
                     help="byte-compare completed responses vs the oracle")
     ap.add_argument("--out", default=None,
                     help="also write the summary row JSON to this path")
+    ap.add_argument("--trace-out", default=None, metavar="JSONL",
+                    help="per-request JSONL trace (request_id, latency, "
+                         "phases, outcome) — tail-latency spikes become "
+                         "attributable to a specific request/phase instead "
+                         "of hiding inside the aggregate p99")
     # In-process service knobs (no-ops with --url):
     ap.add_argument("--mesh", default=None, help="RxC (in-process only)")
     ap.add_argument("--max-batch", type=int, default=8)
@@ -133,6 +138,7 @@ def main() -> int:
 
     service = None
     if args.in_process:
+        from parallel_convolution_tpu.obs import events as obs_events
         from parallel_convolution_tpu.resilience import faults
         from parallel_convolution_tpu.serving.frontend import InProcessClient
         from parallel_convolution_tpu.serving.service import (
@@ -140,6 +146,7 @@ def main() -> int:
         )
 
         faults.install_from_env()
+        obs_events.install_from_env()  # PCTPU_OBS_EVENTS: leave a timeline
         mesh = None
         if args.mesh:
             from parallel_convolution_tpu.parallel.mesh import mesh_from_spec
@@ -172,19 +179,20 @@ def main() -> int:
         want = oracle.run_serial_u8(img, get_filter(args.filter_name),
                                     args.iters, boundary=args.boundary)
 
-    results = []                      # (latency_s, status, resp_dict)
+    results = []                      # (index, latency_s, status, resp)
     results_lock = threading.Lock()
 
     def one_request(i: int) -> None:
         b = dict(body, request_id=f"lg{i}")
         t0 = time.perf_counter()
+        ts = time.time()
         try:
             status, resp = transport_request(b)
         except Exception as e:  # noqa: BLE001 — a transport failure row
             status, resp = -1, {"ok": False, "detail": repr(e)[:300]}
         lat = time.perf_counter() - t0
         with results_lock:
-            results.append((lat, status, resp))
+            results.append((i, ts, lat, status, resp))
 
     t_start = time.perf_counter()
     if args.rate:
@@ -225,10 +233,40 @@ def main() -> int:
             th.join()
     wall = time.perf_counter() - t_start
 
-    completed = [(lat, r) for lat, s, r in results if s == 200 and r.get("ok")]
+    if args.trace_out:
+        # The per-request timeline: one JSONL line per issued request, in
+        # issue order — a p99 spike is now a grep, not a guess.
+        from pathlib import Path
+
+        tp = Path(args.trace_out)
+        tp.parent.mkdir(parents=True, exist_ok=True)
+        with open(tp, "w") as f:
+            for i, ts, lat, s, r in sorted(results):
+                line = {
+                    "request_id": r.get("request_id") or f"lg{i}",
+                    "ts": round(ts, 6),
+                    "latency_ms": round(1e3 * lat, 3),
+                    "status": s,
+                    "ok": bool(r.get("ok")),
+                }
+                if r.get("ok"):
+                    line.update(
+                        effective_backend=r.get("effective_backend", ""),
+                        effective_grid=r.get("effective_grid", ""),
+                        batch_size=r.get("batch_size"),
+                        plan_source=r.get("plan_source", ""),
+                        phases=r.get("phases", {}),
+                    )
+                else:
+                    line.update(rejected=r.get("rejected"),
+                                detail=(r.get("detail") or "")[:200])
+                f.write(json.dumps(line) + "\n")
+
+    completed = [(lat, r) for _, _, lat, s, r in results
+                 if s == 200 and r.get("ok")]
     rejected: dict[str, int] = {}
     failures = []
-    for lat, s, r in results:
+    for _, _, lat, s, r in results:
         if s == 200 and r.get("ok"):
             continue
         reason = r.get("rejected")

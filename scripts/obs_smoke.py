@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Observability smoke: serving + obs end-to-end on the CPU mesh.
+
+The ``run_t1.sh --obs-smoke`` leg: boot the in-process convolution
+service on the 2x4 virtual-device mesh with obs ON, push loadgen-style
+traffic through the REAL HTTP frontend, then assert the whole telemetry
+spine held together:
+
+1. ``GET /metrics`` parses as Prometheus text exposition and carries the
+   serving/step/attribution metric families;
+2. the event log (``PCTPU_OBS_EVENTS``) validates line-by-line against
+   the obs.events schema (monotonic seq, typed kinds);
+3. ``scripts/obs_report.py`` folds the event log + metrics snapshot and
+   exits 0.
+
+One summary row lands in ``--out`` (``evidence/obs_smoke.json``, the
+supervisor leg's done_file) with ``"failures": 0`` iff every gate held.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import _path  # noqa: F401  (repo root + JAX_PLATFORMS re-apply)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n", type=int, default=24, help="requests to push")
+    ap.add_argument("--rows", type=int, default=48)
+    ap.add_argument("--cols", type=int, default=64)
+    ap.add_argument("--iters", type=int, default=2)
+    ap.add_argument("--mesh", default="2x4")
+    ap.add_argument("--events", default="evidence/obs_events.jsonl")
+    ap.add_argument("--metrics-out", default="evidence/obs_metrics.json")
+    ap.add_argument("--report-out", default="evidence/obs_report.json")
+    ap.add_argument("--out", default="evidence/obs_smoke.json")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from parallel_convolution_tpu.obs import events as obs_events, metrics
+    from parallel_convolution_tpu.utils import imageio
+
+    if not metrics.enabled():
+        metrics.set_enabled(True)  # the smoke TESTS obs: force it on
+    ev_path = Path(args.events)
+    ev_path.parent.mkdir(parents=True, exist_ok=True)
+    if ev_path.exists():
+        ev_path.unlink()  # a fresh timeline per smoke run
+    obs_events.configure(ev_path)
+
+    from parallel_convolution_tpu.parallel.mesh import mesh_from_spec
+    from parallel_convolution_tpu.serving.frontend import make_http_server
+    from parallel_convolution_tpu.serving.service import ConvolutionService
+
+    failures: list[str] = []
+    service = ConvolutionService(mesh_from_spec(args.mesh), max_batch=8,
+                                 max_delay_s=0.005, max_queue=64)
+    server = make_http_server(service, port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{port}"
+
+    import base64
+
+    img = imageio.generate_test_image(args.rows, args.cols, "grey", seed=0)
+    body = json.dumps({
+        "image_b64": base64.b64encode(
+            np.ascontiguousarray(img).tobytes()).decode("ascii"),
+        "rows": args.rows, "cols": args.cols, "mode": "grey",
+        "filter": "blur3", "iters": args.iters, "backend": "shifted",
+    }).encode()
+
+    t0 = time.perf_counter()
+    completed = 0
+    for i in range(args.n):
+        req = urllib.request.Request(
+            f"{base}/v1/convolve", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                if json.loads(resp.read()).get("ok"):
+                    completed += 1
+        except Exception as e:  # noqa: BLE001 — counted, reported
+            failures.append(f"request {i}: {e!r}")
+    wall = time.perf_counter() - t0
+    if completed != args.n:
+        failures.append(f"only {completed}/{args.n} requests completed")
+
+    # Gate 1: /metrics parses and carries the expected families.
+    metrics_ok = False
+    try:
+        with urllib.request.urlopen(f"{base}/metrics", timeout=30) as resp:
+            text = resp.read().decode()
+        parsed = metrics.parse_text(text)
+        missing = [n for n in (
+            "pctpu_service_stats", "pctpu_engine_stats",
+            "pctpu_batcher_stats", "pctpu_request_phase_seconds_bucket",
+            "pctpu_halo_bytes_total", "pctpu_exchange_seconds_total",
+            "pctpu_admission_total", "pctpu_plan_drift_ratio",
+        ) if n not in parsed]
+        if missing:
+            failures.append(f"/metrics missing families: {missing}")
+        else:
+            metrics_ok = True
+    except Exception as e:  # noqa: BLE001
+        failures.append(f"/metrics: {e!r}")
+
+    server.shutdown()
+    service.close()
+
+    # Gate 2: the event log validates line-by-line.
+    events_ok = False
+    try:
+        recs = obs_events.read_events(ev_path)
+        bad = [p for r in recs for p in obs_events.validate_event(r)]
+        if not recs:
+            failures.append("event log is empty")
+        elif bad:
+            failures.append(f"{len(bad)} event schema problems: {bad[:5]}")
+        else:
+            events_ok = True
+    except Exception as e:  # noqa: BLE001
+        failures.append(f"event log: {e!r}")
+
+    # Gate 3: obs_report folds both and exits 0.
+    metrics.dump(args.metrics_out)
+    import subprocess
+
+    rc = subprocess.run(
+        [sys.executable, str(Path(__file__).parent / "obs_report.py"),
+         "--events", str(ev_path), "--metrics", args.metrics_out,
+         "--out", args.report_out, "--quiet"],
+        capture_output=True, text=True).returncode
+    report_ok = rc == 0
+    if not report_ok:
+        failures.append(f"obs_report.py exited {rc}")
+
+    row = {
+        "workload": (f"obs smoke blur3 {args.rows}x{args.cols} "
+                     f"{args.iters} iters, {args.n} http requests"),
+        "mesh": args.mesh,
+        "completed": completed,
+        "wall_s": round(wall, 3),
+        "metrics_ok": metrics_ok,
+        "events_ok": events_ok,
+        "report_ok": report_ok,
+        "event_count": len(recs) if events_ok else None,
+        "failures": len(failures),
+        **({"failure_sample": failures[:5]} if failures else {}),
+    }
+    out = Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(row, indent=2))
+    print(json.dumps(row), flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,6 +51,7 @@ from pathlib import Path
 
 import _path  # noqa: F401  (repo root + JAX_PLATFORMS re-apply)
 
+from parallel_convolution_tpu.obs import events as obs_events
 from parallel_convolution_tpu.resilience.retry import RetryPolicy
 from parallel_convolution_tpu.resilience.supervisor import (
     Supervisor, legs_from_json,
@@ -58,6 +59,7 @@ from parallel_convolution_tpu.resilience.supervisor import (
 
 
 def main() -> int:
+    obs_events.install_from_env()  # PCTPU_OBS_EVENTS: leg/heartbeat timeline
     ap = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("--legs", required=True,

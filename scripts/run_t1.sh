@@ -19,6 +19,15 @@
 #                                      it (auto_ok in the summary row —
 #                                      evidence/tuning_smoke.json, the
 #                                      supervisor leg's done_file).
+#   scripts/run_t1.sh --obs-smoke      observability end-to-end on the 2x4
+#                                      CPU mesh: boot the service with obs
+#                                      on, push HTTP traffic, assert
+#                                      /metrics parses, the event log
+#                                      validates against the obs.events
+#                                      schema, and obs_report.py exits 0.
+#                                      Row (failures: 0) lands in
+#                                      evidence/obs_smoke.json (the
+#                                      supervisor leg's done_file).
 #   scripts/run_t1.sh --elastic-smoke  reshape round-trip on the CPU mesh:
 #                                      crash a checkpointed run on 2x4,
 #                                      resume the snapshot on 1x2 / 2x2 /
@@ -28,6 +37,14 @@
 #                                      evidence/elastic_smoke.json (the
 #                                      supervisor leg's done_file).
 cd "$(dirname "$0")/.." || exit 1
+
+if [ "${1:-}" = "--obs-smoke" ]; then
+  exec timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    PCTPU_OBS=1 \
+    python scripts/obs_smoke.py --n 24 --rows 48 --cols 64 --iters 2 \
+      --mesh 2x4 --out evidence/obs_smoke.json
+fi
 
 if [ "${1:-}" = "--elastic-smoke" ]; then
   exec timeout -k 10 600 env JAX_PLATFORMS=cpu \

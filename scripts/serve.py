@@ -65,12 +65,14 @@ def main() -> int:
 
         force_platform(args.platform, warn=True)
 
+    from parallel_convolution_tpu.obs import events as obs_events
     from parallel_convolution_tpu.resilience import faults
     from parallel_convolution_tpu.serving.frontend import make_http_server
     from parallel_convolution_tpu.serving.service import ConvolutionService
     from parallel_convolution_tpu.utils.platform import enable_compile_cache
 
     faults.install_from_env()
+    obs_events.install_from_env()  # PCTPU_OBS_EVENTS: the event timeline
     enable_compile_cache()
 
     mesh = None
@@ -95,6 +97,8 @@ def main() -> int:
 
     server = make_http_server(service, args.host, args.port)
     host, port = server.server_address[:2]
+    obs_events.emit("serve", state="boot", url=f"http://{host}:{port}",
+                    mesh=service.snapshot().get("mesh", ""))
     print(json.dumps({"serving": f"http://{host}:{port}",
                       **{k: v for k, v in service.snapshot().items()
                          if k in ("mesh", "platform", "device_kind")}}),

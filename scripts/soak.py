@@ -563,6 +563,10 @@ def main() -> int:
     ap.add_argument("--trial-out", default=None, help=argparse.SUPPRESS)
     args = ap.parse_args()
 
+    from parallel_convolution_tpu.obs import events as obs_events
+
+    obs_events.install_from_env()  # PCTPU_OBS_EVENTS: drill timeline
+
     if args.fault_trial:
         return run_fault_trial(args.fault_trial, args.trial_seed,
                                args.trial_out)
