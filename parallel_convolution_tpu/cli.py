@@ -67,6 +67,17 @@ def _add_perf_args(p: argparse.ArgumentParser) -> None:
                    help="Pallas kernel output-tile override, e.g. "
                         "1024,512 (default: per-kernel tuned value; "
                         "results are bit-identical for any tile)")
+    p.add_argument("--overlap", default="auto",
+                   choices=["auto", "on", "off"],
+                   help="interior-first overlapped halo pipeline in the "
+                        "RDMA kernels: ghost-band DMAs fly while the "
+                        "block interior computes, receive waits retire "
+                        "just before the rim (bit-identical to the "
+                        "serialized order).  'auto' = off for explicit "
+                        "backends, cost-model-decided for --backend "
+                        "auto; 'on' is a request clamped to legality "
+                        "(RDMA tier, compiled Pallas) — the RESOLVED "
+                        "knob is what rows and summaries report")
     p.add_argument("--interior-split", action="store_true",
                    dest="interior_split",
                    help="unmasked-interior launch split for fused Pallas "
@@ -132,6 +143,10 @@ def _resolve_perf_knobs(args, mesh) -> None:
         # backend='auto' keeps the None: it means 'tune the depth too'
         # (resolved with the backend through the plan cache/cost model).
         args.fuse = 1
+    # --overlap: 'auto' -> None (off for explicit backends, tuned for
+    # backend='auto'); on/off -> a clamped request (resolve_overlap).
+    args.overlap = {"auto": None, "on": True, "off": False}[
+        getattr(args, "overlap", "auto")]
 
 
 def _mesh_from_flag(spec: str | None):
@@ -331,6 +346,7 @@ def main(argv: list[str] | None = None) -> int:
             interior_split=args.interior_split,
             backend=args.backend, storage=args.storage, fuse=args.fuse,
             reps=args.reps, tile=tile, fallback=args.fallback,
+            overlap=args.overlap,
         )
         if note:
             row["platform_note"] = note
@@ -348,7 +364,7 @@ def main(argv: list[str] | None = None) -> int:
             check_every=args.check_every, mesh=mesh, backend=args.backend,
             quantize=True, fuse=args.fuse, tile=tile,
             boundary=args.boundary, storage=args.storage,
-            interior_split=args.interior_split,
+            interior_split=args.interior_split, overlap=args.overlap,
         )
         img = imageio.read_raw(args.image, args.rows, args.cols, args.mode)
         x = imageio.interleaved_to_planar(img).astype(np.float32)
@@ -366,6 +382,7 @@ def main(argv: list[str] | None = None) -> int:
                              fuse=args.fuse, boundary=args.boundary,
                              tile=tile,
                              interior_split=args.interior_split,
+                             overlap=args.overlap,
                              fallback=args.fallback)
     if args.checkpoint:
         from parallel_convolution_tpu.parallel import step as step_lib
@@ -378,7 +395,7 @@ def main(argv: list[str] | None = None) -> int:
             ckpt_dir=args.checkpoint, every=args.checkpoint_every,
             backend=args.backend, fuse=args.fuse, boundary=args.boundary,
             tile=tile, interior_split=args.interior_split,
-            fallback=args.fallback,
+            fallback=args.fallback, overlap=args.overlap,
         )
         sharded_io.save_sharded(args.output, out, args.rows, args.cols,
                                 args.mode)
@@ -423,6 +440,8 @@ def main(argv: list[str] | None = None) -> int:
     else:
         label = (args.backend if eff == args.backend
                  else f"{args.backend} degraded to {eff}")
+    if getattr(model, "effective_overlap", None):
+        label += ", overlapped halo pipeline"
     print(f"ran {args.loops} x {args.filter_name} on {r}x{c} mesh "
           f"({label}) -> {args.output}")
     return 0

@@ -42,6 +42,8 @@ class JacobiSolver:
     tile: tuple[int, int] | None = None  # Pallas kernel tile override
     interior_split: bool = False  # unmasked-interior launch split (see
     #                ConvolutionModel; fused chunks only)
+    overlap: bool | None = None  # interior-first overlapped halo pipeline
+    #                (see ConvolutionModel; resolved in sharded_converge)
 
     def __post_init__(self) -> None:
         if isinstance(self.filt, str):
@@ -66,6 +68,6 @@ class JacobiSolver:
             quantize=self.quantize, backend=self.backend,
             boundary=self.boundary, storage=self.storage,
             fuse=self.fuse, tile=self.tile,
-            interior_split=self.interior_split,
+            interior_split=self.interior_split, overlap=self.overlap,
         )
         return np.asarray(out), iters

@@ -58,6 +58,10 @@ class ConvolutionModel:
     #                override; None = per-kernel tuned default
     interior_split: bool = False  # unmasked-interior launch split (fused
     #                Pallas on a 1x1 grid; bit-identical, opt-in experiment)
+    overlap: bool | None = None  # interior-first overlapped halo pipeline
+    #                (RDMA kernels): None = off for explicit backends /
+    #                tuned for backend="auto"; True is a clamped request —
+    #                the resolved knob lands in self.effective_overlap
     fallback: bool = False  # graceful backend degradation on transient
     #                compile/launch failure (resilience.degrade)
 
@@ -76,6 +80,9 @@ class ConvolutionModel:
         # (measured|interpolated|predicted), or 'explicit'.
         self.effective_backend: str | None = None
         self.plan_source: str = "explicit"
+        # The overlap knob the last run ACTUALLY compiled with (clamped
+        # request / tuned decision / degrade re-clamp); None until a run.
+        self.effective_overlap: bool | None = None
 
     def set_mesh(self, mesh) -> "ConvolutionModel":
         """Swap the device mesh mid-object (elastic recovery).
@@ -94,19 +101,23 @@ class ConvolutionModel:
         self.mesh = mesh_from_spec(mesh) if isinstance(mesh, str) else mesh
         self.effective_backend = None
         self.plan_source = "explicit"
+        self.effective_overlap = None
         return self
 
     def _resolved_knobs(self, hw: tuple[int, int],
-                        channels: int = 1) -> tuple[str, int, object]:
+                        channels: int = 1) -> tuple[str, int, object, bool]:
         """Resolve for the REAL (H, W) workload: the probe must compile
         the same kernel family (block geometry + storage dtype) the run
         will, or it could pass while the run crashes.
 
         ``backend="auto"`` resolves through the tuning subsystem FIRST
         (plan cache, else cost model); the degradation walk then guards
-        the resolved backend like any explicitly-named one.
+        the resolved backend like any explicitly-named one.  The overlap
+        knob resolves alongside (tuned for auto, clamped request
+        otherwise) and is re-clamped if degradation leaves the RDMA tier.
         """
         backend, fuse, tile = self.backend, self.fuse, self.tile
+        overlap = self.overlap
         if backend == "auto":
             from parallel_convolution_tpu import tuning
 
@@ -114,15 +125,18 @@ class ConvolutionModel:
                 self.mesh, self.filt, (channels, *hw),
                 storage=self.storage, quantize=self.quantize,
                 boundary=self.boundary, fuse=fuse,
-                tile=step_lib._norm_tile(tile))
+                tile=step_lib._norm_tile(tile), overlap=overlap)
             backend, fuse, tile = res.backend, res.fuse, res.tile
+            overlap = res.overlap
             self.plan_source = res.source
         else:
             fuse = 1 if fuse is None else fuse
             self.plan_source = "explicit"
+        overlap = step_lib.resolve_overlap(overlap, backend, self.mesh)
         if not self.fallback:
             self.effective_backend = backend
-            return backend, fuse, tile
+            self.effective_overlap = overlap
+            return backend, fuse, tile, overlap
         from parallel_convolution_tpu.parallel.mesh import (
             grid_shape, padded_extent,
         )
@@ -132,19 +146,23 @@ class ConvolutionModel:
         eff = step_lib._resolve_fallback(
             self.mesh, self.filt, backend, self.quantize, fuse,
             self.boundary, step_lib._norm_tile(tile),
-            self.interior_split, self.storage, block_hw=block_hw)
+            self.interior_split, self.storage, block_hw=block_hw,
+            overlap=overlap)
+        overlap = overlap and eff == "pallas_rdma"
         self.effective_backend = eff
-        return eff, fuse, tile
+        self.effective_overlap = overlap
+        return eff, fuse, tile, overlap
 
     # -- array-level API ----------------------------------------------------
     def run_planar(self, x, iters: int) -> jnp.ndarray:
         """(C, H, W) f32 in → (C, H, W) f32 out after ``iters`` iterations."""
-        backend, fuse, tile = self._resolved_knobs(x.shape[-2:], x.shape[0])
+        backend, fuse, tile, overlap = self._resolved_knobs(
+            x.shape[-2:], x.shape[0])
         return step_lib.sharded_iterate(
             x, self.filt, iters, mesh=self.mesh,
             quantize=self.quantize, backend=backend,
             storage=self.storage, fuse=fuse, boundary=self.boundary,
-            tile=tile, interior_split=self.interior_split,
+            tile=tile, interior_split=self.interior_split, overlap=overlap,
         )
 
     def run_image(self, img: np.ndarray, iters: int) -> np.ndarray:
@@ -195,12 +213,12 @@ class ConvolutionModel:
             src, rows, cols, mode, self.mesh,
             dtype=np.dtype(STORAGE_DTYPES[self.storage]),
         )
-        backend, fuse, tile = self._resolved_knobs(
+        backend, fuse, tile, overlap = self._resolved_knobs(
             (rows, cols), 3 if mode == "rgb" else 1)
         out = step_lib.iterate_prepared(
             xs, self.filt, iters, self.mesh, (rows, cols),
             quantize=self.quantize, backend=backend,
             fuse=fuse, boundary=self.boundary, tile=tile,
-            interior_split=self.interior_split,
+            interior_split=self.interior_split, overlap=overlap,
         )
         sharded_io.save_sharded(dst, out, rows, cols, mode)

@@ -40,7 +40,8 @@ from parallel_convolution_tpu.tuning import costmodel
 
 __all__ = [
     "exchange_rounds", "halo_bytes_per_round", "halo_bytes_total",
-    "predicted_exchange_fraction", "record_drift", "record_step",
+    "predicted_exchange_fraction", "predicted_exchange_split",
+    "record_drift", "record_step",
 ]
 
 DIRECTIONS = ("north", "south", "east", "west")
@@ -108,28 +109,50 @@ def halo_bytes_total(grid, block_hw, radius: int, fuse: int, iters: int,
     return total
 
 
-def predicted_exchange_fraction(
+def predicted_exchange_split(
         grid, block_hw, radius: int, fuse: int, *, backend: str,
         storage: str, shape: tuple[int, int, int],
         tile: tuple[int, int] | None = None, quantize: bool = True,
         separable: bool = False, platform: str = "cpu",
-        device_kind: str = "") -> float:
-    """Exchange share of one iteration's roofline time, in [0, 1].
+        device_kind: str = "", overlap: bool = False) -> dict:
+    """Exchange-vs-compute attribution of one iteration's roofline time,
+    overlap-adjusted.
 
-    The cost model's exchange term over ``max(bandwidth, compute) +
-    exchange`` — the same decomposition the autotuner ranks with, so the
-    attribution in rows/reports and the knob ``backend="auto"`` turns are
-    the one model (and recalibrating one recalibrates the other).  Pure
-    model attribution: the interpret penalty scales both terms, so the
-    fraction is penalty-invariant; a 1x1 grid is exactly 0.
+    Returns::
+
+      {"exchange_fraction":        exposed exchange / total wall,
+       "exchange_hidden_fraction": hidden exchange / total exchange,
+       "exchange_hidden_of_total": hidden exchange / total wall,
+       "overlap":                  the caller's compiled-form knob}
+
+    ``overlap`` is reported back VERBATIM (callers pass the knob the
+    executable compiled with, so events and rows agree by construction);
+    the max() *adjustment* applies only where the pipeline can actually
+    hide bytes (``costmodel.overlap_legal`` — a degenerate all-rim block
+    or a 1x1 grid computes in serialized order even inside the
+    overlapped program, so it is priced serialized with hidden = 0).
+
+    Serialized arithmetic: total = compute + exchange, nothing hidden,
+    ``exchange_fraction`` is exactly the pre-overlap series.
+    Overlapped: the interior-first pipeline rides the exchange under
+    the compute roof, so ``hidden = min(exchange, compute)`` and only
+    the remainder is exposed over ``total = max(compute, exchange)`` —
+    the "hidden vs. exposed exchange time" reading the overlapped-halo
+    ROADMAP item is judged by.  Pure model attribution: the interpret
+    penalty scales all terms, so the fractions are penalty-invariant; a
+    1x1 grid is exactly 0 / 0.
     """
     hw = costmodel.hardware_for(platform, device_kind)
     T = max(1, int(fuse))
     k = 2 * int(radius) + 1
+    ov = bool(overlap) and costmodel.overlap_legal(
+        backend, tuple(grid), tuple(block_hw), int(radius), T)
+    out = {"exchange_fraction": 0.0, "exchange_hidden_fraction": 0.0,
+           "exchange_hidden_of_total": 0.0, "overlap": bool(overlap)}
     ex = costmodel.exchange_seconds_per_px_iter(
         tuple(grid), tuple(block_hw), int(radius), T, storage, hw)
     if ex == 0.0:
-        return 0.0
+        return out
     tile_eff = costmodel.effective_tile(backend, tile)
     rim_tile = tile_eff if tile_eff is not None else tuple(block_hw)
     if backend == "pallas_rdma" and not costmodel.rdma_is_tiled(
@@ -141,8 +164,37 @@ def predicted_exchange_fraction(
         tuple(shape)) / (hw.hbm_gbps * 1e9)
     t_flop = costmodel.flops_per_px_iter(
         k, sep, quantize, T, rim_tile, int(radius)) / (hw.flop_gops * 1e9)
-    t = max(t_hbm, t_flop) + ex
-    return min(1.0, ex / t) if t > 0 else 0.0
+    roof = max(t_hbm, t_flop)
+    if ov:
+        hidden = min(ex, roof)
+        exposed = ex - hidden
+        total = max(roof, ex)
+        out["exchange_hidden_fraction"] = min(1.0, hidden / ex)
+        if total > 0:
+            out["exchange_hidden_of_total"] = min(1.0, hidden / total)
+    else:
+        exposed, total = ex, roof + ex
+    if total > 0:
+        out["exchange_fraction"] = min(1.0, exposed / total)
+    return out
+
+
+def predicted_exchange_fraction(
+        grid, block_hw, radius: int, fuse: int, *, backend: str,
+        storage: str, shape: tuple[int, int, int],
+        tile: tuple[int, int] | None = None, quantize: bool = True,
+        separable: bool = False, platform: str = "cpu",
+        device_kind: str = "", overlap: bool = False) -> float:
+    """The (exposed) exchange share of one iteration, in [0, 1] — the
+    ``exchange_fraction`` member of :func:`predicted_exchange_split`,
+    kept as the scalar surface existing callers/series use.  With
+    ``overlap=False`` the values are identical to the pre-overlap
+    series (compute + exchange decomposition)."""
+    return predicted_exchange_split(
+        grid, block_hw, radius, fuse, backend=backend, storage=storage,
+        shape=shape, tile=tile, quantize=quantize, separable=separable,
+        platform=platform, device_kind=device_kind,
+        overlap=overlap)["exchange_fraction"]
 
 
 # -- the step-level recorder (metrics + event, one helper, two callers) ----
@@ -160,10 +212,15 @@ def _m():
             ("backend",)),
         metrics.counter(
             "pctpu_exchange_seconds_total",
-            "model-attributed exchange share of step walls", ("backend",)),
+            "model-attributed EXPOSED exchange share of step walls",
+            ("backend",)),
         metrics.counter(
             "pctpu_compute_seconds_total",
             "model-attributed compute share of step walls", ("backend",)),
+        metrics.counter(
+            "pctpu_exchange_hidden_seconds_total",
+            "model-attributed exchange time hidden under compute by the "
+            "overlapped pipeline", ("backend",)),
         metrics.counter(
             "pctpu_halo_bytes_total",
             "analytic ghost-band bytes moved, per direction",
@@ -181,7 +238,7 @@ def record_step(*, backend: str, grid, block_hw, radius: int, fuse: int,
                 iters: int, channels: int, storage: str, boundary: str,
                 wall_s: float | None, shape, quantize: bool = True,
                 tile=None, platform: str = "cpu", device_kind: str = "",
-                source: str = "step") -> dict | None:
+                source: str = "step", overlap: bool = False) -> dict | None:
     """Record one compiled-iterate call: wall, halo bytes, exchange split.
 
     ``wall_s=None`` means the caller dispatched asynchronously and has no
@@ -202,15 +259,22 @@ def record_step(*, backend: str, grid, block_hw, radius: int, fuse: int,
     sep = backend in ("separable", "pallas_sep")
     by = halo_bytes_total(grid, block_hw, radius, fuse, iters, channels,
                           storage, boundary)
-    frac = predicted_exchange_fraction(
+    split = predicted_exchange_split(
         grid, block_hw, radius, fuse, backend=backend, storage=storage,
         shape=shape, tile=tile, quantize=quantize, separable=sep,
-        platform=platform, device_kind=device_kind)
-    wall, ex_s, comp_s, hbytes, rounds, iters_m = _m()
+        platform=platform, device_kind=device_kind, overlap=overlap)
+    frac = split["exchange_fraction"]
+    hidden_of_ex = split["exchange_hidden_fraction"]
+    wall, ex_s, comp_s, hid_s, hbytes, rounds, iters_m = _m()
     if wall_s is not None:
         wall.observe(wall_s, backend=backend)
         ex_s.inc(wall_s * frac, backend=backend)
         comp_s.inc(wall_s * (1.0 - frac), backend=backend)
+        if split["exchange_hidden_of_total"] > 0.0:
+            # Exchange time the pipeline rode under the compute share —
+            # informational (it overlaps compute seconds, not additive).
+            hid_s.inc(wall_s * split["exchange_hidden_of_total"],
+                      backend=backend)
     for d in DIRECTIONS:
         hbytes.inc(by[d], backend=backend, direction=d)
     rounds.inc(by["rounds"], backend=backend)
@@ -222,8 +286,12 @@ def record_step(*, backend: str, grid, block_hw, radius: int, fuse: int,
         storage=storage, boundary=boundary, rounds=by["rounds"],
         halo_bytes={d: by[d] for d in DIRECTIONS},
         exchange_fraction=round(frac, 4),
+        overlap=bool(split["overlap"]),
+        exchange_hidden_fraction=round(hidden_of_ex, 4),
         **({"wall_s": round(wall_s, 6)} if wall_s is not None else {}))
-    return {"halo_bytes": by, "exchange_fraction": frac}
+    return {"halo_bytes": by, "exchange_fraction": frac,
+            "exchange_hidden_fraction": hidden_of_ex,
+            "overlap": bool(split["overlap"])}
 
 
 def record_drift(plan_key: str, backend: str, predicted_gpx: float | None,

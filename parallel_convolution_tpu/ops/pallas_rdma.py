@@ -24,6 +24,15 @@ the Cerebras wafer-scale in-fabric neighbor transfer), i.e. this tier's
 reason to exist at small blocks.  See DESIGN.md "RDMA temporal fusion"
 for the band-depth math and the win/retire decision rule.
 
+Overlapped pipeline (``overlap=True``, round 12): both kernels can run
+interior-first — start the ghost-band DMAs, compute every output pixel
+whose level-0 window needs no ghost byte while they fly, and retire each
+phase's receive semaphores immediately before the first compute that
+reads them (monolithic: the ``overlap_regions`` 5-region schedule;
+tiled: rim-last rotated traversal + an SMEM deferred-wait ledger).
+Byte-identical to the serialized order by construction — see DESIGN.md
+"Overlapped halo pipeline" and tests/test_overlap.py.
+
 Corner propagation uses the same two-phase trick as halo.py: column slabs
 are sent at full padded height *after* the row-ghost receive semaphores
 fire, so corners take two hops and no diagonal messages exist.  Ghost
@@ -189,9 +198,37 @@ def _topology(R, Cc, periodic):
     return up_in, down_in, left_in, right_in, nbr
 
 
+def overlap_regions(h: int, w: int, d: int):
+    """The interior-first output partition of one (h, w) block at ghost
+    depth ``d``, as ``(interior, row_bands, col_bands)`` — each a list of
+    half-open ``(r0, r1, c0, c1)`` output rectangles (empties dropped).
+
+    * ``interior`` needs NO ghost data (its level-0 window is the local
+      block) — computed while the row DMAs are in flight;
+    * ``row_bands`` (top/bottom strips restricted to interior columns)
+      need the ROW ghosts only — computed while the column DMAs fly;
+    * ``col_bands`` (full-height left/right strips) read column ghosts
+      (and, via the full padded height, the two-hop corners) — computed
+      after the column receive semaphores clear.
+
+    The three groups tile the block exactly (no overlap, no gap) for any
+    geometry, including degenerate blocks where ``min(h, w) <= 2*d``
+    (interior empties out and the bands absorb everything).  Shared by
+    the monolithic kernel and the cost model's legality predicate; unit
+    pinned in tests/test_overlap.py.
+    """
+    t, b = min(d, h), max(h - d, min(d, h))
+    l, rt = min(d, w), max(w - d, min(d, w))
+    interior = [(t, b, l, rt)]
+    row_bands = [(0, t, l, rt), (b, h, l, rt)]
+    col_bands = [(0, h, 0, l), (0, h, rt, w)]
+    keep = lambda rs: [x for x in rs if x[0] < x[1] and x[2] < x[3]]
+    return keep(interior), keep(row_bands), keep(col_bands)
+
+
 def _rdma_kernel(in_ref, out_ref, pad, send_sem, recv_sem, *,
                  taps, sep, k, r, T, C, h, w, R, Cc, periodic, quantize,
-                 convex, round_mode, valid_hw):
+                 convex, round_mode, valid_hw, overlap=False):
     """One device's program: exchange T·r-deep ghosts in-kernel, then run
     T stencil levels (temporal fusion — ONE exchange buys T iterations).
 
@@ -205,6 +242,19 @@ def _rdma_kernel(in_ref, out_ref, pad, send_sem, recv_sem, *,
     with T single-exchange steps.  ``valid_hw=None`` (fuse=1, or the
     periodic torus) statically drops the masks: the validated
     single-level protocol is byte-identical to before.
+
+    ``overlap=True`` is the interior-first pipeline (ROADMAP item 1, the
+    persistent/partitioned-MPI overlap recipe): compute is split into the
+    :func:`overlap_regions` partition and interleaved with the two
+    exchange phases — interior under the in-flight row DMAs, top/bottom
+    bands under the column DMAs, left/right bands after the last receive
+    semaphore.  Bit-exact vs the serialized order because every output
+    pixel's level chain is a pure function of its own level-0 dependency
+    cone, which each region's window contains by construction; the only
+    reordering is BETWEEN independent pixels.  Safe vs the in-flight
+    DMAs because each region reads only pad cells that are either local
+    or already received (inbound ghost writes are disjoint from the
+    interior/band reads until their semaphore is waited).
     """
     d = r * T
     # Interior + boundary-ghost initialization.  Inbound RDMA targets are
@@ -236,6 +286,40 @@ def _rdma_kernel(in_ref, out_ref, pad, send_sem, recv_sem, *,
     # and drop out statically.
     _neighbor_barrier(up_in, down_in, left_in, right_in, nbr)
 
+    def compute(regions):
+        """T stencil levels for each output rectangle (shared level loop
+        — identical op order / quantize / tap threading to the ppermute
+        fused path and to the serialized whole-block call; a region's
+        level-0 window is the same pad cells the whole-block window
+        reads for those pixels, so bytes cannot differ).  Level-0
+        out-of-image positions are already exact zeros (boundary ghosts
+        zeroed above; the pad-to-multiple rim is zero by the iterate's
+        masking invariant), so no level-0 select tier is needed — only
+        the per-level rank-1 re-zeroing against the region-shifted
+        global-coordinate iotas."""
+        for (r0, r1, c0, c1) in regions:
+            rows0 = cols0 = None
+            if valid_hw is not None:
+                rows0 = (lax.axis_index("x") * h - d + r0
+                         + lax.broadcasted_iota(
+                             jnp.int32, (r1 - r0 + 2 * d, 1), 0))
+                cols0 = (lax.axis_index("y") * w - d + c0
+                         + lax.broadcasted_iota(
+                             jnp.int32, (1, c1 - c0 + 2 * d), 1))
+            for c in range(C):
+                acc = _iterate_levels(
+                    pad[c, r0 : r1 + 2 * d, c0 : c1 + 2 * d],
+                    taps=taps, sep=sep, k=k, r=r, T=T,
+                    out_hw=(r1 - r0, c1 - c0),
+                    quantize=quantize, convex=convex,
+                    round_mode=round_mode,
+                    rows0=rows0, cols0=cols0, valid_hw=valid_hw)
+                out_ref[c, r0:r1, c0:c1] = _from_f32(acc, out_ref.dtype)
+
+    interior, row_bands, col_bands = (
+        overlap_regions(h, w, d) if overlap
+        else ([], [], [(0, h, 0, w)]))  # serialized: one whole-block call
+
     # --- Phase 1: rows.  My top d interior rows -> upper neighbor's
     # bottom ghost; my bottom d interior rows -> lower neighbor's top
     # ghost (d <= h, enforced at the launch).
@@ -249,9 +333,17 @@ def _rdma_kernel(in_ref, out_ref, pad, send_sem, recv_sem, *,
         pad.at[:, 0:d, d : d + w],
         send_sem.at[_DOWN], recv_sem.at[_DOWN], device_id=nbr(+1, 0),
     )
-    if not (periodic and R == 1):
+    row_dma = not (periodic and R == 1)
+    if row_dma:
         _when(up_in)(send_up.start)
         _when(down_in)(send_down.start)
+
+    # Interior-first: the middle of the block needs no ghost byte — its
+    # level-0 window reads only the local interior (which the outbound
+    # sends also read, read-vs-read), never a cell an inbound DMA writes.
+    compute(interior)
+
+    if row_dma:
         _when(up_in)(send_up.wait_send)
         _when(down_in)(send_down.wait_send)
         # My bottom ghost is written by my lower neighbor's send_up copy,
@@ -264,6 +356,7 @@ def _rdma_kernel(in_ref, out_ref, pad, send_sem, recv_sem, *,
     if periodic and Cc == 1:
         pad[:, :, 0:d] = pad[:, :, w : w + d]
         pad[:, :, w + d : w + 2 * d] = pad[:, :, d : 2 * d]
+        compute(row_bands)
     else:
 
         @_unless(left_in)
@@ -286,29 +379,21 @@ def _rdma_kernel(in_ref, out_ref, pad, send_sem, recv_sem, *,
         )
         _when(left_in)(send_left.start)
         _when(right_in)(send_right.start)
+
+        # Top/bottom bands on interior columns read row ghosts (arrived)
+        # plus local interior — never a column-ghost cell, so they hide
+        # the column phase exactly as the interior hid the row phase.
+        compute(row_bands)
+
         _when(left_in)(send_left.wait_send)
         _when(right_in)(send_right.wait_send)
         _when(right_in)(send_left.wait_recv)
         _when(left_in)(send_right.wait_recv)
 
-    # --- Compute: T stencil levels on the fully-padded block (shared
-    # level loop — identical op order / quantize / tap threading to the
-    # ppermute fused path).  Level-0 out-of-image positions are already
-    # exact zeros (boundary ghosts zeroed above; the pad-to-multiple rim
-    # is zero by the iterate's masking invariant), so no level-0 select
-    # tier is needed — only the per-level rank-1 re-zeroing.
-    rows0 = cols0 = None
-    if valid_hw is not None:
-        rows0 = (lax.axis_index("x") * h - d
-                 + lax.broadcasted_iota(jnp.int32, (h + 2 * d, 1), 0))
-        cols0 = (lax.axis_index("y") * w - d
-                 + lax.broadcasted_iota(jnp.int32, (1, w + 2 * d), 1))
-    for c in range(C):
-        acc = _iterate_levels(
-            pad[c], taps=taps, sep=sep, k=k, r=r, T=T, out_hw=(h, w),
-            quantize=quantize, convex=convex, round_mode=round_mode,
-            rows0=rows0, cols0=cols0, valid_hw=valid_hw)
-        out_ref[c] = _from_f32(acc, out_ref.dtype)
+    # --- Rim finish (overlap) / whole-block compute (serialized): the
+    # full-height left/right bands read the column ghosts and the corner
+    # bytes that rode them — everything has landed by now.
+    compute(col_bands)
 
 
 # ---------------------------------------------------------------------------
@@ -356,18 +441,161 @@ def _rdma_kernel(in_ref, out_ref, pad, send_sem, recv_sem, *,
 _TILED_VMEM_BYTES = 10 * 2**20  # monolithic-kernel budget before auto-tiling
 
 
+def _and2(a, b):
+    """``a & b`` with python-bool static folding on either side."""
+    if isinstance(a, bool):
+        return b if a else False
+    if isinstance(b, bool):
+        return a if b else False
+    return jnp.logical_and(a, b)
+
+
+def _or2(a, b):
+    """``a | b`` with python-bool static folding on either side."""
+    if isinstance(a, bool):
+        return True if a else b
+    if isinstance(b, bool):
+        return True if b else a
+    return jnp.logical_or(a, b)
+
+
 def _rdma_tiled_kernel(in_ref, out_ref, pad, win, wsems, xsem, send_sem,
-                       recv_sem, *, taps, sep, k, r, T, C, h, w, R, Cc,
+                       recv_sem, flags, *, taps, sep, k, r, T, C, h, w, R, Cc,
                        periodic, quantize, convex, th, tw, sub_v, round_mode,
-                       valid_hw):
+                       valid_hw, overlap=False):
+    """HBM-pad windowed variant; ``overlap=True`` is the interior-first
+    pipeline at window granularity.
+
+    Serialized (``overlap=False``): the step-0 program completes the
+    whole two-phase exchange before any window is copied — the validated
+    protocol, byte-identical to before this knob existed.
+
+    Overlapped: step 0 only STARTS the row-band DMAs; the window
+    traversal is rotated by one on both grid axes so the rim windows
+    (the only ones whose (ext_h, ext_w) read window reaches a ghost
+    band) are visited last, and a 3-state ledger in SMEM scratch
+    (``flags[0]``: 0 = rows in flight, 1 = rows done + columns in
+    flight, 2 = all landed) defers every semaphore wait to the first
+    window whose read window actually overlaps a still-pending transfer
+    — interior windows stream and compute under the in-flight exchange.
+    Sound because grid programs run sequentially on one core with
+    shared scratch (the same property the step-0-exchange design
+    already relies on), waits recreate the identical copy descriptors,
+    the ledger transitions are monotonic, and the rim windows that
+    trigger each transition provably exist in every grid (window row 0
+    / last row, column 0 / last column).  The column phase still starts
+    only after the row receives (its full-height bands carry the
+    two-hop corner bytes), so the exchange protocol — order, slabs,
+    semaphore pairing — is unchanged; only the waits move later.
+    """
     LANE = 128
     d = r * T  # ghost depth; <= min(sub_v, LANE) so one band carries it
     ext_h, ext_w = th + 2 * sub_v, tw + 2 * LANE
-    c, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    c, vi, vj = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     ni, nj = pl.num_programs(1), pl.num_programs(2)
-    step = (c * ni + i) * nj + j
+    step = (c * ni + vi) * nj + vj
 
     up_in, down_in, left_in, right_in, nbr = _topology(R, Cc, periodic)
+
+    row_remote = R > 1   # remote row-band DMAs exist in this program
+    col_remote = Cc > 1  # remote column-band DMAs exist
+    # Periodic self-wrap columns on a multi-row grid: the local wrap
+    # copies read the FULL padded height, so under overlap they must
+    # run after the row receives — i.e. at the 0->1 ledger transition,
+    # not at step 0 — and windows reading column ghosts must wait on
+    # that transition even though no remote column DMA exists.
+    col_wrap_deferred = periodic and Cc == 1 and row_remote
+    engage = overlap and (row_remote or col_remote)
+    # Window (wi, wj) this program computes: the rotated traversal
+    # visits rim windows last under the overlapped pipeline.  The out
+    # BlockSpec index map applies the SAME rotation (fused_rdma_step).
+    if engage:
+        i, j = lax.rem(vi + 1, ni), lax.rem(vj + 1, nj)
+    else:
+        i, j = vi, vj
+
+    # -- exchange pieces, each buildable at any program (descriptors are
+    # pure functions of the topology; a wait only needs the semaphore).
+    def _local_row_wrap():
+        for src, dst, sl in (((sub_v, 2 * sub_v),
+                              (h + sub_v, h + 2 * sub_v), _UP),
+                             ((h, h + sub_v), (0, sub_v), _DOWN)):
+            cp = pltpu.make_async_copy(
+                pad.at[:, src[0] : src[1], LANE : LANE + w],
+                pad.at[:, dst[0] : dst[1], LANE : LANE + w],
+                send_sem.at[sl])
+            cp.start()
+            cp.wait()
+
+    def _local_col_wrap():
+        for src, dst, sl in (((LANE, 2 * LANE),
+                              (w + LANE, w + 2 * LANE), _LEFT),
+                             ((w, w + LANE), (0, LANE), _RIGHT)):
+            cp = pltpu.make_async_copy(
+                pad.at[:, :, src[0] : src[1]],
+                pad.at[:, :, dst[0] : dst[1]],
+                send_sem.at[sl])
+            cp.start()
+            cp.wait()
+
+    def _row_copies():
+        su = pltpu.make_async_remote_copy(
+            pad.at[:, sub_v : 2 * sub_v, LANE : LANE + w],
+            pad.at[:, h + sub_v : h + 2 * sub_v, LANE : LANE + w],
+            send_sem.at[_UP], recv_sem.at[_UP], device_id=nbr(-1, 0),
+        )
+        sd = pltpu.make_async_remote_copy(
+            pad.at[:, h : h + sub_v, LANE : LANE + w],
+            pad.at[:, 0:sub_v, LANE : LANE + w],
+            send_sem.at[_DOWN], recv_sem.at[_DOWN], device_id=nbr(+1, 0),
+        )
+        return su, sd
+
+    def _col_copies():
+        sl_ = pltpu.make_async_remote_copy(
+            pad.at[:, :, LANE : 2 * LANE],
+            pad.at[:, :, w + LANE : w + 2 * LANE],
+            send_sem.at[_LEFT], recv_sem.at[_LEFT], device_id=nbr(0, -1),
+        )
+        sr = pltpu.make_async_remote_copy(
+            pad.at[:, :, w : w + LANE],
+            pad.at[:, :, 0:LANE],
+            send_sem.at[_RIGHT], recv_sem.at[_RIGHT], device_id=nbr(0, +1),
+        )
+        return sl_, sr
+
+    def _start_rows():
+        su, sd = _row_copies()
+        _when(up_in)(su.start)
+        _when(down_in)(sd.start)
+
+    def _wait_rows():
+        su, sd = _row_copies()
+        _when(up_in)(su.wait_send)
+        _when(down_in)(sd.wait_send)
+        # My top ghost is written by my upper neighbor's send_down (it
+        # signals MY recv_sem[_DOWN]) and vice versa — SPMD symmetry.
+        _when(down_in)(su.wait_recv)
+        _when(up_in)(sd.wait_recv)
+
+    def _start_cols():
+        # Phase 2 initiation: column bands at FULL padded height — the
+        # transferred bands carry the just-arrived row ghosts, so
+        # corners propagate in two hops exactly as in halo.py / the
+        # monolithic kernel.  Callable only after the row phase landed.
+        if periodic and Cc == 1:
+            _local_col_wrap()
+        elif col_remote:
+            sl_, sr = _col_copies()
+            _when(left_in)(sl_.start)
+            _when(right_in)(sr.start)
+
+    def _wait_cols():
+        sl_, sr = _col_copies()
+        _when(left_in)(sl_.wait_send)
+        _when(right_in)(sr.wait_send)
+        _when(right_in)(sl_.wait_recv)
+        _when(left_in)(sr.wait_recv)
 
     @pl.when(step == 0)
     def _exchange():
@@ -379,71 +607,76 @@ def _rdma_tiled_kernel(in_ref, out_ref, pad, win, wsems, xsem, send_sem,
 
         _neighbor_barrier(up_in, down_in, left_in, right_in, nbr)
 
-        # Phase 1: row bands (interior cols only; ghost cols not yet live).
+        # Phase 1: row bands (interior cols only; ghost cols not yet
+        # live).  Torus of height 1: own opposite edge, local aligned
+        # copies — complete synchronously here either way.
         if periodic and R == 1:
-            # Torus of height 1: own opposite edge, local aligned copies.
-            for src, dst, sl in (((sub_v, 2 * sub_v),
-                                  (h + sub_v, h + 2 * sub_v), _UP),
-                                 ((h, h + sub_v), (0, sub_v), _DOWN)):
-                cp = pltpu.make_async_copy(
-                    pad.at[:, src[0] : src[1], LANE : LANE + w],
-                    pad.at[:, dst[0] : dst[1], LANE : LANE + w],
-                    send_sem.at[sl])
-                cp.start()
-                cp.wait()
+            _local_row_wrap()
+        if not engage:
+            # Serialized: the whole exchange completes before any window.
+            if row_remote:
+                _start_rows()
+                _wait_rows()
+            if periodic and Cc == 1:
+                _local_col_wrap()
+            elif col_remote:
+                _start_cols()
+                _wait_cols()
         else:
-            send_up = pltpu.make_async_remote_copy(
-                pad.at[:, sub_v : 2 * sub_v, LANE : LANE + w],
-                pad.at[:, h + sub_v : h + 2 * sub_v, LANE : LANE + w],
-                send_sem.at[_UP], recv_sem.at[_UP], device_id=nbr(-1, 0),
-            )
-            send_down = pltpu.make_async_remote_copy(
-                pad.at[:, h : h + sub_v, LANE : LANE + w],
-                pad.at[:, 0:sub_v, LANE : LANE + w],
-                send_sem.at[_DOWN], recv_sem.at[_DOWN], device_id=nbr(+1, 0),
-            )
-            _when(up_in)(send_up.start)
-            _when(down_in)(send_down.start)
-            _when(up_in)(send_up.wait_send)
-            _when(down_in)(send_down.wait_send)
-            _when(down_in)(send_up.wait_recv)
-            _when(up_in)(send_down.wait_recv)
+            if row_remote:
+                _start_rows()
+                flags[0] = jnp.int32(0)
+            else:
+                # Rows already complete (local wrap / no axis): the
+                # column phase can start under the very first windows.
+                _start_cols()
+                flags[0] = jnp.int32(1 if col_remote else 2)
 
-        # Phase 2: column bands at FULL padded height — the transferred
-        # bands carry the just-arrived row ghosts, so corners propagate in
-        # two hops exactly as in halo.py / the monolithic kernel.
-        if periodic and Cc == 1:
-            for src, dst, sl in (((LANE, 2 * LANE),
-                                  (w + LANE, w + 2 * LANE), _LEFT),
-                                 ((w, w + LANE), (0, LANE), _RIGHT)):
-                cp = pltpu.make_async_copy(
-                    pad.at[:, :, src[0] : src[1]],
-                    pad.at[:, :, dst[0] : dst[1]],
-                    send_sem.at[sl])
-                cp.start()
-                cp.wait()
+    # -- deferred-wait guard: runs before a window copy is ISSUED, with
+    # the window's indices — waits exactly when that window's read
+    # region overlaps a still-pending transfer, advancing the ledger.
+    def _ensure(wi, wj):
+        if not engage:
+            return
+        # Geometric touch: the (ext_h, ext_w) read window vs the four
+        # ghost bands; hazardous only where an actual transfer writes
+        # (the _in predicates — non-live ghost regions hold garbage the
+        # valid-box mask kills, no ordering needed).
+        top, bot = wi == 0, wi * th + ext_h > h + sub_v
+        lef, rig = wj == 0, wj * tw + ext_w > w + LANE
+        need_row = (_or2(_and2(top, up_in), _and2(bot, down_in))
+                    if row_remote else False)
+        if col_remote:
+            need_col = _or2(_and2(lef, left_in), _and2(rig, right_in))
+        elif col_wrap_deferred:
+            # Self-wrap ghosts are VALID data (periodic valid box), but
+            # written only at the 0->1 transition — any reader waits.
+            need_col = _or2(lef, rig)
         else:
-            send_left = pltpu.make_async_remote_copy(
-                pad.at[:, :, LANE : 2 * LANE],
-                pad.at[:, :, w + LANE : w + 2 * LANE],
-                send_sem.at[_LEFT], recv_sem.at[_LEFT], device_id=nbr(0, -1),
-            )
-            send_right = pltpu.make_async_remote_copy(
-                pad.at[:, :, w : w + LANE],
-                pad.at[:, :, 0:LANE],
-                send_sem.at[_RIGHT], recv_sem.at[_RIGHT], device_id=nbr(0, +1),
-            )
-            _when(left_in)(send_left.start)
-            _when(right_in)(send_right.start)
-            _when(left_in)(send_left.wait_send)
-            _when(right_in)(send_right.wait_send)
-            _when(right_in)(send_left.wait_recv)
-            _when(left_in)(send_right.wait_recv)
+            need_col = False
+        need_any = _or2(need_row, need_col)
+
+        @_when(_and2(need_any, flags[0] == 0))
+        def _():
+            _wait_rows()
+            _start_cols()
+            flags[0] = jnp.int32(1 if col_remote else 2)
+
+        if col_remote and need_col is not False:
+            @_when(_and2(need_col, flags[0] == 1))
+            def _():
+                _wait_cols()
+                flags[0] = jnp.int32(2)
 
     # --- Compute: the _stencil_kernel windowed-DMA grid over the HBM pad.
-    def window_copy(cc, ii, jj, s):
+    def window_copy(cc, ai, aj, s):
+        if engage:
+            wi, wj = lax.rem(ai + 1, ni), lax.rem(aj + 1, nj)
+        else:
+            wi, wj = ai, aj
+        _ensure(wi, wj)
         return pltpu.make_async_copy(
-            pad.at[cc, pl.ds(ii * th, ext_h), pl.ds(jj * tw, ext_w)],
+            pad.at[cc, pl.ds(wi * th, ext_h), pl.ds(wj * tw, ext_w)],
             win.at[s], wsems.at[s])
 
     slot = _prefetch_window(window_copy)
@@ -493,7 +726,7 @@ def _rdma_tiled_kernel(in_ref, out_ref, pad, win, wsems, xsem, send_sem,
     jax.jit,
     static_argnames=("filt", "grid", "boundary", "quantize", "out_dtype",
                      "interpret", "tiled", "tile", "pad_operand", "fuse",
-                     "valid_hw"),
+                     "valid_hw", "overlap"),
 )
 def fused_rdma_step(
     block: jnp.ndarray,
@@ -508,6 +741,7 @@ def fused_rdma_step(
     pad_operand: bool | None = None,
     fuse: int = 1,
     valid_hw: tuple[int, int] | None = None,
+    overlap: bool = False,
 ) -> jnp.ndarray:
     """``fuse`` halo-fused stencil iterations, entirely inside one kernel.
 
@@ -536,6 +770,21 @@ def fused_rdma_step(
     HBM-pad + windowed-DMA variant (``_rdma_tiled_kernel``); small blocks
     keep the all-VMEM kernel (lower latency, no per-window DMA).  ``tile``
     sets the tiled variant's output tile (default ``DEFAULT_TILE``).
+
+    ``overlap=True`` selects the interior-first overlapped pipeline in
+    BOTH kernels (see ``_rdma_kernel`` / ``_rdma_tiled_kernel``): the
+    ghost-band DMAs fly while ghost-free compute proceeds, and the
+    receive waits retire immediately before the first compute that
+    reads them — byte-identical to the serialized order for every
+    (boundary, fuse, grid, storage) combination, because only
+    independent per-pixel work is reordered (proven in
+    tests/test_overlap.py; multi-device cells need the faithful
+    interpreter or silicon).  The monolithic kernel always emits the
+    region-split program when asked (degenerate regions clamp away);
+    the tiled kernel engages only when a remote axis exists — on a 1x1
+    grid its program is the serialized one verbatim.  The dispatch
+    layer (``parallel/step.py``) resolves when this knob is on; callers
+    there never pass it blindly.
 
     ``pad_operand`` (tiled variant only) chooses how the HBM pad buffer
     is provided.  ``False``: as an ``pltpu.MemorySpace.HBM``
@@ -625,6 +874,7 @@ def fused_rdma_step(
             _rdma_kernel, taps=taps, sep=sep, k=k, r=r, T=T, C=C, h=h, w=w,
             R=grid[0], Cc=grid[1], periodic=periodic, quantize=quantize,
             convex=filt.convex, round_mode=round_mode, valid_hw=kern_valid,
+            overlap=bool(overlap),
         )
         return pl.pallas_call(
             kernel,
@@ -671,15 +921,24 @@ def fused_rdma_step(
         _rdma_tiled_kernel, taps=taps, sep=sep, k=k, r=r, T=T, C=C, h=h,
         w=w, R=grid[0], Cc=grid[1], periodic=periodic, quantize=quantize,
         convex=filt.convex, th=th, tw=tw, sub_v=sub_v,
-        round_mode=round_mode, valid_hw=kern_valid,
+        round_mode=round_mode, valid_hw=kern_valid, overlap=bool(overlap),
     )
+    # Rim-last traversal under the overlapped pipeline: the out index
+    # map applies the same +1 rotation the kernel applies to its window
+    # indices, so program p's out block IS the window it computed.
+    engage = bool(overlap) and (grid[0] > 1 or grid[1] > 1)
     vmem_scratch = [
         pltpu.VMEM((2, ext_h, ext_w), block.dtype),
         pltpu.SemaphoreType.DMA((2,)),
         pltpu.SemaphoreType.DMA(()),
         pltpu.SemaphoreType.DMA((4,)),
         pltpu.SemaphoreType.DMA((4,)),
+        pltpu.SMEM((1,), jnp.int32),  # deferred-wait ledger (overlap)
     ]
+    if engage:
+        out_idx = lambda c, a, b: (c, (a + 1) % gh, (b + 1) % gw)
+    else:
+        out_idx = lambda c, i, j: (c, i, j)
     if pad_operand is None:
         # Resolve from the EXECUTION mode already decided above, not the
         # global backend: a TPU-default process driving a forced-CPU mesh
@@ -701,7 +960,7 @@ def fused_rdma_step(
             kernel,
             grid=(C, gh, gw),
             in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-            out_specs=(pl.BlockSpec((1, th, tw), lambda c, i, j: (c, i, j)),
+            out_specs=(pl.BlockSpec((1, th, tw), out_idx),
                        pl.BlockSpec(memory_space=pl.ANY)),
             out_shape=(shape_struct((C, gh * th, gw * tw), out_dtype, vma),
                        shape_struct((C, h_pad, w_pad), block.dtype, vma)),
@@ -714,7 +973,7 @@ def fused_rdma_step(
         kernel,
         grid=(C, gh, gw),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec((1, th, tw), lambda c, i, j: (c, i, j)),
+        out_specs=pl.BlockSpec((1, th, tw), out_idx),
         out_shape=shape_struct((C, gh * th, gw * tw), out_dtype, vma),
         scratch_shapes=[hbm_scratch((C, h_pad, w_pad),
                                     block.dtype)] + vmem_scratch,

@@ -23,7 +23,9 @@ bit-identical to the serial oracle for any mesh shape.
 
 from __future__ import annotations
 
+import os
 import time
+import warnings
 from functools import lru_cache
 
 import jax
@@ -71,7 +73,8 @@ def _note_compile(builder: str, backend: str, grid, iters: int, fuse: int,
 def _record_step_obs(backend: str, mesh: Mesh, block_hw, radius: int,
                      fuse: int, iters: int, channels: int, storage: str,
                      boundary: str, wall_s: float | None, shape,
-                     quantize: bool, tile, source: str) -> None:
+                     quantize: bool, tile, source: str,
+                     overlap: bool = False) -> None:
     from parallel_convolution_tpu.obs import attribution
 
     grid = grid_shape(mesh)
@@ -81,7 +84,8 @@ def _record_step_obs(backend: str, mesh: Mesh, block_hw, radius: int,
         fuse=fuse, iters=iters, channels=channels, storage=storage,
         boundary=boundary, wall_s=wall_s, shape=shape, quantize=quantize,
         tile=tile, platform=dev0.platform,
-        device_kind=getattr(dev0, "device_kind", "") or "", source=source)
+        device_kind=getattr(dev0, "device_kind", "") or "", source=source,
+        overlap=overlap)
 
 
 def _valid_mask(valid_hw, block_hw, margin: int = 0):
@@ -104,6 +108,69 @@ def _valid_mask(valid_hw, block_hw, margin: int = 0):
     return ok[None].astype(jnp.float32)
 
 
+# Overlap resolution warn-once registry (one line per cause per process;
+# the stamped knob, not stderr, is the durable record).
+_OVERLAP_WARNED: set = set()
+
+# Env escape hatch: run the overlapped program under interpreted Pallas
+# anyway.  The CPU shim has no real async semaphore timing, so overlap
+# buys nothing there and is force-serialized by default — but CI byte
+# proofs (scripts/rdma_fuse_ab.py --overlap, the --overlap-smoke leg)
+# must drive the overlapped PROGRAM through the full dispatch stack.
+# Canonical name lives in the jax-free config registry; re-exported here
+# because dispatch call sites (and tests) historically read it off step.
+from parallel_convolution_tpu.utils.config import (  # noqa: E402
+    OVERLAP_INTERPRET_ENV,
+)
+
+
+def _warn_overlap_once(cause: str, msg: str) -> None:
+    if cause in _OVERLAP_WARNED:
+        return
+    _OVERLAP_WARNED.add(cause)
+    warnings.warn(msg, UserWarning, stacklevel=3)
+
+
+def resolve_overlap(overlap: bool | None, backend: str, mesh: Mesh) -> bool:
+    """The overlap knob a launch will ACTUALLY compile with.
+
+    ``None`` (the explicit-backend default) resolves to False — the
+    serialized order; ``backend="auto"`` callers get a concrete bool
+    from the tuning resolver before reaching here.  ``True`` is a
+    clamped request, mirrored by ``tuning.resolve``:
+
+    * only the RDMA kernels have an overlapped pipeline — any other
+      backend force-serializes with a one-time warning;
+    * interpreted Pallas (a CPU mesh) force-serializes with a one-time
+      warning: the interpreter's DMAs have no real async timing, so the
+      pipeline proves nothing and costs trace complexity — UNLESS
+      ``PCTPU_OVERLAP_INTERPRET=1``, the CI byte-proof escape hatch
+      (the A/B harness and the --overlap-smoke leg run the overlapped
+      program through the whole dispatch stack to prove byte equality).
+
+    Every bench row / serving response stamps the RESOLVED value, so a
+    clamp is visible in artifacts, never only on stderr.
+    """
+    if overlap is None or not overlap:
+        return False
+    if backend != "pallas_rdma":
+        _warn_overlap_once(
+            f"backend:{backend}",
+            f"overlap=True requested but backend {backend!r} has no "
+            "overlapped halo pipeline (RDMA kernels only); running "
+            "serialized — rows stamp overlap=False")
+        return False
+    if _mesh_interpret(mesh) and not os.environ.get(OVERLAP_INTERPRET_ENV):
+        _warn_overlap_once(
+            "interpret",
+            "overlap=True force-serialized under interpreted Pallas (the "
+            "CPU shim has no real async semaphore timing; set "
+            f"{OVERLAP_INTERPRET_ENV}=1 to run the overlapped program "
+            "anyway for byte proofs) — rows stamp overlap=False")
+        return False
+    return True
+
+
 def _axis_class_index(a, n: int):
     """Dynamic index of device ``a``'s offset class along an ``n``-device
     axis, matching ``pallas_stencil.axis_offset_classes`` order."""
@@ -118,7 +185,8 @@ def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
                      backend: str, fuse: int = 1, boundary: str = "zero",
                      tile: tuple[int, int] | None = None,
                      interpret: bool | None = None,
-                     interior_split: bool = False):
+                     interior_split: bool = False,
+                     overlap: bool = False):
     """``fuse`` iterations on a local block per halo exchange.
 
     fuse=1 is the reference's loop shape: exchange 1-deep halos, stencil,
@@ -176,6 +244,7 @@ def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
                 v, filt, grid, boundary, quantize=quantize,
                 out_dtype=v.dtype, tile=tile, interpret=interpret,
                 fuse=fuse, valid_hw=None if periodic else tuple(valid_hw),
+                overlap=overlap,
             )
             if needs_mask and fuse == 1:
                 p = p * _valid_mask(valid_hw, block_hw).astype(p.dtype)
@@ -259,8 +328,14 @@ def _build_iterate(mesh: Mesh, filt: Filter, iters: int, quantize: bool,
                    valid_hw, block_hw, backend: str, fuse: int = 1,
                    boundary: str = "zero",
                    tile: tuple[int, int] | None = None,
-                   interior_split: bool = False):
-    """Compile the fixed-count iteration runner for one (mesh, config)."""
+                   interior_split: bool = False,
+                   overlap: bool = False):
+    """Compile the fixed-count iteration runner for one (mesh, config).
+
+    ``overlap`` must already be RESOLVED (``resolve_overlap``) — this
+    layer compiles exactly what it is told, so the stamped knob and the
+    executable can never disagree.
+    """
     # Consulted only on lru_cache misses — i.e. exactly when a fresh
     # trace/compile happens, the event the 'backend_compile' site models.
     fault_point("backend_compile")
@@ -275,11 +350,11 @@ def _build_iterate(mesh: Mesh, filt: Filter, iters: int, quantize: bool,
     interp = _mesh_interpret(mesh)
     chunk = _make_block_step(filt, grid, valid_hw, block_hw, quantize,
                              backend, fuse, boundary, tile, interp,
-                             interior_split)
+                             interior_split, overlap)
     n_chunks, rem = divmod(iters, fuse)
     tail = (_make_block_step(filt, grid, valid_hw, block_hw, quantize,
                              backend, rem, boundary, tile, interp,
-                             interior_split)
+                             interior_split, overlap)
             if rem else None)
 
     def body(block):
@@ -300,7 +375,8 @@ def _build_converge(mesh: Mesh, filt: Filter, tol: float, max_iters: int,
                     check_every: int, quantize: bool, valid_hw, block_hw,
                     backend: str, boundary: str = "zero", fuse: int = 1,
                     tile: tuple[int, int] | None = None,
-                    interior_split: bool = False):
+                    interior_split: bool = False,
+                    overlap: bool = False):
     """Compile the run-to-convergence runner (C6: every-N diff + allreduce).
 
     ``fuse``/``tile`` are the flagship iteration knobs (temporal fusion,
@@ -332,10 +408,11 @@ def _build_converge(mesh: Mesh, filt: Filter, tol: float, max_iters: int,
                   block_hw)
     interp = _mesh_interpret(mesh)
     step = _make_block_step(filt, grid, valid_hw, block_hw, quantize, backend,
-                            boundary=boundary, tile=tile, interpret=interp)
+                            boundary=boundary, tile=tile, interpret=interp,
+                            overlap=overlap)
     fused = (_make_block_step(filt, grid, valid_hw, block_hw, quantize,
                               backend, fuse, boundary, tile, interp,
-                              interior_split)
+                              interior_split, overlap)
              if fuse > 1 else None)
 
     def body(block):
@@ -518,15 +595,19 @@ def _storage_name(dtype) -> str:
 
 
 def _resolve_auto(mesh, filt, backend, fuse, tile, storage, quantize,
-                  boundary, valid_hw, channels, check_every=None):
-    """``backend='auto'`` -> concrete ``(backend, fuse, tile, source)``.
+                  boundary, valid_hw, channels, check_every=None,
+                  overlap=None):
+    """``backend='auto'`` -> concrete
+    ``(backend, fuse, tile, overlap, source)``.
 
     Resolution goes through the tuning subsystem (plan cache if a
     ``PCTPU_PLAN_FILE`` is armed, else the cost model) and happens
     BEFORE the resilience degrade walk — auto picks the tier, the
     fallback probe then guards the resolved launch exactly as it guards
     an explicitly-named one.  Explicit backends pass through untouched
-    (``fuse=None`` then just normalizes to 1, the historical default).
+    (``fuse=None`` then just normalizes to 1, the historical default;
+    ``overlap`` stays as requested for :func:`resolve_overlap` to
+    settle against the mesh).
 
     ``check_every`` (the convergence path only) is part of the tuning
     identity: it bounds the legal fusion depth (a chunk fuses at most
@@ -534,32 +615,36 @@ def _resolve_auto(mesh, filt, backend, fuse, tile, storage, quantize,
     convergence run resolves its own plan rather than a fixed-count one.
     """
     if backend != AUTO:
-        return backend, (1 if fuse is None else int(fuse)), tile, None
+        return backend, (1 if fuse is None else int(fuse)), tile, overlap, None
     from parallel_convolution_tpu import tuning
 
     res = tuning.resolve(
         mesh, filt, (channels, valid_hw[0], valid_hw[1]), storage=storage,
         quantize=quantize, boundary=boundary, fuse=fuse,
-        tile=_norm_tile(tile), check_every=check_every)
-    return res.backend, res.fuse, res.tile, res.source
+        tile=_norm_tile(tile), overlap=overlap, check_every=check_every)
+    return res.backend, res.fuse, res.tile, res.overlap, res.source
 
 
 def _resolve_fallback(mesh, filt, backend, quantize, fuse, boundary, tile,
                       interior_split, storage="f32",
-                      block_hw=None) -> str:
+                      block_hw=None, overlap: bool = False) -> str:
     """Walk the degradation chain (resilience.degrade) for this config.
 
     ``block_hw``/``storage`` must describe the REAL run: kernel selection
     depends on both (e.g. pallas_rdma's tiled-vs-monolithic switch), so a
     probe on a different geometry or dtype could pass while the real
     launch crashes — exactly the gap this probe exists to close.
+    ``overlap`` likewise: the overlapped RDMA program is a different
+    kernel than the serialized one, so the probe must compile the same
+    form (degrade clamps it per walked tier — only the RDMA tier has an
+    overlapped form).
     """
     from parallel_convolution_tpu.resilience import degrade
 
     return degrade.resolve_backend(
         mesh, filt, backend, quantize=quantize, fuse=fuse, boundary=boundary,
         tile=tile, interior_split=interior_split, storage=storage,
-        block_hw=block_hw)
+        block_hw=block_hw, overlap=overlap)
 
 
 def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
@@ -569,7 +654,8 @@ def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
                      tile: tuple[int, int] | None = None,
                      interior_split: bool = False,
                      check_contract: bool = True,
-                     fallback: bool = False):
+                     fallback: bool = False,
+                     overlap: bool | None = None):
     """Iterate an already-sharded padded (C, Hp, Wp) array in place(-ish).
 
     The zero-copy entry for huge images loaded via utils.sharded_io: input
@@ -594,6 +680,12 @@ def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
     (plan cache, else cost model; ``fuse=None``/``tile=None`` are then
     tuned too, non-None values are pins) — the degrade walk below
     applies to the *resolved* backend.
+
+    ``overlap`` selects the interior-first overlapped halo pipeline in
+    the RDMA kernels (None = off for explicit backends, tuned for
+    ``backend="auto"``); the resolved bool — clamped by
+    :func:`resolve_overlap` and re-clamped to False if the degrade walk
+    leaves the RDMA tier — is what actually compiles.
     """
     if jnp.dtype(xs.dtype) == jnp.uint8 and not quantize:
         _check_storage("u8", quantize)  # public entry: same guard as above
@@ -601,18 +693,20 @@ def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
         _check_quantize_contract(xs, filt, quantize)
     R, Cc = grid_shape(mesh)
     block_hw = (xs.shape[1] // R, xs.shape[2] // Cc)
-    backend, fuse, tile, _ = _resolve_auto(
+    backend, fuse, tile, overlap, _ = _resolve_auto(
         mesh, filt, backend, fuse, tile, _storage_name(xs.dtype), quantize,
-        boundary, tuple(valid_hw), xs.shape[0])
+        boundary, tuple(valid_hw), xs.shape[0], overlap=overlap)
+    overlap = resolve_overlap(overlap, backend, mesh)
     if fallback:
         backend = _resolve_fallback(mesh, filt, backend, quantize, fuse,
                                     boundary, _norm_tile(tile),
                                     interior_split,
                                     storage=_storage_name(xs.dtype),
-                                    block_hw=block_hw)
+                                    block_hw=block_hw, overlap=overlap)
+        overlap = overlap and backend == "pallas_rdma"
     fn = _build_iterate(mesh, filt, iters, quantize, tuple(valid_hw),
                         block_hw, backend, fuse, boundary, _norm_tile(tile),
-                        interior_split)
+                        interior_split, overlap)
     if not obs_metrics.enabled():
         return fn(xs)
     # Observed mode: attribute halo bytes/rounds and emit the exchange
@@ -627,7 +721,7 @@ def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
                      max(1, min(fuse, iters or 1)), iters, channels,
                      _storage_name(out.dtype), boundary, None, shape,
                      quantize, _norm_tile(tile),
-                     source="iterate_prepared")
+                     source="iterate_prepared", overlap=overlap)
     return out
 
 
@@ -637,7 +731,8 @@ def sharded_iterate(x, filt: Filter, iters: int, mesh: Mesh | None = None,
                     boundary: str = "zero",
                     tile: tuple[int, int] | None = None,
                     interior_split: bool = False,
-                    fallback: bool = False):
+                    fallback: bool = False,
+                    overlap: bool | None = None):
     """Run ``iters`` stencil iterations of a global (C, H, W) f32 image
     sharded over the 2D mesh.  Returns the global (C, H, W) f32 result
     (bit-identical to the serial oracle for any mesh shape).
@@ -663,7 +758,8 @@ def sharded_iterate(x, filt: Filter, iters: int, mesh: Mesh | None = None,
     out = iterate_prepared(xs, filt, iters, mesh, valid_hw,
                            quantize=quantize, backend=backend, fuse=fuse,
                            boundary=boundary, tile=tile,
-                           interior_split=interior_split, fallback=fallback)
+                           interior_split=interior_split, fallback=fallback,
+                           overlap=overlap)
     return out[:, : valid_hw[0], : valid_hw[1]].astype(jnp.float32)
 
 
@@ -673,7 +769,8 @@ def sharded_converge(x, filt: Filter, tol: float, max_iters: int,
                      storage: str = "f32", boundary: str = "zero",
                      fuse: int | None = 1,
                      tile: tuple[int, int] | None = None,
-                     interior_split: bool = False, fallback: bool = False):
+                     interior_split: bool = False, fallback: bool = False,
+                     overlap: bool | None = None):
     """Run-to-convergence (BASELINE config 5).  Returns (result, iters_run).
 
     ``fuse``/``tile`` mirror :func:`sharded_iterate`: fused chunks run
@@ -685,19 +782,22 @@ def sharded_converge(x, filt: Filter, tol: float, max_iters: int,
         mesh = make_grid_mesh()
     _check_storage(storage, quantize)
     xs, valid_hw, block_hw = _prepare(x, mesh, filt.radius, storage)
-    backend, fuse, tile, _ = _resolve_auto(
+    backend, fuse, tile, overlap, _ = _resolve_auto(
         mesh, filt, backend, fuse, tile, storage, quantize, boundary,
-        tuple(valid_hw), xs.shape[0], check_every=int(check_every))
+        tuple(valid_hw), xs.shape[0], check_every=int(check_every),
+        overlap=overlap)
+    overlap = resolve_overlap(overlap, backend, mesh)
     if fallback:
         backend = _resolve_fallback(mesh, filt, backend, quantize, fuse,
                                     boundary, _norm_tile(tile),
                                     interior_split, storage,
-                                    block_hw=block_hw)
+                                    block_hw=block_hw, overlap=overlap)
+        overlap = overlap and backend == "pallas_rdma"
     _check_quantize_contract(xs, filt, quantize)
     fn = _build_converge(mesh, filt, float(tol), int(max_iters),
                          int(check_every), quantize, valid_hw, block_hw,
                          backend, boundary, int(fuse), _norm_tile(tile),
-                         interior_split)
+                         interior_split, overlap)
     channels, shape = xs.shape[0], tuple(xs.shape)
     t0 = time.perf_counter()
     out, done = fn(xs)
@@ -707,5 +807,6 @@ def sharded_converge(x, filt: Filter, tol: float, max_iters: int,
                          max(1, min(int(fuse), max(1, check_every - 1))),
                          done, channels, storage, boundary,
                          time.perf_counter() - t0, shape, quantize,
-                         _norm_tile(tile), source="sharded_converge")
+                         _norm_tile(tile), source="sharded_converge",
+                         overlap=overlap)
     return out[:, : valid_hw[0], : valid_hw[1]].astype(jnp.float32), done

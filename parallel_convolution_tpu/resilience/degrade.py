@@ -76,9 +76,11 @@ def clear_probe_cache() -> None:
 
 
 def _probe_key(mesh, filt: Filter, backend: str, quantize, fuse, boundary,
-               tile, interior_split, storage, block_hw) -> tuple:
+               tile, interior_split, storage, block_hw,
+               overlap=False) -> tuple:
     return (mesh, filt.name, filt.radius, backend, bool(quantize), int(fuse),
-            boundary, tile, bool(interior_split), storage, block_hw)
+            boundary, tile, bool(interior_split), storage, block_hw,
+            bool(overlap))
 
 
 def probe_backend(mesh, filt: Filter, backend: str, *, quantize: bool = True,
@@ -86,7 +88,8 @@ def probe_backend(mesh, filt: Filter, backend: str, *, quantize: bool = True,
                   tile: tuple[int, int] | None = None,
                   interior_split: bool = False,
                   storage: str = "f32",
-                  block_hw: tuple[int, int] | None = None) -> None:
+                  block_hw: tuple[int, int] | None = None,
+                  overlap: bool = False) -> None:
     """Compile + run one ``fuse``-iteration sharded chunk of ``backend``.
 
     Raises whatever the compile/launch raised (replayed from cache on
@@ -103,7 +106,7 @@ def probe_backend(mesh, filt: Filter, backend: str, *, quantize: bool = True,
     backend, config) per process.
     """
     key = _probe_key(mesh, filt, backend, quantize, fuse, boundary, tile,
-                     interior_split, storage, block_hw)
+                     interior_split, storage, block_hw, overlap)
     if key in _PROBE_CACHE:
         err = _PROBE_CACHE[key]
         if err is not None:
@@ -111,7 +114,7 @@ def probe_backend(mesh, filt: Filter, backend: str, *, quantize: bool = True,
         return
     try:
         _run_probe(mesh, filt, backend, quantize, fuse, boundary, tile,
-                   interior_split, storage, block_hw)
+                   interior_split, storage, block_hw, overlap)
     except Exception as e:  # noqa: BLE001 — the verdict IS the product
         _PROBE_CACHE[key] = e
         raise
@@ -119,7 +122,7 @@ def probe_backend(mesh, filt: Filter, backend: str, *, quantize: bool = True,
 
 
 def _run_probe(mesh, filt, backend, quantize, fuse, boundary, tile,
-               interior_split, storage, block_hw) -> None:
+               interior_split, storage, block_hw, overlap=False) -> None:
     import jax
     import numpy as np
 
@@ -136,7 +139,7 @@ def _run_probe(mesh, filt, backend, quantize, fuse, boundary, tile,
     xs, valid_hw, block_hw = step_lib._prepare(x, mesh, filt.radius, storage)
     fn = step_lib._build_iterate(mesh, filt, fuse, quantize, valid_hw,
                                  block_hw, backend, fuse, boundary, tile,
-                                 interior_split)
+                                 interior_split, overlap)
     jax.block_until_ready(fn(xs))
 
 
@@ -157,6 +160,7 @@ def resolve_backend(mesh, filt: Filter, backend: str, *, quantize: bool = True,
                     tile: tuple[int, int] | None = None,
                     interior_split: bool = False, storage: str = "f32",
                     block_hw: tuple[int, int] | None = None,
+                    overlap: bool = False,
                     warn: bool = True) -> str:
     """Return the first backend in ``degradation_chain(backend)`` whose
     probe passes; raise immediately on a terminal probe failure.
@@ -165,6 +169,12 @@ def resolve_backend(mesh, filt: Filter, backend: str, *, quantize: bool = True,
     request — callers (``utils.bench``, ``ConvolutionModel``) additionally
     stamp the returned name into their rows/attributes so the degradation
     is visible in artifacts, not only on stderr.
+
+    ``overlap`` is clamped per walked tier (only the RDMA kernels have
+    an overlapped form), so each probe compiles exactly the program the
+    real launch would use on that tier — including the case where the
+    OVERLAPPED RDMA program fails transiently and the walk lands on a
+    serialized lower tier.
     """
     chain = degradation_chain(backend)
     last: BaseException | None = None
@@ -173,7 +183,8 @@ def resolve_backend(mesh, filt: Filter, backend: str, *, quantize: bool = True,
             probe_backend(mesh, filt, b, quantize=quantize, fuse=fuse,
                           boundary=boundary, tile=tile,
                           interior_split=interior_split, storage=storage,
-                          block_hw=block_hw)
+                          block_hw=block_hw,
+                          overlap=bool(overlap) and b == "pallas_rdma")
         except Exception as e:  # noqa: BLE001
             if classify(e) == TERMINAL:
                 raise

@@ -79,6 +79,10 @@ class EngineKey:
     backend: str = "shifted"         # requested; the entry records effective
     grid: tuple[int, int] = (1, 1)   # mesh grid (rows, cols)
     tile: tuple[int, int] | None = None  # Pallas kernel tile (None=default)
+    overlap: bool = False            # RESOLVED interior-first overlapped
+    #                                  halo pipeline knob (resolve_key
+    #                                  settles None/auto before keying, so
+    #                                  equal executables share one key)
 
     def validate(self) -> None:
         """Terminal (ValueError) on any out-of-registry field — the typed
@@ -111,7 +115,8 @@ class _Entry:
     """One warm key: resolved backend + compiled runners per batch size."""
 
     __slots__ = ("key", "effective_backend", "fns", "lock", "plan_source",
-                 "predicted_gpx", "plan_key")
+                 "predicted_gpx", "plan_key", "effective_overlap",
+                 "splits")
 
     def __init__(self, key: EngineKey, effective_backend: str,
                  plan_source: str = "explicit",
@@ -119,12 +124,22 @@ class _Entry:
                  plan_key: str = ""):
         self.key = key
         self.effective_backend = effective_backend
+        # The overlap knob the executables are ACTUALLY compiled with:
+        # the key's resolved value, re-clamped to False when the degrade
+        # walk left the RDMA tier (only that tier has an overlapped form).
+        self.effective_overlap = bool(
+            key.overlap) and effective_backend == "pallas_rdma"
         self.plan_source = plan_source       # explicit|measured|
         #                                      interpolated|predicted
         self.predicted_gpx = predicted_gpx   # cost-model Gpx/s/chip
         self.plan_key = plan_key             # tuning canonical key: the
         #                                      drift series' label
         self.fns: dict[int, object] = {}   # batch size -> jitted runner
+        self.splits: dict[int, dict] = {}  # batch size -> exchange split
+        #                                    (pure model math, cached off
+        #                                    the per-request hot path;
+        #                                    batch-dependent only via the
+        #                                    RDMA tiled-kernel switch)
         self.lock = threading.Lock()       # per-batch-size build flight
 
 
@@ -248,6 +263,8 @@ class WarmEngine:
         """
         from parallel_convolution_tpu.parallel.mesh import grid_shape
 
+        from parallel_convolution_tpu.parallel import step as step_lib
+
         kw = dict(kw)
         plan_source = "explicit"
         if kw.get("backend") == "auto":
@@ -260,10 +277,18 @@ class WarmEngine:
                 quantize=bool(kw.get("quantize", True)),
                 boundary=kw.get("boundary", "zero"),
                 fuse=kw.get("fuse"), tile=kw.get("tile"),
+                overlap=kw.get("overlap"),
                 plans=self.plans)
             kw["backend"] = res.backend
             kw["fuse"], kw["tile"] = res.fuse, res.tile
+            kw["overlap"] = res.overlap
             plan_source = res.source
+        # Settle the overlap knob BEFORE keying (None -> False for
+        # explicit backends; requests clamped to the RDMA tier and the
+        # interpret guard) — two requests that compile the same program
+        # must share one key, and the key must state the compiled form.
+        kw["overlap"] = step_lib.resolve_overlap(
+            kw.get("overlap"), kw.get("backend", "shifted"), self.mesh)
         if kw.get("fuse") is None and "fuse" in kw:
             # Same contract as RunConfig/ConvolutionModel: fuse=None
             # means 'tune it', which needs backend='auto' — silently
@@ -363,7 +388,7 @@ class WarmEngine:
                 self.mesh, get_filter(key.filter_name), key.backend,
                 quantize=key.quantize, fuse=key.fuse, boundary=key.boundary,
                 tile=key.tile, storage=key.storage,
-                block_hw=self._block_hw(key))
+                block_hw=self._block_hw(key), overlap=key.overlap)
         # Cost-model figure for the config actually compiled: every
         # response carries predicted-vs-measured visibility, so a silent
         # mistune (or a degraded tier) shows in per-request artifacts.
@@ -375,7 +400,9 @@ class WarmEngine:
                                quantize=key.quantize,
                                boundary=key.boundary)
         predicted = costmodel.predict_gpx_per_chip(search.predict(
-            w, search.Candidate(effective, key.fuse, key.tile)))
+            w, search.Candidate(
+                effective, key.fuse, key.tile,
+                bool(key.overlap) and effective == "pallas_rdma")))
         with self._lock:
             source = self._plan_sources.get(key, "explicit")
         entry = _Entry(key, effective, plan_source=source,
@@ -407,7 +434,7 @@ class WarmEngine:
             fn = step_lib._build_iterate(
                 self.mesh, filt, key.iters, key.quantize, valid_hw,
                 block_hw, entry.effective_backend, key.fuse, key.boundary,
-                key.tile, False)
+                key.tile, False, entry.effective_overlap)
             # Trace + XLA-compile NOW (jit compiles on first call): warm
             # means the request path never sees compilation.
             import jax
@@ -489,12 +516,38 @@ class WarmEngine:
             self.stats["images"] += B
         if obs_metrics.enabled():
             self._record_batch_obs(entry, B, filt, dev_s)
+        # Overlap-adjusted exchange attribution for the response (pure
+        # model arithmetic — always on, obs or not): hidden vs exposed
+        # exchange is how the overlapped-halo lever is judged per
+        # request.  Cached per (entry, batch) — it is a pure function of
+        # them (batch-dependent only via the RDMA tiled switch), and a
+        # benign last-writer-wins race writes identical dicts.
+        split = entry.splits.get(B)
+        if split is None:
+            from parallel_convolution_tpu.obs import attribution
+
+            dev0 = self.mesh.devices.flat[0]
+            split = attribution.predicted_exchange_split(
+                key.grid, self._block_hw(key), filt.radius,
+                max(1, min(key.fuse, key.iters)),
+                backend=entry.effective_backend, storage=key.storage,
+                shape=(B * C, H, W), tile=key.tile, quantize=key.quantize,
+                separable=entry.effective_backend in ("separable",
+                                                      "pallas_sep"),
+                platform=dev0.platform,
+                device_kind=getattr(dev0, "device_kind", "") or "",
+                overlap=entry.effective_overlap)
+            entry.splits[B] = split
         info = {
             "effective_backend": entry.effective_backend,
             "effective_grid": f"{key.grid[0]}x{key.grid[1]}",
             "plan_source": entry.plan_source,
             "predicted_gpx_per_chip": entry.predicted_gpx,
             "batch_size": B,
+            "overlap": entry.effective_overlap,
+            "exchange_fraction": round(split["exchange_fraction"], 4),
+            "exchange_hidden_fraction": round(
+                split["exchange_hidden_fraction"], 4),
             "phases": {name: t.wall(name)
                        for name in ("compile", "copy_in", "device",
                                     "copy_out")},
@@ -519,7 +572,7 @@ class WarmEngine:
             wall_s=dev_s, shape=(B * C, H, W), quantize=key.quantize,
             tile=key.tile, platform=dev0.platform,
             device_kind=getattr(dev0, "device_kind", "") or "",
-            source="serving")
+            source="serving", overlap=entry.effective_overlap)
         if dev_s > 0:
             attribution.record_drift(
                 entry.plan_key, entry.effective_backend,
@@ -540,6 +593,7 @@ class WarmEngine:
                      "effective_backend": e.effective_backend,
                      "fuse": k.fuse,
                      "tile": list(k.tile) if k.tile else None,
+                     "overlap": e.effective_overlap,
                      "plan_source": e.plan_source,
                      "predicted_gpx_per_chip": e.predicted_gpx,
                      "batch_sizes": sorted(e.fns)}

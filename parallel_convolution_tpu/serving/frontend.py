@@ -95,6 +95,10 @@ def decode_request(body: dict) -> Request:
                   else int(body.get("fuse", 1))),
             boundary=body.get("boundary", "zero"),
             quantize=bool(body.get("quantize", True)),
+            # overlap: null/absent = off for explicit backends, tuned
+            # for backend="auto"; true/false = clamped request.
+            overlap=(None if body.get("overlap") is None
+                     else bool(body.get("overlap"))),
             deadline_s=(float(deadline_ms) / 1e3
                         if deadline_ms is not None else None),
             request_id=body.get("request_id"),
@@ -120,6 +124,9 @@ def encode_response(result) -> tuple[int, dict]:
         "backend": result.backend,
         "plan_source": result.plan_source,
         "predicted_gpx_per_chip": result.predicted_gpx_per_chip,
+        "overlap": result.overlap,
+        "exchange_fraction": result.exchange_fraction,
+        "exchange_hidden_fraction": result.exchange_hidden_fraction,
         "request_id": result.request_id,
         "batch_size": result.batch_size,
         "phases": result.phases,

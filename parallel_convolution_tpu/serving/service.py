@@ -56,6 +56,11 @@ class Request:
     fuse: int | None = 1             # None = tune it (backend="auto")
     boundary: str = "zero"
     quantize: bool = True
+    overlap: bool | None = None      # interior-first overlapped halo
+    #                                  pipeline: None = off for explicit
+    #                                  backends / tuned for "auto"; the
+    #                                  RESOLVED value rides the key and
+    #                                  every response stamps it
     deadline_s: float | None = None
     request_id: str | None = None
 
@@ -78,6 +83,14 @@ class Response:
     effective_grid: str = ""         # "RxC" mesh grid that produced the
     #                                  bytes (changes after an elastic
     #                                  reshape mid-process)
+    overlap: bool = False            # the compiled program's RESOLVED
+    #                                  overlap knob (False when clamped
+    #                                  or degraded off the RDMA tier)
+    exchange_fraction: float = 0.0   # model-attributed EXPOSED exchange
+    #                                  share of one iteration's wall
+    exchange_hidden_fraction: float = 0.0  # share of exchange time the
+    #                                  overlapped pipeline hides under
+    #                                  compute (0.0 when serialized)
 
     ok = True
 
@@ -174,7 +187,8 @@ class ConvolutionService:
             iters=int(req.iters),
             fuse=None if req.fuse is None else int(req.fuse),
             boundary=req.boundary,
-            quantize=bool(req.quantize), backend=req.backend)
+            quantize=bool(req.quantize), backend=req.backend,
+            overlap=req.overlap)
         key.validate()
         filt = get_filter(key.filter_name)
         R, C = key.grid
@@ -307,6 +321,10 @@ class ConvolutionService:
                     "plan_source", info.get("plan_source", "explicit")),
                 predicted_gpx_per_chip=info.get("predicted_gpx_per_chip"),
                 effective_grid=info.get("effective_grid", ""),
+                overlap=bool(info.get("overlap", False)),
+                exchange_fraction=info.get("exchange_fraction", 0.0),
+                exchange_hidden_fraction=info.get(
+                    "exchange_hidden_fraction", 0.0),
             ))
             self._bump("completed")
             if obs_metrics.enabled():

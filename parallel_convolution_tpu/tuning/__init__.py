@@ -62,6 +62,9 @@ class Resolution:
     source: str
     predicted_gpx: float | None
     key: str
+    # Interior-first overlapped halo pipeline: the tuned (or clamped-
+    # request) decision for the resolved backend; always a concrete bool.
+    overlap: bool = False
 
 
 # The most recent resolution per process, for entry points that label
@@ -77,7 +80,10 @@ def _legal_plan_knobs(w: Workload, plan: Plan) -> tuple[int, object]:
     """Clamp a (possibly other-bucket) plan's knobs to THIS workload's
     legality: fuse to the block/RDMA bounds, tile to alignment+VMEM —
     an interpolated plan from a larger bucket must never hand an
-    impossible launch to the kernels."""
+    impossible launch to the kernels.  (The plan's overlap verdict is
+    clamped by :func:`resolve` itself, at the FINAL fuse — a pinned
+    fuse can change the legal interior, so a clamp here would be stale.)
+    """
     fuse = plan.fuse
     legal_f = search._legal_fuses(w, plan.backend, (fuse,))
     if fuse not in legal_f:
@@ -93,6 +99,7 @@ def _legal_plan_knobs(w: Workload, plan: Plan) -> tuple[int, object]:
 def resolve(mesh, filt, shape, *, storage: str = "f32",
             quantize: bool = True, boundary: str = "zero",
             fuse: int | None = None, tile: tuple[int, int] | None = None,
+            overlap: bool | None = None,
             plans: PlanCache | None = None,
             check_every: int | None = None) -> Resolution:
     """Resolve ``backend="auto"`` (and unset fuse/tile) for one workload.
@@ -101,6 +108,11 @@ def resolve(mesh, filt, shape, *, storage: str = "f32",
     only the unset knobs, and a pinned value is honored verbatim (a pin
     that is illegal for EVERY backend dies loudly in the candidate
     enumeration — never silently remeasured as fuse=1/default tile).
+    ``overlap`` is a clamped *request*, not a pin (see
+    ``search._legal_overlaps``): None lets the cost model decide, an
+    explicit value is honored exactly where legal for the resolved
+    backend and clamped to False otherwise — the resolved bool lands in
+    ``Resolution.overlap`` and every row stamps it.
     ``plans=None`` consults
     the ambient cache (``PCTPU_PLAN_FILE``); pass an explicit
     :class:`PlanCache` (e.g. the serving engine's) to override.
@@ -141,19 +153,28 @@ def resolve(mesh, filt, shape, *, storage: str = "f32",
             f"legality for {w.filter_name} {w.shape} on grid {w.grid}")
     if plan is not None:
         p_fuse, p_tile = _legal_plan_knobs(w, plan)
+        r_fuse = int(fuse) if fuse is not None else p_fuse
+        # An explicit overlap request overrides the plan's verdict;
+        # either way the decision is clamped to legality at the knobs
+        # actually resolved (a pinned fuse can change the legal
+        # interior, so the stored clamp is not enough).
+        want_ov = plan.overlap if overlap is None else overlap
         res = Resolution(
             backend=plan.backend,
-            fuse=int(fuse) if fuse is not None else p_fuse,
+            fuse=r_fuse,
             tile=tile if tile is not None else p_tile,
             source=plan.source,
             predicted_gpx=plan.predicted_gpx,
             key=w.key(),
+            overlap=bool(want_ov) and costmodel.overlap_legal(
+                plan.backend, w.grid, w.block_hw, w.radius, r_fuse),
         )
     else:
         result = search.tune(
             w, mesh=None, dry_run=True,
             fuses=[int(fuse)] if fuse is not None else None,
-            tiles=[tuple(tile)] if tile is not None else None)
+            tiles=[tuple(tile)] if tile is not None else None,
+            overlap=overlap)
         p = result.plan
         res = Resolution(
             backend=p.backend,
@@ -162,6 +183,7 @@ def resolve(mesh, filt, shape, *, storage: str = "f32",
             source="predicted",
             predicted_gpx=p.predicted_gpx,
             key=w.key(),
+            overlap=p.overlap,
         )
     _LAST.append(res)
     del _LAST[:-4]  # bounded history; only the last is ever read

@@ -145,6 +145,29 @@ def rdma_is_tiled(shape: tuple[int, int, int], block_hw: tuple[int, int],
     return mono > RDMA_TILED_VMEM_BYTES
 
 
+def overlap_legal(backend: str, grid: tuple[int, int],
+                  block_hw: tuple[int, int], radius: int,
+                  fuse: int) -> bool:
+    """Whether the interior-first overlapped halo pipeline applies.
+
+    Overlap is an RDMA-kernel restructure (the exchange and the compute
+    live in one program there — no other tier can interleave them), it
+    needs a collective to hide (a 1x1 grid has none), and it needs a
+    non-empty interior to compute under the in-flight DMAs: the rim of
+    one fused chunk is ``d = radius*fuse`` deep on every side, so
+    ``min(block) > 2*d`` or the whole block IS rim and the pipeline
+    degenerates to the serialized order.  Mirrors the kernel's own
+    region decomposition (``ops.pallas_rdma``); drift-guarded in
+    ``tests/test_overlap.py``.
+    """
+    if backend != "pallas_rdma":
+        return False
+    if grid[0] * grid[1] == 1:
+        return False
+    d = radius * max(1, int(fuse))
+    return min(block_hw) > 2 * d
+
+
 def rim_overhead(fuse: int, tile_hw: tuple[int, int], radius: int) -> float:
     """Extra-compute fraction from recomputing the shrinking overlap rim.
 
@@ -240,8 +263,19 @@ def predict_seconds_per_px_iter(backend: str, storage: str, fuse: int,
                                 block_hw: tuple[int, int],
                                 grid: tuple[int, int], k: int,
                                 separable: bool, quantize: bool,
-                                hw: HardwareModel) -> float:
-    """Roofline time: max(bandwidth, compute) + exchange, per px-iter."""
+                                hw: HardwareModel,
+                                overlap: bool = False) -> float:
+    """Roofline time: max(bandwidth, compute) + exchange, per px-iter.
+
+    ``overlap=True`` (legal only per :func:`overlap_legal`) models the
+    interior-first pipeline: the exchange rides UNDER the interior
+    compute, so the serial ``compute + exchange`` sum becomes
+    ``max(compute, exchange)`` — exchange is free until it exceeds the
+    compute it hides behind, the persistent/partitioned-MPI overlap
+    claim (PAPERS.md) as a roofline term.  An illegal overlap request
+    silently prices the serialized form (same clamp the dispatch layer
+    applies), so the model and the executable can never disagree.
+    """
     radius = k // 2
     T = max(1, int(fuse))
     tile_eff = effective_tile(backend, tile)
@@ -255,8 +289,13 @@ def predict_seconds_per_px_iter(backend: str, storage: str, fuse: int,
     ) / (hw.hbm_gbps * 1e9)
     t_flop = flops_per_px_iter(
         k, sep, quantize, T, rim_tile, radius) / (hw.flop_gops * 1e9)
-    t = max(t_hbm, t_flop) + exchange_seconds_per_px_iter(
+    t_roof = max(t_hbm, t_flop)
+    t_ex = exchange_seconds_per_px_iter(
         grid, block_hw, radius, T, storage, hw)
+    if overlap and overlap_legal(backend, grid, block_hw, radius, T):
+        t = max(t_roof, t_ex)
+    else:
+        t = t_roof + t_ex
     if backend in PALLAS_BACKENDS and hw.interpret_pallas:
         t *= INTERPRET_PENALTY
     return t

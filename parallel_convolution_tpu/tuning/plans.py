@@ -168,6 +168,10 @@ class Plan:
     source: str = "predicted"
     predicted_gpx: float | None = None
     measured_gpx: float | None = None
+    # Interior-first overlapped halo pipeline (RDMA tier).  Serialized
+    # records from pre-overlap plan files lack the key and default to
+    # False — the exact pre-overlap behavior, so no schema bump.
+    overlap: bool = False
 
     def to_record(self, workload: Workload | None = None) -> dict:
         rec = {
@@ -177,6 +181,7 @@ class Plan:
             "source": self.source,
             "predicted_gpx": self.predicted_gpx,
             "measured_gpx": self.measured_gpx,
+            "overlap": bool(self.overlap),
         }
         if workload is not None:
             rec["key_fields"] = workload.key_fields()
@@ -192,6 +197,7 @@ class Plan:
             source=rec.get("source", "measured"),
             predicted_gpx=rec.get("predicted_gpx"),
             measured_gpx=rec.get("measured_gpx"),
+            overlap=bool(rec.get("overlap", False)),
         )
 
 

@@ -54,11 +54,13 @@ _PREFERENCE = ("shifted", "xla_conv", "separable", "pallas_sep", "pallas",
 
 @dataclasses.dataclass(frozen=True)
 class Candidate:
-    """One point of the knob space: (backend, fuse, tile)."""
+    """One point of the knob space: (backend, fuse, tile, overlap)."""
 
     backend: str
     fuse: int = 1
     tile: tuple[int, int] | None = None
+    overlap: bool = False  # interior-first overlapped halo pipeline
+    #                        (RDMA tier only; costmodel.overlap_legal)
 
 
 def _sep_byte_safe(w: Workload) -> bool:
@@ -158,14 +160,49 @@ def _legal_tiles(w: Workload, backend: str, menu,
     return out or ([] if strict else [None])
 
 
+def _legal_overlaps(w: Workload, backend: str, fuse: int,
+                    overlap: bool | None) -> list[bool]:
+    """Overlap options for one (backend, fuse) point.
+
+    ``overlap`` is a *request*, not a hard pin: an explicit True is
+    clamped to legality (the serialized form is always available, and
+    every artifact stamps the RESOLVED value) — unlike fuse/tile pins,
+    which die loudly, because overlap legality depends on the backend
+    the tuner is still choosing, so a hard pin would empty every
+    non-RDMA branch of the space.
+
+    Interpreted-Pallas platforms enumerate only the serialized form
+    (unless the byte-proof env hatch is armed): the dispatch layer
+    force-serializes overlap there, so an overlap=True candidate would
+    MEASURE the serialized executable — two identical twins burning the
+    measurement budget, and a plan stamped overlap=True whose
+    measured_gpx never ran the overlapped program.
+    """
+    import os
+
+    from parallel_convolution_tpu.utils.config import OVERLAP_INTERPRET_ENV
+
+    legal = costmodel.overlap_legal(backend, w.grid, w.block_hw, w.radius,
+                                    fuse)
+    if (legal and costmodel.hardware_for(
+            w.platform, w.device_kind).interpret_pallas
+            and not os.environ.get(OVERLAP_INTERPRET_ENV)):
+        legal = False
+    if overlap is None:
+        return [False, True] if legal else [False]
+    return [bool(overlap) and legal]
+
+
 def enumerate_candidates(w: Workload, backends=None, fuses=None,
-                         tiles=None) -> list[Candidate]:
+                         tiles=None, overlap: bool | None = None,
+                         ) -> list[Candidate]:
     """The deterministic legal candidate list for one workload.
 
     ``backends``/``fuses``/``tiles`` pin a sub-space (an explicitly
     passed knob is honored verbatim; legality still filters fuse depth
     so an impossible pin dies here with an empty-space error rather
-    than deep inside a kernel launch).
+    than deep inside a kernel launch).  ``overlap`` (None = enumerate
+    both where legal) is a clamped request — see :func:`_legal_overlaps`.
     """
     out = []
     for b in (backends if backends is not None else _legal_backends(w)):
@@ -174,7 +211,8 @@ def enumerate_candidates(w: Workload, backends=None, fuses=None,
             for t in _legal_tiles(w, b, tiles if tiles is not None
                                   else TILE_MENU, strict=tiles is not None,
                                   fuse=T):
-                out.append(Candidate(b, T, t))
+                for ov in _legal_overlaps(w, b, T, overlap):
+                    out.append(Candidate(b, T, t, ov))
     if not out:
         raise ValueError(
             f"no legal candidates for {w.filter_name} {w.shape} on grid "
@@ -188,7 +226,7 @@ def predict(w: Workload, c: Candidate,
     hw = hw or costmodel.hardware_for(w.platform, w.device_kind)
     return costmodel.predict_seconds_per_px_iter(
         c.backend, w.storage, c.fuse, c.tile, w.shape, w.block_hw, w.grid,
-        w.taps_k, w.separable, w.quantize, hw)
+        w.taps_k, w.separable, w.quantize, hw, overlap=c.overlap)
 
 
 def rank(w: Workload, candidates,
@@ -202,7 +240,9 @@ def rank(w: Workload, candidates,
         t, c = pc
         pref = (_PREFERENCE.index(c.backend)
                 if c.backend in _PREFERENCE else len(_PREFERENCE))
-        return (t, pref, c.fuse, c.tile or (0, 0))
+        # overlap last: on a model tie (exchange fully hidden OR zero)
+        # the serialized form wins — never pipeline for a predicted 0.
+        return (t, pref, c.fuse, c.tile or (0, 0), c.overlap)
 
     return sorted(((predict(w, c, hw), c) for c in candidates),
                   key=sort_key)
@@ -234,14 +274,15 @@ def measure(w: Workload, c: Candidate, mesh, *, iters: int = 8,
         mesh=mesh, channels=w.shape[0], backend=c.backend,
         quantize=w.quantize, storage=w.storage, fuse=c.fuse,
         boundary=w.boundary, reps=reps, tile=c.tile,
-        interior_split=interior_split)
+        interior_split=interior_split, overlap=c.overlap)
     row["predicted_gpx_per_chip"] = round(
         costmodel.predict_gpx_per_chip(predict(w, c)), 3)
     return row
 
 
 def tune(w: Workload, mesh=None, *, dry_run: bool = False,
-         backends=None, fuses=None, tiles=None, iters: int = 8,
+         backends=None, fuses=None, tiles=None, overlap: bool | None = None,
+         iters: int = 8,
          reps: int = 2, max_measure: int = 8, prune_factor: float = 4.0,
          interior_split: bool = False) -> TuneResult:
     """Tune one workload: rank the legal space, optionally measure.
@@ -255,14 +296,16 @@ def tune(w: Workload, mesh=None, *, dry_run: bool = False,
     that fail to compile/launch are recorded as error rows and skipped —
     the tuner prices what works.
     """
-    ranked = rank(w, enumerate_candidates(w, backends, fuses, tiles))
+    ranked = rank(w, enumerate_candidates(w, backends, fuses, tiles,
+                                          overlap=overlap))
     best_t, best_c = ranked[0]
     predicted_gpx = costmodel.predict_gpx_per_chip(best_t)
     if dry_run or mesh is None:
         return TuneResult(
             Plan(best_c.backend, best_c.fuse, best_c.tile,
                  source="predicted",
-                 predicted_gpx=round(predicted_gpx, 3)),
+                 predicted_gpx=round(predicted_gpx, 3),
+                 overlap=best_c.overlap),
             w, rows=[])
     rows: list[dict] = []
     measured: list[tuple[float, Candidate, float]] = []
@@ -276,6 +319,7 @@ def tune(w: Workload, mesh=None, *, dry_run: bool = False,
             rows.append({"backend": c.backend, "fuse": c.fuse,
                          "tile": (f"{c.tile[0]}x{c.tile[1]}" if c.tile
                                   else None),
+                         "overlap": c.overlap,
                          "error": repr(e)[:200]})
             continue
         rows.append(row)
@@ -291,5 +335,6 @@ def tune(w: Workload, mesh=None, *, dry_run: bool = False,
     gpx, c, pred = measured[0]
     return TuneResult(
         Plan(c.backend, c.fuse, c.tile, source="measured",
-             predicted_gpx=round(pred, 3), measured_gpx=round(gpx, 3)),
+             predicted_gpx=round(pred, 3), measured_gpx=round(gpx, 3),
+             overlap=c.overlap),
         w, rows=rows)

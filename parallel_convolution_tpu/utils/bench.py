@@ -123,6 +123,7 @@ def bench_iterate(
     tile: tuple[int, int] | None = None,
     interior_split: bool = False,
     fallback: bool = False,
+    overlap: bool | None = None,
 ) -> dict:
     """Gpixels/sec/chip for the standard fixed-iteration workload.
 
@@ -163,10 +164,14 @@ def bench_iterate(
     # dtype and sharding are invariant, exactly the double-buffer reuse the
     # real pipeline gets.
     xs, valid_hw, block_hw = step_lib._prepare(x, mesh, filt.radius, storage)
-    effective, fuse, tile, plan_source = step_lib._resolve_auto(
+    effective, fuse, tile, overlap, plan_source = step_lib._resolve_auto(
         mesh, filt, backend, fuse, tile, storage, quantize, boundary,
-        valid_hw, channels)
+        valid_hw, channels, overlap=overlap)
     plan_source = plan_source or "explicit"
+    # The overlap knob the executable will ACTUALLY be compiled with —
+    # stamped below exactly like tile/fuse (post-auto-resolution, post-
+    # clamp), so a row can never disagree with the compiled program.
+    overlap = step_lib.resolve_overlap(overlap, effective, mesh)
     if fallback:
         from parallel_convolution_tpu.resilience import degrade
 
@@ -175,10 +180,11 @@ def bench_iterate(
         effective = degrade.resolve_backend(
             mesh, filt, effective, quantize=quantize, fuse=fuse,
             boundary=boundary, tile=tile, interior_split=interior_split,
-            storage=storage, block_hw=block_hw)
+            storage=storage, block_hw=block_hw, overlap=overlap)
+        overlap = overlap and effective == "pallas_rdma"
     fn = step_lib._build_iterate(mesh, filt, iters, quantize, valid_hw,
                                  block_hw, effective, fuse, boundary,
-                                 tile, interior_split)
+                                 tile, interior_split, overlap)
     out = fence(fn(xs))  # compile + warmup
 
     # The fence itself can cost a large constant on tunnel platforms
@@ -248,7 +254,8 @@ def bench_iterate(
     w = Workload.from_mesh(mesh, filt, (channels, H, W), storage=storage,
                            quantize=quantize, boundary=boundary)
     predicted = costmodel.predict_gpx_per_chip(search.predict(
-        w, search.Candidate(effective, compiled_fuse, compiled_tile)))
+        w, search.Candidate(effective, compiled_fuse, compiled_tile,
+                            overlap)))
     # Exchange/overlap attribution (obs.attribution): the analytic
     # per-direction ghost-band bytes of this decomposition and the
     # roofline model's exchange share — the per-phase instrumentation
@@ -266,20 +273,23 @@ def bench_iterate(
         wall_s=secs, shape=(channels, H, W), quantize=quantize,
         tile=compiled_tile, platform=dev0.platform,
         device_kind=getattr(dev0, "device_kind", "") or "",
-        source="bench")
+        source="bench", overlap=overlap)
     if att is None:
+        split = attribution.predicted_exchange_split(
+            grid, block_hw, filt.radius, compiled_fuse,
+            backend=effective, storage=storage,
+            shape=(channels, H, W), tile=compiled_tile,
+            quantize=quantize,
+            separable=effective in ("separable", "pallas_sep"),
+            platform=dev0.platform,
+            device_kind=getattr(dev0, "device_kind", "") or "",
+            overlap=overlap)
         att = {
             "halo_bytes": attribution.halo_bytes_total(
                 grid, block_hw, filt.radius, compiled_fuse, iters,
                 channels, storage, boundary),
-            "exchange_fraction": attribution.predicted_exchange_fraction(
-                grid, block_hw, filt.radius, compiled_fuse,
-                backend=effective, storage=storage,
-                shape=(channels, H, W), tile=compiled_tile,
-                quantize=quantize,
-                separable=effective in ("separable", "pallas_sep"),
-                platform=dev0.platform,
-                device_kind=getattr(dev0, "device_kind", "") or ""),
+            "exchange_fraction": split["exchange_fraction"],
+            "exchange_hidden_fraction": split["exchange_hidden_fraction"],
         }
     # The drift series (ROADMAP 5a's recalibration input): the bench
     # measurement against the model's figure, per plan key.
@@ -299,6 +309,10 @@ def bench_iterate(
         "fuse": compiled_fuse,
         "tile": (f"{compiled_tile[0]}x{compiled_tile[1]}"
                  if compiled_tile else None),
+        # The RESOLVED overlap knob (post-auto-resolution, post-clamp,
+        # post-degrade) — the program this row timed either was or was
+        # not the interior-first pipeline; the row says which.
+        "overlap": bool(overlap),
         "plan_source": plan_source,
         "predicted_gpx_per_chip": round(predicted, 3),
         "mesh": "x".join(str(s) for s in grid),
@@ -310,6 +324,10 @@ def bench_iterate(
         # iteration and the analytic ghost-band bytes this run moved
         # (whole mesh, all rounds, per direction) — obs.attribution.
         "exchange_fraction": round(att["exchange_fraction"], 4),
+        # Overlap-adjusted split: the share of exchange time the
+        # interior-first pipeline hides under compute (0.0 serialized).
+        "exchange_hidden_fraction": round(
+            att.get("exchange_hidden_fraction", 0.0), 4),
         "halo_bytes": att["halo_bytes"],
         # Which wall scheme ACTUALLY produced this row ('slope' = chained
         # spans with the fence constant cancelled; 'fence' = plain fenced

@@ -461,6 +461,7 @@ def run_checkpointed(
     tile: tuple[int, int] | None = None,
     interior_split: bool = False,
     fallback: bool = False,
+    overlap: bool | None = None,
 ) -> jax.Array:
     """Iterate with a snapshot every ``every`` iterations; auto-resume.
 
@@ -536,8 +537,9 @@ def run_checkpointed(
 
     while done < total_iters:
         chunk = min(every, total_iters - done)
-        # tile and interior_split are pure perf knobs (bit-identical for
-        # any value in every mode), so they are deliberately NOT part of
+        # tile, interior_split, and overlap are pure perf knobs
+        # (bit-identical for any value in every mode), so they are
+        # deliberately NOT part of
         # the resume-compatibility config above.  fuse IS kept there: it
         # is only bit-identical under quantize=True — in float mode with a
         # narrow storage dtype the fused kernel keeps f32 intermediates
@@ -547,7 +549,7 @@ def run_checkpointed(
             xs, filt, chunk, mesh, valid_hw, interior_split=interior_split,
             quantize=quantize, backend=backend, fuse=min(fuse, chunk),
             boundary=boundary, tile=tile, check_contract=False,
-            fallback=fallback,
+            fallback=fallback, overlap=overlap,
         )
         done += chunk
         if done < total_iters:  # final state is the caller's to persist

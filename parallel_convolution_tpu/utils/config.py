@@ -25,6 +25,14 @@ BACKEND_CHOICES = BACKENDS + (AUTO,)
 STORAGES = ("f32", "bf16", "u8")
 BOUNDARIES = ("zero", "periodic")
 
+# Env escape hatch: run the overlapped RDMA pipeline under interpreted
+# Pallas anyway (CI byte proofs).  Lives here (jax-free) because BOTH
+# the dispatch clamp (parallel/step.resolve_overlap) and the tuner's
+# candidate enumeration (tuning/search._legal_overlaps) honor it — the
+# two must read the same switch or auto could tune a form dispatch
+# refuses to compile.
+OVERLAP_INTERPRET_ENV = "PCTPU_OVERLAP_INTERPRET"
+
 
 @dataclasses.dataclass
 class RunConfig:
@@ -41,6 +49,10 @@ class RunConfig:
     storage: str = "f32"           # f32 | bf16
     fuse: int | None = 1           # None = tune it (backend="auto" only)
     tile: tuple[int, int] | None = None   # Pallas kernel tile (TH, TW)
+    overlap: bool | None = None    # interior-first overlapped halo
+    #                                pipeline (RDMA kernels): None = off
+    #                                for explicit backends, tuned for
+    #                                "auto"; True/False = clamped request
     boundary: str = "zero"
     quantize: bool = True
     converge_tol: float | None = None
@@ -72,6 +84,8 @@ class RunConfig:
         if self.fuse is None and self.backend != AUTO:
             raise ValueError(
                 "fuse=None means 'tune it' and needs backend='auto'")
+        if self.overlap is not None:
+            self.overlap = bool(self.overlap)
         if self.mesh_shape is not None:
             self.mesh_shape = tuple(self.mesh_shape)
         if self.tile is not None:
@@ -101,5 +115,6 @@ class RunConfig:
         return ConvolutionModel(
             filt=self.filter_name, mesh=mesh, backend=self.backend,
             quantize=self.quantize, storage=self.storage, fuse=self.fuse,
-            boundary=self.boundary, tile=self.tile, fallback=self.fallback,
+            boundary=self.boundary, tile=self.tile, overlap=self.overlap,
+            fallback=self.fallback,
         )

@@ -1,26 +1,38 @@
 #!/usr/bin/env python
-"""A/B: the RDMA tier vs the ppermute path across temporal-fusion depths.
+"""A/B: the RDMA tier vs the ppermute path across temporal-fusion depths,
+with an optional overlap on/off column.
 
 VERDICT item 3: "give the RDMA tier a reason to exist, or retire it."
 The tier was built for the latency-bound small-block regime, where the
 per-iteration cost is dominated by exchange setup — exactly what
 temporal fusion amortizes (fuse=T: one T*r-deep exchange, T in-kernel
-levels).  This harness prices both paths on the SAME small-block
-workload across fuse ∈ {1,2,4,8} and byte-checks every configuration
-against the serial oracle, emitting JSONL rows for the evidence ledger:
+levels) and what the interior-first overlapped pipeline hides
+(``--overlap``: overlap on/off per fuse level — ROADMAP item 1's lever,
+measurable in one command at the next tunnel window).  Every cell is
+byte-checked against the serial oracle, and every overlap cell is
+additionally byte-compared against its serialized twin — the
+byte-equality gate the ``--overlap-smoke`` tier-1 leg enforces.
+
+Rows are JSONL for the evidence ledger:
 
 * one row per (path, fuse): the standard bench_iterate row plus
-  ``oracle_bytes_ok`` (bit-exactness of a deterministic run) and an
-  ``interpret`` flag (off-TPU rows time the interpreter/XLA:CPU — a
-  mechanism proof, NOT a perf claim; the decision row needs silicon);
-* one summary row with the per-fuse rdma/ppermute speedup ratios and
-  the win/retire reading DESIGN.md asks for.
-
-Runnable today on the CPU mesh (interpret mode); re-run unchanged on
-silicon at the next tunnel window for the decision numbers.
+  ``oracle_bytes_ok`` (bit-exactness of a deterministic run),
+  ``matches_serialized`` (overlap cells only), and an ``interpret``
+  flag (off-TPU rows time the interpreter/XLA:CPU — a mechanism proof,
+  NOT a perf claim; the decision row needs silicon);
+* on a jax without the DMA-faithful TPU interpreter, multi-device RDMA
+  cells are emitted as ``skipped: capability`` rows (they would fail on
+  a missing lowering, proving nothing) and the overlap byte proof runs
+  on a degenerate 1x1 mesh instead, where every RDMA construct
+  statically elides and the overlap REGION-SPLIT compute is still the
+  program under test;
+* one summary row with per-fuse speedup ratios (rdma/ppermute and
+  overlap/serialized), ``failures`` (byte mismatches + unexpected
+  errors), and ``bytes_ok_all``.
 
 Usage:
   python scripts/rdma_fuse_ab.py                       # CPU mesh (8 virt.)
+  python scripts/rdma_fuse_ab.py --overlap --out evidence/overlap_smoke.json
   python scripts/rdma_fuse_ab.py --size 1024 --iters 64  # silicon regime
 """
 
@@ -28,26 +40,95 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 import _path  # noqa: F401  (repo root onto sys.path)
 
 
-def _byte_check(backend, fuse, mesh, filt, iters):
-    """Bit-exactness of a deterministic small run vs the serial oracle."""
+def _byte_check(backend, fuse, mesh, filt, iters, overlap=False,
+                size=(64, 64)):
+    """Bit-exactness of a deterministic small run vs the serial oracle;
+    returns (ok, raw_bytes) so overlap cells can also compare twins."""
     import numpy as np
 
     from parallel_convolution_tpu.ops import oracle
     from parallel_convolution_tpu.parallel import step
     from parallel_convolution_tpu.utils import imageio
 
-    img = imageio.generate_test_image(64, 64, "grey", seed=9)
+    img = imageio.generate_test_image(*size, "grey", seed=9)
     want = oracle.run_serial_u8(img, filt, iters)
     x = imageio.interleaved_to_planar(img).astype(np.float32)
     out = step.sharded_iterate(x, filt, iters, mesh=mesh, quantize=True,
-                               backend=backend, fuse=fuse)
+                               backend=backend, fuse=fuse, overlap=overlap)
     got = imageio.planar_to_interleaved(np.asarray(out).astype(np.uint8))
-    return bool(np.array_equal(got, want))
+    return bool(np.array_equal(got, want)), got
+
+
+def _degenerate_overlap_proofs(filt, fuses):
+    """Overlap-vs-serialized byte proofs on a 1x1 mesh — runnable on ANY
+    jax (extent-1 axes statically elide every RDMA construct), pinning
+    the interior-first REGION-SPLIT compute that is the overlap path's
+    only new math when no DMA exists.  Covers both boundaries and both
+    kernels (monolithic via the driver; tiled via a forced launch)."""
+    import jax
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from parallel_convolution_tpu.ops import oracle, pallas_rdma
+    from parallel_convolution_tpu.parallel import step
+    from parallel_convolution_tpu.parallel.mesh import AXES, make_grid_mesh
+    from parallel_convolution_tpu.utils import imageio, jax_compat
+
+    mesh = make_grid_mesh(jax.devices()[:1], (1, 1))
+    rows = []
+    for boundary, dims in (("zero", (37, 53)), ("periodic", (24, 36))):
+        for fuse in fuses:
+            iters = 2 * fuse
+            img = imageio.generate_test_image(*dims, "grey", seed=31)
+            want = oracle.run_serial_u8(img, filt, iters, boundary=boundary)
+            x = imageio.interleaved_to_planar(img).astype(np.float32)
+            got = {}
+            for ov in (False, True):
+                out = step.sharded_iterate(
+                    x, filt, iters, mesh=mesh, quantize=True,
+                    backend="pallas_rdma", boundary=boundary, fuse=fuse,
+                    overlap=ov)
+                got[ov] = imageio.planar_to_interleaved(
+                    np.asarray(out).astype(np.uint8))
+            rows.append({
+                "ab": "overlap_degenerate", "boundary": boundary,
+                "fuse": fuse, "kernel": "monolithic",
+                "oracle_bytes_ok": bool(np.array_equal(got[True], want)),
+                "matches_serialized": bool(
+                    np.array_equal(got[True], got[False])),
+            })
+    # Tiled kernel, forced: multi-window grid + the overlap flag.
+    img = imageio.generate_test_image(96, 384, "grey", seed=34)
+    x = imageio.interleaved_to_planar(img).astype(np.float32)
+    want = oracle.run_serial_u8(img, filt, 4)
+    got = {}
+    for ov in (False, True):
+        def body(v, ov=ov):
+            import jax.lax as lax
+
+            def one(_, cur):
+                return pallas_rdma.fused_rdma_step(
+                    cur, filt, (1, 1), "zero", quantize=True, tiled=True,
+                    tile=(32, 128), fuse=2, valid_hw=img.shape[:2],
+                    overlap=ov)
+            return lax.fori_loop(0, 2, one, v)
+        out = jax.jit(jax_compat.shard_map(
+            body, mesh=mesh, in_specs=P(None, *AXES),
+            out_specs=P(None, *AXES), check_vma=False))(x)
+        got[ov] = np.asarray(out)[0].astype(np.uint8)
+    rows.append({
+        "ab": "overlap_degenerate", "boundary": "zero", "fuse": 2,
+        "kernel": "tiled",
+        "oracle_bytes_ok": bool(np.array_equal(got[True], want)),
+        "matches_serialized": bool(np.array_equal(got[True], got[False])),
+    })
+    return rows
 
 
 def main() -> int:
@@ -62,7 +143,20 @@ def main() -> int:
     ap.add_argument("--mesh", default=None, help="RxC grid (default: all)")
     ap.add_argument("--platform", default=None,
                     help="force jax platform (e.g. cpu)")
+    ap.add_argument("--overlap", action="store_true",
+                    help="add the overlap on/off A/B column (per fuse: "
+                         "serialized RDMA vs interior-first overlapped "
+                         "RDMA, byte-checked cell by cell)")
+    ap.add_argument("--out", default=None,
+                    help="also write the summary row to this JSON file "
+                         "(the --overlap-smoke leg's done_file)")
     args = ap.parse_args()
+
+    if args.overlap:
+        # The overlap column must compile the overlapped PROGRAM even on
+        # a CPU mesh (where dispatch force-serializes by default): this
+        # harness exists to prove bytes, the env is the documented hatch.
+        os.environ.setdefault("PCTPU_OVERLAP_INTERPRET", "1")
 
     from parallel_convolution_tpu.utils.platform import (
         apply_platform_env, enable_compile_cache, force_platform, on_tpu,
@@ -78,7 +172,7 @@ def main() -> int:
 
     from parallel_convolution_tpu.ops.filters import get_filter
     from parallel_convolution_tpu.parallel.mesh import make_grid_mesh
-    from parallel_convolution_tpu.utils import bench
+    from parallel_convolution_tpu.utils import bench, jax_compat
 
     if args.mesh:
         r, c = (int(v) for v in args.mesh.lower().split("x"))
@@ -88,19 +182,50 @@ def main() -> int:
     filt = get_filter("blur3")
     fuses = [int(v) for v in args.fuse.split(",")]
     interp = not on_tpu()
+    # Multi-device RDMA needs the DMA-faithful interpreter off-silicon;
+    # without it those cells FAIL on a missing lowering — emit typed
+    # capability skips instead of error rows that prove nothing.
+    rdma_capable = (mesh.size == 1 or not interp
+                    or jax_compat.HAS_TPU_INTERPRET)
 
     # "ppermute" = the standard tier at the same workload: halo.py
     # collective-permute exchange + the Pallas stencil kernel (fused
     # T-level variant for fuse>1) — the path the RDMA kernel must beat.
-    rows = []
+    paths = [("rdma", "pallas_rdma", False), ("ppermute", "pallas", None)]
+    if args.overlap:
+        paths.insert(1, ("rdma+overlap", "pallas_rdma", True))
+
+    rows, serial_bytes = [], {}
     for fuse in fuses:
-        for label, backend in (("rdma", "pallas_rdma"), ("ppermute", "pallas")):
+        for label, backend, ov in paths:
+            if backend == "pallas_rdma" and not rdma_capable:
+                row = {"backend": backend, "fuse": fuse, "path": label,
+                       "skipped": "capability",
+                       "detail": "no DMA-faithful TPU interpreter in "
+                                 "this jax; multi-device RDMA cells "
+                                 "need current jax or silicon"}
+                row["ab"] = "rdma_fuse"
+                rows.append(row)
+                print(json.dumps(row), flush=True)
+                continue
             try:
                 row = bench.bench_iterate(
                     (args.size, args.size), filt, args.iters, mesh=mesh,
-                    backend=backend, fuse=fuse, reps=args.reps)
-                row["oracle_bytes_ok"] = _byte_check(
-                    backend, fuse, mesh, filt, iters=2 * fuse)
+                    backend=backend, fuse=fuse, reps=args.reps,
+                    overlap=ov)
+                ok, raw = _byte_check(
+                    backend, fuse, mesh, filt, iters=2 * fuse,
+                    overlap=bool(ov))
+                row["oracle_bytes_ok"] = ok
+                if ov:
+                    twin = serial_bytes.get(fuse)
+                    if twin is not None:
+                        import numpy as np
+
+                        row["matches_serialized"] = bool(
+                            np.array_equal(raw, twin))
+                elif backend == "pallas_rdma":
+                    serial_bytes[fuse] = raw
             except Exception as e:
                 row = {"backend": backend, "fuse": fuse,
                        "error": repr(e)[:200]}
@@ -110,34 +235,74 @@ def main() -> int:
             rows.append(row)
             print(json.dumps(row), flush=True)
 
+    # Degenerate-grid overlap proof: ALWAYS runnable (any jax), and the
+    # only overlap byte coverage when the full protocol is capability-
+    # skipped above.
+    proofs = []
+    if args.overlap:
+        proofs = _degenerate_overlap_proofs(filt, [f for f in fuses
+                                                   if f <= 4] or [1])
+        for p in proofs:
+            rows.append(p)
+            print(json.dumps(p), flush=True)
+
     by_fuse = {}
     for r_ in rows:
-        if "error" in r_:
+        if "error" in r_ or "skipped" in r_ or r_["ab"] != "rdma_fuse":
             continue
         by_fuse.setdefault(r_["fuse"], {})[r_["path"]] = r_
+
+    completed = [r_ for r_ in rows
+                 if "error" not in r_ and "skipped" not in r_]
+    mismatches = [r_ for r_ in completed
+                  if not r_.get("oracle_bytes_ok", True)
+                  or not r_.get("matches_serialized", True)]
+    errors = [r_ for r_ in rows if "error" in r_]
+    skipped = [r_ for r_ in rows if "skipped" in r_]
+    overlap_proofs = [r_ for r_ in completed
+                      if r_.get("ab") == "overlap_degenerate"
+                      or r_.get("path") == "rdma+overlap"]
     summary = {
         "probe": "rdma_fuse_ab",
         "workload": f"blur3 {args.size}x{args.size} {args.iters} iters, "
                     f"mesh {'x'.join(str(s) for s in mesh.shape.values())}",
         "interpret": interp,
+        "overlap_ab": bool(args.overlap),
         # interpret rows prove bytes, never speed — only silicon rows may
         # feed the win/retire decision
         "perf_claim": not interp,
         # False when every configuration errored: an A/B with zero
         # completed rows has proven nothing and must not read as a pass.
-        "bytes_ok_all": bool(by_fuse) and all(
-            r_.get("oracle_bytes_ok", False)
-            for r_ in rows if "error" not in r_),
+        "bytes_ok_all": bool(completed) and not mismatches,
+        # The --overlap-smoke gate: byte mismatches + unexpected errors
+        # (typed capability skips are not failures — they name the jax
+        # feature gap; the degenerate proofs above still ran).
+        "failures": len(mismatches) + len(errors),
+        "overlap_proofs": len(overlap_proofs),
     }
     for fuse, d in sorted(by_fuse.items()):
-        if "rdma" in d and "ppermute" in d and d["rdma"]["wall_s"]:
+        if "rdma" in d and "ppermute" in d and d["rdma"].get("wall_s"):
             summary[f"rdma_vs_ppermute[fuse{fuse}]"] = round(
                 d["ppermute"]["wall_s"] / d["rdma"]["wall_s"], 4)
-    errors = [r_ for r_ in rows if "error" in r_]
+        if ("rdma" in d and "rdma+overlap" in d
+                and d["rdma+overlap"].get("wall_s")):
+            summary[f"overlap_vs_serialized[fuse{fuse}]"] = round(
+                d["rdma"]["wall_s"] / d["rdma+overlap"]["wall_s"], 4)
     if errors:
         summary["error_rows"] = len(errors)
+    if skipped:
+        summary["skipped_capability"] = len(skipped)
     print(json.dumps(summary), flush=True)
-    return 0 if summary["bytes_ok_all"] else 1
+    if args.out:
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)),
+                    exist_ok=True)
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(summary, f, indent=1, sort_keys=True)
+            f.write("\n")
+    ok = summary["bytes_ok_all"] and summary["failures"] == 0
+    if args.overlap:
+        ok = ok and summary["overlap_proofs"] > 0
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

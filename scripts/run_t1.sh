@@ -28,6 +28,19 @@
 #                                      Row (failures: 0) lands in
 #                                      evidence/obs_smoke.json (the
 #                                      supervisor leg's done_file).
+#   scripts/run_t1.sh --overlap-smoke  overlapped-halo A/B on the 2x4 CPU
+#                                      mesh: rdma overlap on/off per fuse
+#                                      level, oracle byte-checks on every
+#                                      cell, plus the degenerate-grid
+#                                      overlap-vs-serialized proofs that
+#                                      run on any jax (multi-device RDMA
+#                                      cells become typed capability
+#                                      skips on a jax without the
+#                                      DMA-faithful interpreter).
+#                                      Summary (failures: 0 = the
+#                                      byte-equality gate) lands in
+#                                      evidence/overlap_smoke.json (the
+#                                      supervisor leg's done_file).
 #   scripts/run_t1.sh --elastic-smoke  reshape round-trip on the CPU mesh:
 #                                      crash a checkpointed run on 2x4,
 #                                      resume the snapshot on 1x2 / 2x2 /
@@ -44,6 +57,13 @@ if [ "${1:-}" = "--obs-smoke" ]; then
     PCTPU_OBS=1 \
     python scripts/obs_smoke.py --n 24 --rows 48 --cols 64 --iters 2 \
       --mesh 2x4 --out evidence/obs_smoke.json
+fi
+
+if [ "${1:-}" = "--overlap-smoke" ]; then
+  exec timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python scripts/rdma_fuse_ab.py --overlap --size 64 --iters 4 \
+      --reps 1 --fuse 1,2,4 --mesh 2x4 --out evidence/overlap_smoke.json
 fi
 
 if [ "${1:-}" = "--elastic-smoke" ]; then

@@ -63,6 +63,13 @@ def main() -> int:
                     help="comma list restricting fusion depths")
     ap.add_argument("--tiles", default=None,
                     help="comma list of HxW tiles restricting the menu")
+    ap.add_argument("--overlap", default="auto",
+                    choices=["auto", "on", "off"],
+                    help="overlapped halo pipeline dimension of the "
+                         "candidate space: auto = enumerate both where "
+                         "legal (RDMA tier, real collective, non-empty "
+                         "interior), on/off = clamped request; the "
+                         "winning plan persists its overlap verdict")
     ap.add_argument("--dry-run", action="store_true",
                     help="cost model only — no compiles, no device work; "
                          "the emitted plan carries source='predicted'")
@@ -108,10 +115,11 @@ def main() -> int:
     tiles = ([tuple(int(x) for x in t.split("x"))
               for t in args.tiles.split(",")] if args.tiles else None)
 
+    overlap = {"auto": None, "on": True, "off": False}[args.overlap]
     result = search.tune(
         w, mesh=mesh, dry_run=args.dry_run, backends=backends,
-        fuses=fuses, tiles=tiles, iters=args.iters, reps=args.reps,
-        max_measure=args.max_measure)
+        fuses=fuses, tiles=tiles, overlap=overlap, iters=args.iters,
+        reps=args.reps, max_measure=args.max_measure)
     for row in result.rows:
         print(json.dumps(row), file=sys.stderr, flush=True)
 
@@ -146,6 +154,7 @@ def main() -> int:
         summary["auto_resolved"] = {
             "backend": res.backend, "fuse": res.fuse,
             "tile": list(res.tile) if res.tile else None,
+            "overlap": res.overlap,
             "plan_source": res.source,
         }
         summary["auto_ok"] = bool(
