@@ -14,6 +14,13 @@ One telemetry spine for the whole stack (round 11).  Three parts:
 * :mod:`obs.attribution` — analytic per-direction halo-byte accounting
   and the roofline exchange-vs-compute split, the instrumentation the
   overlapped-halo and topology roadmap items are judged against.
+* :mod:`obs.trace` — causal request tracing (round 13):
+  trace_id/span_id/parent_id spans emitted as ``span`` events into the
+  same log, propagated across transports via ``traceparent`` strings;
+  ``scripts/trace_report.py`` reconstructs per-request trees, batch
+  critical paths, and Chrome ``trace_event`` JSON, and
+  ``scripts/perf_gate.py`` is the perf-regression sentry over
+  ``evidence/perf_history.jsonl``.
 
 ``scripts/obs_report.py`` folds an event log + metrics snapshot into the
 human summary (per-phase quantiles, exchange fraction per backend,
@@ -26,9 +33,9 @@ must stay cheap.  ``obs.attribution`` additionally pulls the (jax-free)
 tuning cost model.
 """
 
-from parallel_convolution_tpu.obs import events, metrics
+from parallel_convolution_tpu.obs import events, metrics, trace
 
-__all__ = ["attribution", "events", "metrics"]
+__all__ = ["attribution", "events", "metrics", "trace"]
 
 
 def __getattr__(name):

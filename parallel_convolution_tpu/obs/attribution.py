@@ -35,7 +35,9 @@ cost model's calibrated constants for the time split.
 
 from __future__ import annotations
 
-from parallel_convolution_tpu.obs import events, metrics
+import time
+
+from parallel_convolution_tpu.obs import events, metrics, trace
 from parallel_convolution_tpu.tuning import costmodel
 
 __all__ = [
@@ -289,6 +291,28 @@ def record_step(*, backend: str, grid, block_hw, radius: int, fuse: int,
         overlap=bool(split["overlap"]),
         exchange_hidden_fraction=round(hidden_of_ex, 4),
         **({"wall_s": round(wall_s, 6)} if wall_s is not None else {}))
+    # Trace attribution (round 13): when this step runs under an active
+    # span (the serving device span, a traced converge call), split the
+    # measured wall into model-attributed exchange / compute CHILD spans
+    # — the reference's per-phase MPI_Wtime breakdown made first-class.
+    # The exchange span's dur is the EXPOSED share; the hidden-under-
+    # compute share (r12 overlap) rides as an attribute because it
+    # overlaps the compute span's interval rather than adding to it.
+    ctx = trace.current()
+    if ctx is not None and wall_s is not None and wall_s > 0:
+        ex_s = wall_s * frac
+        comp_s = wall_s - ex_s
+        t0 = time.time() - wall_s
+        trace.emit_span(
+            "exchange", trace_id=ctx.trace_id, parent_id=ctx.span_id,
+            start_ts=t0, dur_s=ex_s, backend=backend, source=source,
+            overlap=bool(split["overlap"]),
+            hidden_s=round(wall_s * split["exchange_hidden_of_total"], 6),
+            halo_bytes=by["total"], rounds=by["rounds"])
+        trace.emit_span(
+            "compute", trace_id=ctx.trace_id, parent_id=ctx.span_id,
+            start_ts=t0 + ex_s, dur_s=comp_s, backend=backend,
+            source=source, iters=int(iters))
     return {"halo_bytes": by, "exchange_fraction": frac,
             "exchange_hidden_fraction": hidden_of_ex,
             "overlap": bool(split["overlap"])}

@@ -67,6 +67,8 @@ KINDS = frozenset({
     "heartbeat",           # supervisor liveness tick
     "leg",                 # supervisor leg state change (start/done/...)
     "serve",               # service lifecycle (boot, close)
+    "span",                # one closed trace span (obs.trace): trace_id/
+    #                        span_id/parent_id + start_ts/dur_s/links
 })
 
 _REQUIRED = ("seq", "ts", "perf", "kind")
@@ -118,13 +120,30 @@ class EventLog:
             except OSError:
                 pass
         else:
-            # Shift generations up, oldest first, each step atomic.
+            # Shift generations up, oldest first, each step atomic.  Every
+            # rename is guarded against exactly ONE failure: a SIBLING
+            # process (or any external actor) rotating the same path can
+            # win the race between our ``exists()`` check and the
+            # ``os.replace`` — a vanished source must degrade to "that
+            # generation already moved", not to a FileNotFoundError that
+            # kills the writer thread and LOSES the line being emitted
+            # (the multi-thread rotation stress in tests/test_obs.py pins
+            # this).  Persistent failures (EACCES, a no-rename mount) are
+            # NOT swallowed — silently disabling rotation would let the
+            # live file grow past max_bytes forever.
             for i in range(self.keep - 1, 0, -1):
                 src = self.path.with_name(f"{self.path.name}.{i}")
                 if src.exists():
-                    os.replace(src, self.path.with_name(
-                        f"{self.path.name}.{i + 1}"))
-            os.replace(self.path, self.path.with_name(f"{self.path.name}.1"))
+                    try:
+                        os.replace(src, self.path.with_name(
+                            f"{self.path.name}.{i + 1}"))
+                    except FileNotFoundError:
+                        pass
+            try:
+                os.replace(self.path,
+                           self.path.with_name(f"{self.path.name}.1"))
+            except FileNotFoundError:
+                pass  # live vanished: a sibling already rotated it away
             # Drop anything beyond keep (the shift above may have created
             # .keep+1 transiently — remove it).
             extra = self.path.with_name(f"{self.path.name}.{self.keep + 1}")
@@ -150,11 +169,15 @@ class EventLog:
                    "perf": round(time.perf_counter(), 6),
                    "pid": os.getpid(), "kind": kind, **fields}
             line = json.dumps(rec, default=str) + "\n"
-            if self._size + len(line) > self.max_bytes and self._size > 0:
+            # Size accounting in BYTES (the unit tell()/max_bytes use):
+            # len(line) counts characters, which under-counts any
+            # non-ASCII field and lets the file overshoot max_bytes.
+            nbytes = len(line.encode("utf-8"))
+            if self._size + nbytes > self.max_bytes and self._size > 0:
                 self._rotate_locked()
             self._fh.write(line)
             self._fh.flush()
-            self._size += len(line)
+            self._size += nbytes
         return rec
 
     def close(self) -> None:

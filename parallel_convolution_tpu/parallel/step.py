@@ -58,16 +58,26 @@ __all__ = ["BACKENDS", "STORAGE_DTYPES", "sharded_iterate", "sharded_converge",
 def _note_compile(builder: str, backend: str, grid, iters: int, fuse: int,
                   boundary: str, block_hw) -> None:
     """Telemetry for one fresh trace/compile (a build-cache miss): the
-    ``compile`` event + a labeled counter.  One branch when obs is off."""
+    ``compile`` event + a labeled counter.  One branch when obs is off.
+
+    When the compile happens under an active trace (a cold serving key:
+    the engine's compile span is current on this thread), the event
+    carries the trace/span ids — ``trace_report.py`` can then show which
+    request's span tree triggered which build-cache miss."""
     if not obs_metrics.enabled():
         return
+    from parallel_convolution_tpu.obs import trace as obs_trace
+
     obs_metrics.counter(
         "pctpu_compiles_total", "fresh traces/compiles of iteration runners",
         ("builder", "backend")).inc(builder=builder, backend=backend)
+    ctx = obs_trace.current()
     obs_events.emit(
         "compile", builder=builder, backend=backend,
         grid=f"{grid[0]}x{grid[1]}", iters=int(iters), fuse=int(fuse),
-        boundary=boundary, block=[int(b) for b in block_hw])
+        boundary=boundary, block=[int(b) for b in block_hw],
+        **({"trace_id": ctx.trace_id, "span_id": ctx.span_id}
+           if ctx is not None else {}))
 
 
 def _record_step_obs(backend: str, mesh: Mesh, block_hw, radius: int,
@@ -800,13 +810,24 @@ def sharded_converge(x, filt: Filter, tol: float, max_iters: int,
                          interior_split, overlap)
     channels, shape = xs.shape[0], tuple(xs.shape)
     t0 = time.perf_counter()
-    out, done = fn(xs)
-    done = int(done)  # materializes the run (the convergence count)
+    # The convergence run is fenced (the count readback), so it gets a
+    # real device span: root of a fresh trace for a bare CLI call, child
+    # of the caller's span when one is active.  record_step below then
+    # hangs the model-attributed exchange/compute children off it.
+    from parallel_convolution_tpu.obs import trace as obs_trace
+
+    with obs_trace.span("device", source="sharded_converge",
+                        backend=backend) as dsp:
+        out, done = fn(xs)
+        done = int(done)  # materializes the run (the convergence count)
+        dsp.set(iters=done)
     if obs_metrics.enabled():
-        _record_step_obs(backend, mesh, block_hw, filt.radius,
-                         max(1, min(int(fuse), max(1, check_every - 1))),
-                         done, channels, storage, boundary,
-                         time.perf_counter() - t0, shape, quantize,
-                         _norm_tile(tile), source="sharded_converge",
-                         overlap=overlap)
+        with obs_trace.attach(dsp.context):
+            _record_step_obs(backend, mesh, block_hw, filt.radius,
+                             max(1, min(int(fuse),
+                                        max(1, check_every - 1))),
+                             done, channels, storage, boundary,
+                             time.perf_counter() - t0, shape, quantize,
+                             _norm_tile(tile), source="sharded_converge",
+                             overlap=overlap)
     return out[:, : valid_hw[0], : valid_hw[1]].astype(jnp.float32), done

@@ -23,6 +23,13 @@ Invariants (asserted by ``tests/test_serving.py``):
 * **One worker.**  All device execution happens on the single worker
   thread, serializing access to the mesh; HTTP handler threads only
   enqueue and wait on their slot.
+
+Tracing (round 13): the batcher itself opens no spans — it is the
+thread hop.  A request's :class:`obs.trace.SpanContext` rides its
+payload (``payload["trace"]``), and the executor derives the per-request
+``queue`` span from this queue's own clocks (``_Item.enqueued_at`` →
+flush collect) plus the per-flush ``batch`` span that links every
+co-batched request (``service._execute_batch``).
 """
 
 from __future__ import annotations

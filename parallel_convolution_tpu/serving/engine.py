@@ -48,7 +48,9 @@ from collections import OrderedDict
 
 import numpy as np
 
-from parallel_convolution_tpu.obs import events as obs_events, metrics as obs_metrics
+from parallel_convolution_tpu.obs import (
+    events as obs_events, metrics as obs_metrics, trace as obs_trace,
+)
 from parallel_convolution_tpu.ops.filters import get_filter
 from parallel_convolution_tpu.utils.config import (
     BACKENDS, BOUNDARIES, STORAGES,
@@ -116,7 +118,7 @@ class _Entry:
 
     __slots__ = ("key", "effective_backend", "fns", "lock", "plan_source",
                  "predicted_gpx", "plan_key", "effective_overlap",
-                 "splits")
+                 "splits", "compile_ref")
 
     def __init__(self, key: EngineKey, effective_backend: str,
                  plan_source: str = "explicit",
@@ -134,6 +136,10 @@ class _Entry:
         self.predicted_gpx = predicted_gpx   # cost-model Gpx/s/chip
         self.plan_key = plan_key             # tuning canonical key: the
         #                                      drift series' label
+        self.compile_ref: dict | None = None  # the single-flight leader's
+        #                                      compile_build span ref —
+        #                                      waiters (and reports) link
+        #                                      to WHO paid for the compile
         self.fns: dict[int, object] = {}   # batch size -> jitted runner
         self.splits: dict[int, dict] = {}  # batch size -> exchange split
         #                                    (pure model math, cached off
@@ -146,12 +152,13 @@ class _Entry:
 class _InFlight:
     """A cold key's compilation in progress: leader fills, waiters wait."""
 
-    __slots__ = ("event", "entry", "error")
+    __slots__ = ("event", "entry", "error", "span_ref")
 
     def __init__(self):
         self.event = threading.Event()
         self.entry: _Entry | None = None
         self.error: BaseException | None = None
+        self.span_ref: dict | None = None  # leader's compile_build span
 
 
 class WarmEngine:
@@ -343,6 +350,12 @@ class WarmEngine:
                 fl.event.wait()
                 if fl.error is not None:
                     raise fl.error
+                # Single-flight attribution (obs.trace): this thread did
+                # not compile — link the LEADER's compile_build span onto
+                # our enclosing span (run_batch's compile phase), so the
+                # trace report can tell who paid and who drafted.
+                if fl.span_ref is not None:
+                    obs_trace.add_link(fl.span_ref, kind="single_flight")
                 # The leader landed the entry; loop to take the hit path
                 # (or recompile if an eviction already dropped it).
                 with self._lock:
@@ -352,7 +365,12 @@ class WarmEngine:
                         return e
                 continue
             try:
-                entry = self._build_entry(key)
+                with obs_trace.span(
+                        "compile_build", backend=key.backend,
+                        filter=key.filter_name, fuse=key.fuse,
+                        shape=list(key.shape)) as bsp:
+                    entry = self._build_entry(key)
+                    entry.compile_ref = fl.span_ref = bsp.ref
             except BaseException as err:
                 fl.error = err
                 with self._lock:
@@ -489,33 +507,48 @@ class WarmEngine:
                 f"stale key grid {key.grid}: engine mesh is now "
                 f"{self.grid()} (resharded mid-process)")
         with t.phase("compile"):
-            entry = self.entry(key)
-            fn = entry.fns.get(B) or self._compile_batch(entry, B)
+            # The trace's compile span covers acquisition (warm hit or
+            # cold build): the leader's compile_build nests inside it, a
+            # single-flight waiter LINKS the leader's build span instead
+            # (obs.trace — who paid vs who drafted).
+            with obs_trace.span("compile", backend=key.backend,
+                                batch=B) as csp:
+                entry = self.entry(key)
+                fn = entry.fns.get(B) or self._compile_batch(entry, B)
+                csp.set(effective_backend=entry.effective_backend)
         filt = get_filter(key.filter_name)
         with t.phase("copy_in"):
-            folded = np.ascontiguousarray(
-                images.reshape(B * C, H, W).astype(np.float32))
-            xs, valid_hw, _ = step_lib._prepare(
-                folded, self.mesh, filt.radius, key.storage)
-            jax.block_until_ready(xs)
+            with obs_trace.span("copy_in", batch=B):
+                folded = np.ascontiguousarray(
+                    images.reshape(B * C, H, W).astype(np.float32))
+                xs, valid_hw, _ = step_lib._prepare(
+                    folded, self.mesh, filt.radius, key.storage)
+                jax.block_until_ready(xs)
         # The timer is shared across retry ATTEMPTS (the service re-invokes
         # run_batch with it), so telemetry must charge only THIS call's
         # device delta — a retried batch's drift/exchange series would
         # otherwise include the failed attempt's wall.
         dev_before = t.wall("device")
         with t.phase("device"):
-            out = fn(xs)
-            jax.block_until_ready(out)
+            with obs_trace.span("device", batch=B,
+                                backend=entry.effective_backend) as dsp:
+                out = fn(xs)
+                jax.block_until_ready(out)
         dev_s = t.wall("device") - dev_before
         with t.phase("copy_out"):
-            out = np.asarray(
-                out[:, : valid_hw[0], : valid_hw[1]].astype(jnp.float32))
-            out = out.reshape(B, C, H, W)
+            with obs_trace.span("copy_out", batch=B):
+                out = np.asarray(
+                    out[:, : valid_hw[0], : valid_hw[1]].astype(jnp.float32))
+                out = out.reshape(B, C, H, W)
         with self._lock:
             self.stats["batches"] += 1
             self.stats["images"] += B
         if obs_metrics.enabled():
-            self._record_batch_obs(entry, B, filt, dev_s)
+            # Attach the (already closed) device span's context so the
+            # model-attributed exchange/compute spans record_step emits
+            # land as ITS children — the span tree's leaf level.
+            with obs_trace.attach(dsp.context):
+                self._record_batch_obs(entry, B, filt, dev_s)
         # Overlap-adjusted exchange attribution for the response (pure
         # model arithmetic — always on, obs or not): hidden vs exposed
         # exchange is how the overlapped-halo lever is judged per
@@ -542,6 +575,7 @@ class WarmEngine:
             "effective_backend": entry.effective_backend,
             "effective_grid": f"{key.grid[0]}x{key.grid[1]}",
             "plan_source": entry.plan_source,
+            "plan_key": entry.plan_key,
             "predicted_gpx_per_chip": entry.predicted_gpx,
             "batch_size": B,
             "overlap": entry.effective_overlap,
@@ -580,6 +614,17 @@ class WarmEngine:
                 B * C * H * W * key.iters / dev_s / self.mesh.size / 1e9)
 
     # -- introspection ------------------------------------------------------
+    def degraded(self) -> list[dict]:
+        """Distinct requested→effective backend downgrades among resident
+        entries — the 'current degrade tier' surface ``/readyz`` reports
+        (a degraded service still serves; readiness reports it rather
+        than failing on it)."""
+        with self._lock:
+            pairs = sorted({(k.backend, e.effective_backend)
+                            for k, e in self._entries.items()
+                            if e.effective_backend != k.backend})
+        return [{"requested": req, "effective": eff} for req, eff in pairs]
+
     def snapshot(self) -> dict:
         """Stats + resident keys, for /stats and the loadgen row."""
         with self._lock:

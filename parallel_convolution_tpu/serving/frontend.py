@@ -25,13 +25,25 @@ Wire format (POST ``/v1/convolve``)::
     429 -> {"ok": false,
             "rejected": "queue_full"|"deadline"|"error"|"resharding", ...}
 
-``GET /healthz`` returns ``{"ok": true}`` plus the service snapshot;
+``GET /healthz`` returns ``{"ok": true}`` plus the service snapshot
+(liveness: the process is up and can report state); ``GET /readyz``
+returns the READINESS verdict — 200 only when the service can usefully
+take a new request (503 while a mesh reshape is in progress or the
+admission queue is at its bound; the current degrade tier rides in the
+payload) — the probe surface the ROADMAP-item-2 replica router keys on.
 ``GET /stats`` returns the snapshot alone; ``GET /metrics`` serves the
 process-global obs registry in Prometheus text exposition format 0.0.4
 (round 11 — the pull endpoint the stack never had; with ``PCTPU_OBS=0``
 it serves a comment noting obs is disabled, still a valid exposition).
 Rejections map to HTTP 429 (load shed — retryable by the client) except
 contract errors (400).
+
+Tracing (round 13): each request runs under a ``request`` root span
+(obs.trace) and every response body carries its ``trace_id``.  Context
+propagates IN via the W3C-style ``traceparent`` — an HTTP header on the
+POST, or an explicit ``"traceparent"`` body field on the in-process
+client — so an upstream caller's trace adopts the serving spans instead
+of starting a fresh tree.
 """
 
 from __future__ import annotations
@@ -41,7 +53,9 @@ import json
 
 import numpy as np
 
-from parallel_convolution_tpu.obs import metrics as obs_metrics
+from parallel_convolution_tpu.obs import (
+    metrics as obs_metrics, trace as obs_trace,
+)
 from parallel_convolution_tpu.serving.service import (
     ConvolutionService, Rejected, Request, Response,
 )
@@ -113,6 +127,7 @@ def encode_response(result) -> tuple[int, dict]:
         return _REJECT_STATUS.get(result.reason, 429), {
             "ok": False, "rejected": result.reason,
             "request_id": result.request_id, "detail": result.detail,
+            "trace_id": result.trace_id,
         }
     assert isinstance(result, Response)
     return 200, {
@@ -123,6 +138,7 @@ def encode_response(result) -> tuple[int, dict]:
         "effective_grid": result.effective_grid,
         "backend": result.backend,
         "plan_source": result.plan_source,
+        "plan_key": result.plan_key,
         "predicted_gpx_per_chip": result.predicted_gpx_per_chip,
         "overlap": result.overlap,
         "exchange_fraction": result.exchange_fraction,
@@ -130,6 +146,7 @@ def encode_response(result) -> tuple[int, dict]:
         "request_id": result.request_id,
         "batch_size": result.batch_size,
         "phases": result.phases,
+        "trace_id": result.trace_id,
     }
 
 
@@ -139,19 +156,51 @@ class InProcessClient:
     def __init__(self, service: ConvolutionService):
         self.service = service
 
-    def request(self, body: dict,
-                timeout: float | None = None) -> tuple[int, dict]:
-        """One wire-format request → (status, wire-format response)."""
-        try:
-            req = decode_request(body)
-        except ValueError as e:
-            return 400, {"ok": False, "rejected": "invalid",
-                         "request_id": body.get("request_id") or "",
-                         "detail": str(e)}
-        return encode_response(self.service.submit(req, timeout=timeout))
+    def request(self, body: dict, timeout: float | None = None,
+                traceparent: str | None = None,
+                transport: str = "in_process") -> tuple[int, dict]:
+        """One wire-format request → (status, wire-format response).
+
+        The request runs under a ``request`` root span; ``traceparent``
+        (the explicit argument, or a ``"traceparent"`` body field) makes
+        it a CHILD of the caller's span instead — the in-process twin of
+        the HTTP header.  Every response dict carries ``trace_id``
+        ("" with obs disabled).  ``transport`` labels the root span —
+        the HTTP handler delegates here and passes ``"http"``.
+        """
+        tp = traceparent if traceparent is not None else body.get(
+            "traceparent")
+        pctx = obs_trace.parse_traceparent(tp)
+        with obs_trace.span(
+                "request", parent=pctx, transport=transport,
+                request_id=str(body.get("request_id") or ""),
+                # The parent span (if any) lives in the CALLER's process:
+                # reconstruction must treat this span as a local root, not
+                # an orphan, when the parent is absent from the log.
+                **({"remote_parent": True} if pctx is not None
+                   else {})) as sp:
+            tid = sp.context.trace_id if sp.context is not None else ""
+            try:
+                req = decode_request(body)
+            except ValueError as e:
+                sp.set(outcome="invalid")
+                return 400, {"ok": False, "rejected": "invalid",
+                             "request_id": body.get("request_id") or "",
+                             "detail": str(e), "trace_id": tid}
+            status, wire = encode_response(
+                self.service.submit(req, timeout=timeout))
+            if not wire.get("trace_id"):
+                wire["trace_id"] = tid
+            sp.set(status=status)
+            return status, wire
 
     def healthz(self) -> tuple[int, dict]:
         return 200, {"ok": True, **self.service.snapshot()}
+
+    def readyz(self) -> tuple[int, dict]:
+        """Socket-free readiness twin: (200|503, verdict payload)."""
+        ready, payload = self.service.readiness()
+        return (200 if ready else 503), {"ok": ready, **payload}
 
     def stats(self) -> tuple[int, dict]:
         return 200, self.service.snapshot()
@@ -189,6 +238,8 @@ def make_http_server(service: ConvolutionService, host: str = "127.0.0.1",
         def do_GET(self):  # noqa: N802 — http.server API
             if self.path == "/healthz":
                 self._send(*client.healthz())
+            elif self.path == "/readyz":
+                self._send(*client.readyz())
             elif self.path == "/stats":
                 self._send(*client.stats())
             elif self.path == "/metrics":
@@ -214,6 +265,11 @@ def make_http_server(service: ConvolutionService, host: str = "127.0.0.1",
                 self._send(400, {"ok": False, "rejected": "invalid",
                                  "detail": f"bad JSON body: {e}"})
                 return
-            self._send(*client.request(body))
+            # W3C-style trace propagation: the transport header wins
+            # over any body field (the HTTP twin of the in-process
+            # client's explicit argument).
+            self._send(*client.request(
+                body, traceparent=self.headers.get("traceparent"),
+                transport="http"))
 
     return ThreadingHTTPServer((host, port), Handler)

@@ -29,7 +29,9 @@ import time
 
 import numpy as np
 
-from parallel_convolution_tpu.obs import events as obs_events, metrics as obs_metrics
+from parallel_convolution_tpu.obs import (
+    events as obs_events, metrics as obs_metrics, trace as obs_trace,
+)
 from parallel_convolution_tpu.serving.batcher import MicroBatcher
 from parallel_convolution_tpu.serving.engine import EngineKey, WarmEngine
 from parallel_convolution_tpu.utils.tracing import PhaseTimer
@@ -91,6 +93,11 @@ class Response:
     exchange_hidden_fraction: float = 0.0  # share of exchange time the
     #                                  overlapped pipeline hides under
     #                                  compute (0.0 when serialized)
+    trace_id: str = ""               # the request's causal trace id
+    #                                  (obs.trace; "" with PCTPU_OBS=0)
+    plan_key: str = ""               # tuning canonical key of the served
+    #                                  config — the perf_gate.py history
+    #                                  key and the drift-series label
 
     ok = True
 
@@ -102,6 +109,8 @@ class Rejected:
     reason: str   # queue_full | deadline | invalid | error | resharding
     request_id: str
     detail: str = ""
+    trace_id: str = ""   # the request's causal trace id (when admitted
+    #                      under an active trace; "" otherwise)
 
     ok = False
 
@@ -153,9 +162,12 @@ class ConvolutionService:
             self.stats[counter] += n
 
     def _shed(self, reason: str, rid: str, detail: str = "",
-              counter: str | None = None, n: int = 1) -> Rejected:
+              counter: str | None = None, n: int = 1,
+              trace=None) -> Rejected:
         """One path for every typed rejection: the legacy counter bump,
-        the admission event, and the Rejected value."""
+        the admission event, and the Rejected value.  ``trace`` is the
+        request's :class:`obs.trace.SpanContext` when it was admitted
+        under an active trace — the rejection then joins the tree."""
         if counter is not None:
             self._bump(counter, n)
         if obs_metrics.enabled():
@@ -163,9 +175,13 @@ class ConvolutionService:
                 "pctpu_admission_total",
                 "typed request outcomes at the admission boundary",
                 ("outcome",)).inc(n, outcome=reason)
-            obs_events.emit("admission", outcome=reason, request_id=rid,
-                            detail=detail[:200])
-        return Rejected(reason, rid, detail=detail)
+            obs_events.emit(
+                "admission", outcome=reason, request_id=rid,
+                detail=detail[:200],
+                **({"trace_id": trace.trace_id} if trace is not None
+                   else {}))
+        return Rejected(reason, rid, detail=detail,
+                        trace_id=trace.trace_id if trace is not None else "")
 
     def _validate(self, req: Request) -> tuple[EngineKey, str, np.ndarray]:
         """Terminal ValueError on any contract violation (→ ``invalid``).
@@ -214,27 +230,47 @@ class ConvolutionService:
         """
         rid = req.request_id or f"r{next(self._ids)}"
         self._bump("submitted")
-        if self._reshaping:
-            # The mesh is being swapped under us: shed with a typed,
-            # retryable reason (the window is one drain + re-warm long).
-            return self._shed("resharding", rid,
-                              detail="mesh reshape in progress; retry",
-                              counter="rejected_resharding")
-        try:
-            key, plan_source, planar = self._validate(req)
-        except Exception as e:  # noqa: BLE001 — contract errors are typed
-            return self._shed("invalid", rid, detail=str(e),
-                              counter="rejected_invalid")
-        deadline_at = (time.monotonic() + req.deadline_s
-                       if req.deadline_s is not None else None)
-        payload = {"planar": planar, "rid": rid, "rgb": req.image.ndim == 3,
-                   "backend": req.backend, "plan_source": plan_source}
-        slot = self.batcher.try_submit(key, payload, deadline_at)
-        if slot is None:
-            return self._shed(
-                "queue_full", rid,
-                detail=f"queue depth >= {self.batcher.max_queue}",
-                counter="rejected_queue_full")
+        # The request's causal root: the transport's `request` span when
+        # one is active (frontend.InProcessClient / the HTTP handler),
+        # else the admission span below becomes the root — either way a
+        # traced request has exactly ONE root (obs.trace).
+        parent = obs_trace.current()
+        root = parent
+        with obs_trace.span("admission", request_id=rid,
+                            backend=req.backend) as asp:
+            if root is None:
+                root = asp.context
+            asp.set(outcome="admitted")
+            if self._reshaping:
+                # The mesh is being swapped under us: shed with a typed,
+                # retryable reason (the window is one drain + re-warm
+                # long).
+                asp.set(outcome="resharding")
+                return self._shed("resharding", rid,
+                                  detail="mesh reshape in progress; retry",
+                                  counter="rejected_resharding",
+                                  trace=root)
+            try:
+                key, plan_source, planar = self._validate(req)
+            except Exception as e:  # noqa: BLE001 — typed contract errors
+                asp.set(outcome="invalid")
+                return self._shed("invalid", rid, detail=str(e),
+                                  counter="rejected_invalid", trace=root)
+            deadline_at = (time.monotonic() + req.deadline_s
+                           if req.deadline_s is not None else None)
+            payload = {"planar": planar, "rid": rid,
+                       "rgb": req.image.ndim == 3,
+                       "backend": req.backend, "plan_source": plan_source,
+                       # The context the worker thread re-enters: queue
+                       # span parent, batch-span link, response trace_id.
+                       "trace": root}
+            slot = self.batcher.try_submit(key, payload, deadline_at)
+            if slot is None:
+                asp.set(outcome="queue_full")
+                return self._shed(
+                    "queue_full", rid,
+                    detail=f"queue depth >= {self.batcher.max_queue}",
+                    counter="rejected_queue_full", trace=root)
         if not wait:
             return slot
         result = slot.result(timeout)
@@ -245,7 +281,7 @@ class ConvolutionService:
             # service can never reconcile as healthy load shedding.
             return self._shed("timeout", rid,
                               detail="client wait timed out",
-                              counter="client_timeouts")
+                              counter="client_timeouts", trace=root)
         return result
 
     # -- execution (batcher worker thread) ------------------------------------
@@ -261,7 +297,8 @@ class ConvolutionService:
                     "deadline", it.payload["rid"],
                     detail=f"queued {start - it.enqueued_at:.3f}s past "
                            "deadline",
-                    counter="rejected_deadline"))
+                    counter="rejected_deadline",
+                    trace=it.payload.get("trace")))
             else:
                 live.append(it)
         if not live:
@@ -276,7 +313,8 @@ class ConvolutionService:
                 it.slot.set(self._shed(
                     "resharding", it.payload["rid"],
                     detail="mesh resharded while queued; retry",
-                    counter="rejected_resharding"))
+                    counter="rejected_resharding",
+                    trace=it.payload.get("trace")))
             return
         stacked = np.stack([it.payload["planar"] for it in live])
         timer = PhaseTimer()
@@ -287,58 +325,90 @@ class ConvolutionService:
         def on_retry(attempt_no, exc, delay):
             self._bump("retries")
 
-        try:
-            out, info = with_retry(attempt, self.retry_policy,
-                                   on_retry=on_retry)
-        except Exception as e:  # noqa: BLE001 — typed result, never a hang
+        # The batch-join span (obs.trace): ONE span per flush, parented
+        # to the first traced request (whose trace natively owns the
+        # shared compile/device work — "who paid") and LINKING every
+        # co-batched request's root, so each of the N traces can find
+        # the batch it rode.  The engine phases below run on this worker
+        # thread inside this span, becoming its children.
+        traces = [it.payload.get("trace") for it in live]
+        primary = next((c for c in traces if c is not None), None)
+        with obs_trace.span(
+                "batch", parent=primary,
+                links=[c for c in traces if c is not None],
+                n_requests=len(live)) as bsp:
+            now_ts = time.time()
             for it in live:
-                it.slot.set(self._shed("error", it.payload["rid"],
-                                       detail=repr(e)[:500],
-                                       counter="rejected_error"))
-            return
-        phases = dict(info["phases"])
-        u8 = np.clip(np.rint(out), 0.0, 255.0).astype(np.uint8)
-        for i, it in enumerate(live):
-            plane = u8[i]
-            image = (imageio.planar_to_interleaved(plane)
-                     if it.payload["rgb"] else plane[0])
-            queue_s = start - it.enqueued_at
-            per = {"queue": round(queue_s, 6),
-                   **{k: round(v, 6) for k, v in phases.items()},
-                   }
-            per["total"] = round(queue_s + sum(phases.values()), 6)
-            it.slot.set(Response(
-                image=image,
-                effective_backend=info["effective_backend"],
-                backend=it.payload["backend"],
-                request_id=it.payload["rid"],
-                batch_size=info["batch_size"],
-                phases=per,
-                # Per-REQUEST provenance from admission time: an auto and
-                # an explicit request can share this entry, so the
-                # entry's build-time note cannot label them both.
-                plan_source=it.payload.get(
-                    "plan_source", info.get("plan_source", "explicit")),
-                predicted_gpx_per_chip=info.get("predicted_gpx_per_chip"),
-                effective_grid=info.get("effective_grid", ""),
-                overlap=bool(info.get("overlap", False)),
-                exchange_fraction=info.get("exchange_fraction", 0.0),
-                exchange_hidden_fraction=info.get(
-                    "exchange_hidden_fraction", 0.0),
-            ))
-            self._bump("completed")
-            if obs_metrics.enabled():
-                ph = obs_metrics.histogram(
-                    "pctpu_request_phase_seconds",
-                    "per-request serving latency by phase",
-                    ("phase", "backend"))
-                eff = info["effective_backend"]
-                for name, v in per.items():
-                    ph.observe(v, phase=name, backend=eff)
-                obs_metrics.counter(
-                    "pctpu_admission_total",
-                    "typed request outcomes at the admission boundary",
-                    ("outcome",)).inc(outcome="completed")
+                c = it.payload.get("trace")
+                if c is not None:
+                    q = start - it.enqueued_at
+                    # Synthetic queue span: enqueue → batch collect, from
+                    # the batcher's own clocks, child of the request root.
+                    obs_trace.emit_span(
+                        "queue", trace_id=c.trace_id,
+                        parent_id=c.span_id, start_ts=now_ts - q,
+                        dur_s=q, request_id=it.payload["rid"])
+            try:
+                out, info = with_retry(attempt, self.retry_policy,
+                                       on_retry=on_retry)
+            except Exception as e:  # noqa: BLE001 — typed, never a hang
+                bsp.set(outcome="error")
+                for it in live:
+                    it.slot.set(self._shed("error", it.payload["rid"],
+                                           detail=repr(e)[:500],
+                                           counter="rejected_error",
+                                           trace=it.payload.get("trace")))
+                return
+            bsp.set(batch_size=info["batch_size"],
+                    effective_backend=info["effective_backend"],
+                    plan_key=info.get("plan_key", ""))
+            phases = dict(info["phases"])
+            u8 = np.clip(np.rint(out), 0.0, 255.0).astype(np.uint8)
+            for i, it in enumerate(live):
+                plane = u8[i]
+                image = (imageio.planar_to_interleaved(plane)
+                         if it.payload["rgb"] else plane[0])
+                queue_s = start - it.enqueued_at
+                per = {"queue": round(queue_s, 6),
+                       **{k: round(v, 6) for k, v in phases.items()},
+                       }
+                per["total"] = round(queue_s + sum(phases.values()), 6)
+                c = it.payload.get("trace")
+                it.slot.set(Response(
+                    image=image,
+                    effective_backend=info["effective_backend"],
+                    backend=it.payload["backend"],
+                    request_id=it.payload["rid"],
+                    batch_size=info["batch_size"],
+                    phases=per,
+                    # Per-REQUEST provenance from admission time: an auto
+                    # and an explicit request can share this entry, so
+                    # the entry's build-time note cannot label them both.
+                    plan_source=it.payload.get(
+                        "plan_source", info.get("plan_source", "explicit")),
+                    predicted_gpx_per_chip=info.get(
+                        "predicted_gpx_per_chip"),
+                    effective_grid=info.get("effective_grid", ""),
+                    overlap=bool(info.get("overlap", False)),
+                    exchange_fraction=info.get("exchange_fraction", 0.0),
+                    exchange_hidden_fraction=info.get(
+                        "exchange_hidden_fraction", 0.0),
+                    trace_id=c.trace_id if c is not None else "",
+                    plan_key=info.get("plan_key", ""),
+                ))
+                self._bump("completed")
+                if obs_metrics.enabled():
+                    ph = obs_metrics.histogram(
+                        "pctpu_request_phase_seconds",
+                        "per-request serving latency by phase",
+                        ("phase", "backend"))
+                    eff = info["effective_backend"]
+                    for name, v in per.items():
+                        ph.observe(v, phase=name, backend=eff)
+                    obs_metrics.counter(
+                        "pctpu_admission_total",
+                        "typed request outcomes at the admission boundary",
+                        ("outcome",)).inc(outcome="completed")
         if obs_metrics.enabled():
             obs_metrics.histogram(
                 "pctpu_batch_size", "co-batched requests per flush", (),
@@ -427,6 +497,32 @@ class ConvolutionService:
                 quantize=bool(c.get("quantize", True)),
                 backend=c.get("backend", "shifted")))
         return self.engine.warmup(keys)
+
+    def readiness(self) -> tuple[bool, dict]:
+        """The ``/readyz`` verdict: can this service usefully take a NEW
+        request right now?
+
+        Not ready while a mesh reshape is in progress (submissions shed
+        ``resharding``) or while the queue is at its admission bound
+        (submissions shed ``queue_full``) — exactly the two states where
+        a replica router (ROADMAP item 2) should steer traffic
+        elsewhere.  A DEGRADED backend tier keeps readiness true (the
+        service is serving, on a lower tier) but is reported in the
+        payload so the router can prefer healthy replicas.
+        """
+        depth = self.batcher.depth()
+        bound = self.batcher.max_queue
+        degraded = self.engine.degraded()
+        ready = not self._reshaping and depth < bound
+        return ready, {
+            "ready": ready,
+            "reshaping": bool(self._reshaping),
+            "queue_depth": depth,
+            "queue_bound": bound,
+            "queue_full": depth >= bound,
+            "degraded": degraded,
+            "grid": "x".join(str(v) for v in self.engine.grid()),
+        }
 
     def snapshot(self) -> dict:
         with self._lock:

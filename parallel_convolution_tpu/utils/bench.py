@@ -314,6 +314,9 @@ def bench_iterate(
         # not the interior-first pipeline; the row says which.
         "overlap": bool(overlap),
         "plan_source": plan_source,
+        # The canonical tuning identity of the timed config — the
+        # drift-series label and perf_gate.py's history key.
+        "plan_key": w.key(),
         "predicted_gpx_per_chip": round(predicted, 3),
         "mesh": "x".join(str(s) for s in grid),
         "devices": n_dev,

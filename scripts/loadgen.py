@@ -105,10 +105,12 @@ def main() -> int:
     ap.add_argument("--out", default=None,
                     help="also write the summary row JSON to this path")
     ap.add_argument("--trace-out", default=None, metavar="JSONL",
-                    help="per-request JSONL trace (request_id, latency, "
-                         "phases, outcome) — tail-latency spikes become "
-                         "attributable to a specific request/phase instead "
-                         "of hiding inside the aggregate p99")
+                    help="per-request JSONL trace (request_id, server "
+                         "trace_id, latency, phases, outcome) — tail-"
+                         "latency spikes become attributable to a specific "
+                         "request/phase, and the trace_id joins each row "
+                         "to the server-side span tree "
+                         "(obs_report.py --client-trace)")
     # In-process service knobs (no-ops with --url):
     ap.add_argument("--mesh", default=None, help="RxC (in-process only)")
     ap.add_argument("--max-batch", type=int, default=8)
@@ -244,6 +246,11 @@ def main() -> int:
             for i, ts, lat, s, r in sorted(results):
                 line = {
                     "request_id": r.get("request_id") or f"lg{i}",
+                    # The SERVER-assigned trace id (round 13): joins this
+                    # client-side record to the server-side span tree in
+                    # the event log — obs_report.py --client-trace does
+                    # the merge offline.
+                    "trace_id": r.get("trace_id", ""),
                     "ts": round(ts, 6),
                     "latency_ms": round(1e3 * lat, 3),
                     "status": s,
@@ -300,6 +307,7 @@ def main() -> int:
     effective = sorted({r.get("effective_backend", "") for _, r in completed})
     grids = sorted({r.get("effective_grid", "") for _, r in completed})
     batch_sizes = [r.get("batch_size", 1) for _, r in completed]
+    plan_keys = sorted({r.get("plan_key", "") for _, r in completed} - {""})
 
     row = {
         "workload": (f"serve {args.filter_name} {args.rows}x{args.cols}"
@@ -312,6 +320,10 @@ def main() -> int:
         "effective_backend": (effective[0] if len(effective) == 1
                               else effective),
         "effective_grid": grids[0] if len(grids) == 1 else grids,
+        # The tuning identity of the served config (perf_gate.py's
+        # history key; a list only if mixed keys were somehow served).
+        "plan_key": (plan_keys[0] if len(plan_keys) == 1
+                     else (plan_keys or "")),
         "completed": len(completed),
         "rejected": rejected,
         "non_rejected_failures": non_rejected_failures,

@@ -163,6 +163,45 @@ def summarize_metrics(snap: dict) -> dict:
     return out
 
 
+def merge_client_trace(rows: list[dict], recs: list[dict]) -> dict:
+    """Join loadgen ``--trace-out`` client rows to server-side span trees
+    by the server-assigned ``trace_id`` (round 13).
+
+    The client knows wall latency as the user saw it; the server's
+    ``request`` root span knows where that time went.  The join reports
+    coverage (every client row should find its server trace) and the
+    mean client-minus-server delta — the transport/codec overhead
+    neither side can see alone.
+    """
+    from parallel_convolution_tpu.obs import trace as trace_lib
+
+    spans = trace_lib.span_records(recs)
+    root_dur: dict[str, float] = {}
+    traces: set[str] = set()
+    for s in spans:
+        tid = s.get("trace_id", "")
+        if tid:
+            traces.add(tid)
+            if s.get("name") == "request" and not s.get("parent_id"):
+                root_dur[tid] = float(s.get("dur_s", 0.0))
+    with_id = [r for r in rows if r.get("trace_id")]
+    joined = [r for r in with_id if r["trace_id"] in traces]
+    deltas = [r["latency_ms"] - 1e3 * root_dur[r["trace_id"]]
+              for r in joined
+              if r["trace_id"] in root_dur
+              and isinstance(r.get("latency_ms"), (int, float))]
+    return {
+        "client_rows": len(rows),
+        "with_trace_id": len(with_id),
+        "joined": len(joined),
+        "unjoined": len(with_id) - len(joined),
+        "server_only_traces": len(traces - {r["trace_id"]
+                                            for r in with_id}),
+        "mean_client_minus_server_ms": (
+            round(sum(deltas) / len(deltas), 3) if deltas else None),
+    }
+
+
 def summarize_events(recs: list[dict]) -> dict:
     kinds: dict[str, int] = {}
     invalid = 0
@@ -218,6 +257,13 @@ def _print_human(report: dict) -> None:
               f"quarantines={tot['quarantines']} "
               f"faults={tot['faults_fired']} compiles={tot['compiles']} "
               f"admission={tot['admission']}")
+    cj = report.get("client_join")
+    if cj:
+        print(f"client join: {cj['joined']}/{cj['with_trace_id']} rows "
+              f"matched server traces ({cj['unjoined']} unjoined, "
+              f"{cj['server_only_traces']} server-only), "
+              f"client-server delta "
+              f"{cj['mean_client_minus_server_ms']}ms")
     for key, d in report.get("drift", {}).items():
         print(f"drift {key}: predicted={d['predicted_gpx_per_chip']} "
               f"measured={d['measured_gpx_per_chip']} "
@@ -230,6 +276,9 @@ def main() -> int:
                     help="JSONL event log (rotated generations included)")
     ap.add_argument("--metrics", default=None,
                     help="metrics snapshot JSON (obs.metrics.dump)")
+    ap.add_argument("--client-trace", default=None, metavar="JSONL",
+                    help="loadgen --trace-out rows; joined to the server "
+                         "span trees by trace_id (needs --events)")
     ap.add_argument("--out", default=None,
                     help="also write the JSON report to this path")
     ap.add_argument("--quiet", action="store_true",
@@ -258,6 +307,19 @@ def main() -> int:
             print(f"obs_report: {report['events']['seq_gaps']} seq gaps "
                   "(lost event lines)", file=sys.stderr)
             rc = 1
+        if args.client_trace:
+            try:
+                rows = [json.loads(line) for line in Path(
+                    args.client_trace).read_text().splitlines()
+                    if line.strip()]
+            except (OSError, ValueError) as e:
+                print(f"obs_report: unreadable client trace: {e}",
+                      file=sys.stderr)
+                return 1
+            report["client_join"] = merge_client_trace(rows, recs)
+    elif args.client_trace:
+        print("obs_report: --client-trace needs --events", file=sys.stderr)
+        return 2
     if args.metrics:
         try:
             snap = json.loads(Path(args.metrics).read_text())

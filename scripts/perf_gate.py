@@ -1,0 +1,290 @@
+#!/usr/bin/env python
+"""Perf-regression sentry: gate new bench/loadgen rows against history.
+
+Until round 13 the bench trajectory was write-only: rows landed in
+evidence files and nothing ever JUDGED a new number against the old
+ones.  This script is the gate:
+
+* **History** — ``evidence/perf_history.jsonl`` (committed), one line
+  per accepted measurement, keyed by ``plan_key + backend + grid`` (the
+  same tuning identity the plan cache and the drift series use; rows
+  without a plan_key fall back to their workload string).
+* **Baseline** — the median of the last ``--window`` history entries
+  for the row's key.  A key with fewer than ``--min-samples`` entries is
+  SEEDED (recorded, gate passes): a fresh machine/config cannot regress
+  against nothing.
+* **Noise-aware threshold** — a row regresses when its throughput falls
+  below ``baseline * (1 - t)`` with ``t = clamp(max(--threshold,
+  --noise-mult * rel_stdev), ..., 0.9)``: the floor absorbs run-to-run
+  jitter on quiet keys, the stdev term widens the gate automatically on
+  keys whose history is itself noisy (CPU CI boxes), and improvements
+  are reported but never fail.
+* **Plan drift** (ROADMAP 5a's series, recorded since r11 but never
+  judged) — ``--drift-metrics snapshot.json`` reads the
+  ``pctpu_plan_drift_ratio`` gauge (measured/predicted Gpx/s per plan
+  key) and flags any ratio outside ``[1/bound, bound]``
+  (``--drift-bound``): a model that mispredicts by that much needs
+  recalibration before its rankings can be trusted.
+
+Exit status: 0 = every row within its gate (or seeded) and no drift
+flags; 1 = at least one regression or drift flag; 2 = usage error.
+
+  # seed, then gate (the trace-smoke leg does exactly this)
+  python scripts/perf_gate.py --history evidence/perf_history.jsonl \\
+      --row evidence/serving_smoke.json --update
+  python scripts/perf_gate.py --history evidence/perf_history.jsonl \\
+      --row evidence/serving_smoke.json
+
+Rows are the established bench/loadgen schema: any JSON object (or
+JSONL / list of objects) with ``gpixels_per_s`` and the key fields.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+import _path  # noqa: F401  (repo root on sys.path)
+
+
+def row_key(row: dict) -> str:
+    """``plan_key|backend|grid`` — the history identity of one row.
+
+    ``plan_key`` (stamped by bench_iterate and serving responses since
+    r13) is the canonical tuning identity; rows that predate it key on
+    their workload string.  Backend prefers the EFFECTIVE backend (a
+    degraded tier must never be compared against the requested tier's
+    baseline); grid prefers the mesh/effective_grid stamp.
+    """
+    plan = row.get("plan_key") or row.get("workload") or ""
+    if isinstance(plan, (list, tuple)):
+        plan = plan[0] if plan else ""
+    b = row.get("effective_backend") or row.get("backend") or ""
+    if isinstance(b, (list, tuple)):
+        b = "+".join(str(x) for x in b)
+    grid = (row.get("mesh") or row.get("effective_grid")
+            or row.get("grid") or "")
+    if isinstance(grid, (list, tuple)):
+        grid = grid[0] if grid else ""
+    return f"{plan}|{b}|{grid}"
+
+
+def row_metric(row: dict) -> float | None:
+    """Throughput, higher-is-better (None = row carries no gateable
+    number, e.g. a zero-completion loadgen run)."""
+    v = row.get("gpixels_per_s")
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        return None
+    return v if v > 0 else None
+
+
+def load_rows(paths: list[str]) -> list[dict]:
+    """Each file: a JSON object, a JSON list of objects, or JSONL."""
+    rows: list[dict] = []
+    for p in paths:
+        text = Path(p).read_text().strip()
+        if not text:
+            continue
+        try:
+            data = json.loads(text)
+            data = data if isinstance(data, list) else [data]
+        except ValueError:
+            data = [json.loads(line) for line in text.splitlines()
+                    if line.strip()]
+        for d in data:
+            if isinstance(d, dict):
+                d = dict(d)
+                d["_src"] = p
+                rows.append(d)
+    return rows
+
+
+def load_history(path: Path) -> list[dict]:
+    if not path.exists():
+        return []
+    out = []
+    for n, line in enumerate(path.read_text().splitlines(), 1):
+        if not line.strip():
+            continue
+        try:
+            out.append(json.loads(line))
+        except ValueError:
+            # A torn tail must not brick the gate forever — skip with a
+            # visible note; --update rewrites clean lines only.
+            print(f"perf_gate: skipping unparseable history line "
+                  f"{path}:{n}", file=sys.stderr)
+    return out
+
+
+def evaluate(row: dict, history: list[dict], *, window: int,
+             min_samples: int, threshold: float,
+             noise_mult: float) -> dict:
+    """One row's verdict against its key's rolling baseline."""
+    key = row_key(row)
+    gpx = row_metric(row)
+    verdict = {"key": key, "gpixels_per_s": gpx, "src": row.get("_src", "")}
+    if gpx is None:
+        verdict.update(status="skipped",
+                       note="row carries no positive gpixels_per_s")
+        return verdict
+    hist = [float(h["gpixels_per_s"]) for h in history
+            if h.get("key") == key
+            and isinstance(h.get("gpixels_per_s"), (int, float))
+            and h["gpixels_per_s"] > 0][-window:]
+    if len(hist) < min_samples:
+        verdict.update(status="seeded", samples=len(hist),
+                       note=f"fewer than {min_samples} history samples")
+        return verdict
+    base = statistics.median(hist)
+    rel_sd = (statistics.stdev(hist) / base
+              if len(hist) >= 3 and base > 0 else 0.0)
+    t = min(0.9, max(threshold, noise_mult * rel_sd))
+    ratio = gpx / base if base > 0 else None
+    verdict.update(samples=len(hist), baseline=round(base, 6),
+                   rel_stdev=round(rel_sd, 4), threshold=round(t, 4),
+                   ratio=round(ratio, 4) if ratio is not None else None)
+    if gpx < base * (1 - t):
+        verdict["status"] = "regression"
+    elif gpx > base * (1 + t):
+        verdict["status"] = "improved"
+    else:
+        verdict["status"] = "ok"
+    return verdict
+
+
+def drift_flags(snapshot: dict, bound: float) -> list[dict]:
+    """pctpu_plan_drift_ratio series outside [1/bound, bound]."""
+    out = []
+    for m in snapshot.get("metrics", []):
+        if m.get("name") != "pctpu_plan_drift_ratio":
+            continue
+        for s in m.get("series", []):
+            try:
+                r = float(s["value"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            if r <= 0 or r > bound or r < 1.0 / bound:
+                out.append({"key": s.get("labels", {}).get("key", ""),
+                            "backend": s.get("labels", {}).get(
+                                "backend", ""),
+                            "drift_ratio": round(r, 4),
+                            "bound": bound})
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--history", required=True,
+                    help="the committed JSONL history "
+                         "(evidence/perf_history.jsonl)")
+    ap.add_argument("--row", action="append", default=[], metavar="JSON",
+                    help="bench/loadgen row file to gate (repeatable; "
+                         "JSON object, list, or JSONL)")
+    ap.add_argument("--update", action="store_true",
+                    help="append gated rows to the history AFTER "
+                         "evaluation (so a rerun of the same row "
+                         "compares against it)")
+    ap.add_argument("--window", type=int, default=8,
+                    help="rolling baseline size per key")
+    ap.add_argument("--min-samples", type=int, default=1,
+                    help="history samples required before gating "
+                         "(fewer = seed and pass)")
+    ap.add_argument("--threshold", type=float, default=0.3,
+                    help="regression floor: fail below "
+                         "baseline*(1-threshold)")
+    ap.add_argument("--noise-mult", type=float, default=3.0,
+                    help="threshold widens to this multiple of the "
+                         "history's relative stdev when larger")
+    ap.add_argument("--drift-metrics", default=None, metavar="SNAP_JSON",
+                    help="metrics snapshot (obs.metrics.dump) to check "
+                         "plan-drift ratios from the 5a series")
+    ap.add_argument("--drift-bound", type=float, default=10.0,
+                    help="flag drift ratios outside [1/bound, bound]")
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON report to this path")
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args()
+    if not args.row and not args.drift_metrics:
+        print("need --row and/or --drift-metrics", file=sys.stderr)
+        return 2
+
+    hist_path = Path(args.history)
+    history = load_history(hist_path)
+    try:
+        rows = load_rows(args.row)
+    except (OSError, ValueError) as e:
+        print(f"perf_gate: unreadable row file: {e}", file=sys.stderr)
+        return 2
+
+    verdicts = [evaluate(r, history,
+                         window=args.window, min_samples=args.min_samples,
+                         threshold=args.threshold,
+                         noise_mult=args.noise_mult)
+                for r in rows]
+
+    flags = []
+    if args.drift_metrics:
+        try:
+            snap = json.loads(Path(args.drift_metrics).read_text())
+        except (OSError, ValueError) as e:
+            print(f"perf_gate: unreadable metrics snapshot: {e}",
+                  file=sys.stderr)
+            return 2
+        flags = drift_flags(snap, args.drift_bound)
+
+    regressions = [v for v in verdicts if v["status"] == "regression"]
+    if args.update:
+        # Append-only, one line per gated row — regressions too: a real
+        # slowdown becomes the new reality after it ships; the gate's
+        # job is to make it LOUD once, not to pin the baseline forever.
+        hist_path.parent.mkdir(parents=True, exist_ok=True)
+        with open(hist_path, "a") as f:
+            for r, v in zip(rows, verdicts):
+                if v["status"] == "skipped":
+                    continue
+                f.write(json.dumps({
+                    "key": v["key"],
+                    "gpixels_per_s": v["gpixels_per_s"],
+                    "p95_ms": r.get("p95_ms"),
+                    "status": v["status"],
+                    "ts": round(time.time(), 3),
+                    "src": v["src"],
+                }) + "\n")
+
+    report = {
+        "rows": len(rows),
+        "history_lines": len(history),
+        "verdicts": verdicts,
+        "regressions": len(regressions),
+        "drift_flags": flags,
+        "updated": bool(args.update),
+    }
+    if not args.quiet:
+        for v in verdicts:
+            line = (f"{v['status']:10s} {v['key']}  "
+                    f"gpx={v['gpixels_per_s']}")
+            if "baseline" in v:
+                line += (f"  baseline={v['baseline']} "
+                         f"ratio={v['ratio']} thr={v['threshold']}")
+            print(line)
+        for fl in flags:
+            print(f"drift      {fl['key']}|{fl['backend']}  "
+                  f"ratio={fl['drift_ratio']} outside "
+                  f"[1/{fl['bound']}, {fl['bound']}]")
+    if args.out:
+        p = Path(args.out)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(json.dumps(report, indent=2))
+    else:
+        print(json.dumps(report))
+    return 1 if regressions or flags else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

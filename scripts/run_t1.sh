@@ -28,6 +28,21 @@
 #                                      Row (failures: 0) lands in
 #                                      evidence/obs_smoke.json (the
 #                                      supervisor leg's done_file).
+#   scripts/run_t1.sh --trace-smoke    tracing + perf sentry end-to-end on
+#                                      the 2x4 CPU mesh: serve 50 traced
+#                                      in-process requests, assert every
+#                                      response carries a trace_id, the
+#                                      span trees reconstruct complete
+#                                      (one root, zero orphans, batch
+#                                      spans linking all co-batched
+#                                      requests), the client/server
+#                                      trace join covers every request,
+#                                      and perf_gate.py passes against a
+#                                      freshly seeded history while
+#                                      flagging a synthetic 2x-slower
+#                                      row.  Row (failures: 0) lands in
+#                                      evidence/trace_smoke.json (the
+#                                      supervisor leg's done_file).
 #   scripts/run_t1.sh --overlap-smoke  overlapped-halo A/B on the 2x4 CPU
 #                                      mesh: rdma overlap on/off per fuse
 #                                      level, oracle byte-checks on every
@@ -57,6 +72,14 @@ if [ "${1:-}" = "--obs-smoke" ]; then
     PCTPU_OBS=1 \
     python scripts/obs_smoke.py --n 24 --rows 48 --cols 64 --iters 2 \
       --mesh 2x4 --out evidence/obs_smoke.json
+fi
+
+if [ "${1:-}" = "--trace-smoke" ]; then
+  exec timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    PCTPU_OBS=1 \
+    python scripts/trace_smoke.py --n 50 --rows 48 --cols 64 --iters 2 \
+      --mesh 2x4 --out evidence/trace_smoke.json
 fi
 
 if [ "${1:-}" = "--overlap-smoke" ]; then

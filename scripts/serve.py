@@ -11,7 +11,9 @@ is this script, nothing else.
     python scripts/serve.py --port 8080 --mesh 2x4 \\
       --warm '{"rows": 48, "cols": 64, "filter": "blur3", "iters": 2}'
 
-  curl -s localhost:8080/healthz | python -m json.tool
+  curl -s localhost:8080/healthz | python -m json.tool   # liveness
+  curl -s localhost:8080/readyz  | python -m json.tool   # readiness:
+  #   503 during reshape / queue-full; degrade tier in the payload
   python scripts/loadgen.py --url http://127.0.0.1:8080 --n 100 ...
 
 ``PCTPU_FAULTS`` is honored (resilience.faults), so injected-fault

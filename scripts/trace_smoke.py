@@ -1,0 +1,283 @@
+#!/usr/bin/env python
+"""Tracing + perf-sentry smoke: the ``run_t1.sh --trace-smoke`` leg.
+
+Serve N traced requests through the in-process client on the CPU mesh
+with obs ON, then assert the whole round-13 layer held together:
+
+1. every response carries a server-assigned ``trace_id``, and a request
+   sent WITH a ``traceparent`` adopts the caller's trace id (context
+   propagation);
+2. ``/readyz`` (socket-free twin) reports ready on the idle service;
+3. ``scripts/trace_report.py`` reconstructs COMPLETE span trees —
+   exactly one root per trace, zero orphan spans — and the union of
+   batch-span links covers every completed request's trace; the Chrome
+   ``trace_event`` export parses as JSON;
+4. ``scripts/obs_report.py --client-trace`` joins every client-side row
+   to its server-side trace;
+5. ``scripts/perf_gate.py``: seeding a FRESH history with this run's
+   measured row passes, re-gating the same row against the seeded
+   history passes (within noise), and a synthetic 2x-slower row exits
+   NONZERO — the sentry demonstrably bites.
+
+One summary row lands in ``--out`` (``evidence/trace_smoke.json``, the
+supervisor leg's done_file) with ``"failures": 0`` iff every gate held.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import _path  # noqa: F401  (repo root + JAX_PLATFORMS re-apply)
+
+SCRIPTS = Path(__file__).resolve().parent
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n", type=int, default=50, help="requests to push")
+    ap.add_argument("--concurrency", type=int, default=4)
+    ap.add_argument("--rows", type=int, default=48)
+    ap.add_argument("--cols", type=int, default=64)
+    ap.add_argument("--iters", type=int, default=2)
+    ap.add_argument("--mesh", default="2x4")
+    ap.add_argument("--events", default="evidence/trace_events.jsonl")
+    ap.add_argument("--client-out", default="evidence/trace_client.jsonl")
+    ap.add_argument("--report-out", default="evidence/trace_report.json")
+    ap.add_argument("--chrome-out", default="evidence/trace_chrome.json")
+    ap.add_argument("--metrics-out", default="evidence/trace_metrics.json")
+    ap.add_argument("--history", default="evidence/trace_smoke_history.jsonl",
+                    help="the smoke's OWN history file, seeded FRESH each "
+                         "run (hermetic gate).  Deliberately NOT "
+                         "evidence/perf_history.jsonl — that one is the "
+                         "committed append-only baseline real sessions "
+                         "accumulate into; a smoke must never truncate it")
+    ap.add_argument("--out", default="evidence/trace_smoke.json")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from parallel_convolution_tpu.obs import (
+        events as obs_events, metrics, trace as trace_lib,
+    )
+    from parallel_convolution_tpu.utils import imageio
+
+    if not metrics.enabled():
+        metrics.set_enabled(True)  # the smoke TESTS obs: force it on
+    ev_path = Path(args.events)
+    ev_path.parent.mkdir(parents=True, exist_ok=True)
+    for gen in ("", ".1", ".2"):
+        p = ev_path.with_name(ev_path.name + gen)
+        if p.exists():
+            p.unlink()  # a fresh timeline per smoke run
+    obs_events.configure(ev_path)
+
+    from parallel_convolution_tpu.parallel.mesh import mesh_from_spec
+    from parallel_convolution_tpu.serving.frontend import InProcessClient
+    from parallel_convolution_tpu.serving.service import ConvolutionService
+
+    failures: list[str] = []
+    service = ConvolutionService(mesh_from_spec(args.mesh), max_batch=8,
+                                 max_delay_s=0.005, max_queue=256)
+    client = InProcessClient(service)
+
+    img = imageio.generate_test_image(args.rows, args.cols, "grey", seed=0)
+    body = {
+        "image_b64": base64.b64encode(
+            np.ascontiguousarray(img).tobytes()).decode("ascii"),
+        "rows": args.rows, "cols": args.cols, "mode": "grey",
+        "filter": "blur3", "iters": args.iters, "backend": "shifted",
+    }
+
+    # Gate 2 first (idle service): the readiness twin says ready.
+    status, ready = client.readyz()
+    if status != 200 or not ready.get("ok"):
+        failures.append(f"/readyz not ready on idle service: {ready}")
+
+    # One request WITH an upstream traceparent: propagation proof.
+    upstream = trace_lib.SpanContext(trace_lib.new_trace_id(),
+                                     trace_lib.new_span_id())
+    s0, r0 = client.request(
+        dict(body, request_id="tp0",
+             traceparent=trace_lib.format_traceparent(upstream)),
+        timeout=120)
+    if s0 != 200 or r0.get("trace_id") != upstream.trace_id:
+        failures.append(
+            f"traceparent not adopted: status {s0}, "
+            f"trace_id {r0.get('trace_id')!r} != {upstream.trace_id!r}")
+
+    results: list[tuple[int, float, int, dict]] = []
+    lock = threading.Lock()
+    counter = iter(range(args.n))
+
+    def worker():
+        while True:
+            with lock:
+                i = next(counter, None)
+            if i is None:
+                return
+            t0 = time.perf_counter()
+            s, r = client.request(dict(body, request_id=f"tr{i}"),
+                                  timeout=120)
+            lat = time.perf_counter() - t0
+            with lock:
+                results.append((i, lat, s, r))
+
+    t_start = time.perf_counter()
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(max(1, args.concurrency))]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    wall = time.perf_counter() - t_start
+
+    completed = [(i, lat, r) for i, lat, s, r in results
+                 if s == 200 and r.get("ok")]
+    if len(completed) != args.n:
+        failures.append(f"only {len(completed)}/{args.n} completed")
+    missing_tid = [i for i, _, r in completed if not r.get("trace_id")]
+    if missing_tid:
+        failures.append(
+            f"{len(missing_tid)} responses without a trace_id")
+
+    # Client-side rows (the loadgen --trace-out schema) for the join.
+    cp = Path(args.client_out)
+    cp.parent.mkdir(parents=True, exist_ok=True)
+    with open(cp, "w") as f:
+        for i, lat, s, r in sorted(results):
+            f.write(json.dumps({
+                "request_id": r.get("request_id") or f"tr{i}",
+                "trace_id": r.get("trace_id", ""),
+                "ts": 0.0, "latency_ms": round(1e3 * lat, 3),
+                "status": s, "ok": bool(r.get("ok")),
+            }) + "\n")
+
+    service.close()
+    metrics.dump(args.metrics_out)
+
+    # Gate 3: trace_report reconstructs complete trees.
+    report_ok = False
+    rc = subprocess.run(
+        [sys.executable, str(SCRIPTS / "trace_report.py"),
+         "--events", str(ev_path), "--out", args.report_out,
+         "--chrome", args.chrome_out, "--quiet"],
+        capture_output=True, text=True)
+    if rc.returncode != 0:
+        failures.append(f"trace_report.py exited {rc.returncode}: "
+                        f"{(rc.stderr or '').strip()[:300]}")
+    else:
+        rep = json.loads(Path(args.report_out).read_text())
+        if rep["orphan_spans"] or not rep["roots_per_trace_ok"]:
+            failures.append(
+                f"span trees incomplete: {rep['orphan_spans']} orphans, "
+                f"multi_root={rep['multi_root_traces']}")
+        else:
+            linked = set()
+            for b in rep["batches"]:
+                linked.update(b["linked_traces"])
+            resp_tids = {r["trace_id"] for _, _, r in completed
+                         if r.get("trace_id")}
+            if not resp_tids <= linked:
+                failures.append(
+                    f"{len(resp_tids - linked)} completed traces not "
+                    "linked by any batch span")
+            else:
+                report_ok = True
+        try:
+            json.loads(Path(args.chrome_out).read_text())["traceEvents"]
+        except Exception as e:  # noqa: BLE001
+            failures.append(f"chrome export unreadable: {e!r}")
+
+    # Gate 4: the client/server join covers every completed request.
+    join_ok = False
+    jr = subprocess.run(
+        [sys.executable, str(SCRIPTS / "obs_report.py"),
+         "--events", str(ev_path), "--client-trace", str(cp),
+         "--quiet"],
+        capture_output=True, text=True)
+    if jr.returncode != 0:
+        failures.append(f"obs_report.py --client-trace exited "
+                        f"{jr.returncode}")
+    else:
+        cj = json.loads(jr.stdout.strip().splitlines()[-1]).get(
+            "client_join", {})
+        if cj.get("joined", 0) < len(completed):
+            failures.append(f"client/server join incomplete: {cj}")
+        else:
+            join_ok = True
+
+    # Gate 5: the perf sentry — seed fresh, re-gate, and prove it bites.
+    gate_ok = False
+    hist = Path(args.history)
+    if hist.exists():
+        hist.unlink()  # hermetic: fresh seed per smoke run
+    channels = 1
+    px = args.rows * args.cols * channels * args.iters * len(completed)
+    row = {
+        "workload": (f"serve blur3 {args.rows}x{args.cols}x{channels} "
+                     f"{args.iters} iters"),
+        "backend": "shifted",
+        "effective_backend": "shifted",
+        "plan_key": next((r.get("plan_key", "")
+                          for _, _, r in completed), ""),
+        "mesh": args.mesh,
+        "completed": len(completed),
+        "gpixels_per_s": round(px / wall / 1e9, 6) if wall else 0.0,
+    }
+    row_path = Path("evidence/trace_smoke_row.json")
+    row_path.write_text(json.dumps(row, indent=2))
+    slow = dict(row, gpixels_per_s=row["gpixels_per_s"] / 2)
+    slow_path = Path("evidence/trace_smoke_row_slow.json")
+    slow_path.write_text(json.dumps(slow))
+
+    def gate(*extra):
+        return subprocess.run(
+            [sys.executable, str(SCRIPTS / "perf_gate.py"),
+             "--history", str(hist), "--quiet", *extra],
+            capture_output=True, text=True).returncode
+
+    rc_seed = gate("--row", str(row_path), "--update")
+    rc_pass = gate("--row", str(row_path))
+    rc_slow = gate("--row", str(slow_path))
+    slow_path.unlink()
+    if rc_seed != 0:
+        failures.append(f"perf_gate seed run exited {rc_seed}")
+    elif rc_pass != 0:
+        failures.append(f"perf_gate within-noise rerun exited {rc_pass}")
+    elif rc_slow == 0:
+        failures.append("perf_gate did NOT flag the synthetic 2x-slower "
+                        "row")
+    else:
+        gate_ok = True
+
+    summary = {
+        "workload": (f"trace smoke blur3 {args.rows}x{args.cols} "
+                     f"{args.iters} iters, {args.n} in-process requests"),
+        "mesh": args.mesh,
+        "completed": len(completed),
+        "wall_s": round(wall, 3),
+        "gpixels_per_s": row["gpixels_per_s"],
+        "traceparent_propagated": s0 == 200
+        and r0.get("trace_id") == upstream.trace_id,
+        "report_ok": report_ok,
+        "join_ok": join_ok,
+        "perf_gate_ok": gate_ok,
+        "failures": len(failures),
+        **({"failure_sample": failures[:5]} if failures else {}),
+    }
+    out = Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(summary, indent=2))
+    print(json.dumps(summary), flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -201,6 +201,83 @@ def test_event_log_survives_external_rotation(tmp_path):
     assert all(r["pid"] == os.getpid() for r in both)
 
 
+def test_rotation_survives_sibling_stealing_live(tmp_path):
+    """The writer's OWN rotation racing a sibling's: the live file can
+    vanish between our size check and our ``os.replace`` (both processes
+    rotate the same path).  Pre-round-13 this raised FileNotFoundError
+    out of ``emit`` and LOST the line; now a vanished source degrades to
+    'already moved' and the write proceeds in a fresh generation."""
+    import os
+
+    log = events.EventLog(tmp_path / "ev.jsonl", max_bytes=4096, keep=2)
+    log.emit("retry", attempt=1)
+    # The sibling wins the race: live is renamed away while we hold an
+    # open fd and believe the file still exists.
+    os.replace(log.path, tmp_path / "stolen.jsonl")
+    with log._lock:
+        log._rotate_locked()         # must not raise
+    log.emit("retry", attempt=2)     # and the stream continues
+    recs = events.read_events(log.path)
+    assert [r["attempt"] for r in recs] == [2]
+    stolen = events.read_events(tmp_path / "stolen.jsonl",
+                                include_rotated=False)
+    assert [r["attempt"] for r in stolen] == [1]   # nothing lost
+
+
+def test_event_log_multithread_rotation_stress(tmp_path):
+    """N writer threads across MANY forced rotations, with an external
+    actor stealing the live file mid-stream (a sibling process's
+    rotation): no writer may crash, no line may be lost, and each pid's
+    seq stream must stay contiguous across every file the lines landed
+    in."""
+    import os
+
+    log = events.EventLog(tmp_path / "ev.jsonl", max_bytes=4096, keep=50)
+    n_threads, n_lines = 6, 200
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def mover():
+        k = 0
+        while not stop.is_set():
+            try:
+                os.replace(log.path, tmp_path / f"moved.{k}.stolen")
+                k += 1
+            except OSError:
+                pass
+            time.sleep(0.0002)
+
+    def writer(w):
+        try:
+            for i in range(n_lines):
+                log.emit("retry", attempt=i, w=w, pad="x" * 120)
+        except BaseException as e:  # noqa: BLE001 — the assertion target
+            errors.append(e)
+
+    mv = threading.Thread(target=mover, daemon=True)
+    mv.start()
+    threads = [threading.Thread(target=writer, args=(w,))
+               for w in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    mv.join()
+    assert errors == []
+    recs = []
+    for p in sorted(tmp_path.iterdir()):
+        for n, line in enumerate(p.read_text().splitlines(), 1):
+            if line.strip():
+                recs.append(json.loads(line))
+    assert all(events.validate_event(r) == [] for r in recs)
+    # Zero lost lines, zero duplicates, per-pid seq contiguous: the
+    # stitched multiset of seqs across EVERY generation + stolen file is
+    # exactly 1..total.
+    seqs = sorted(r["seq"] for r in recs)
+    assert seqs == list(range(1, n_threads * n_lines + 1))
+
+
 # ----------------------------------------------------------- exposition
 def test_exposition_round_trip():
     c = metrics.counter("rt_total", "help text", ("name",))

@@ -530,10 +530,12 @@ def test_inprocess_client_roundtrip_and_rejection_codec():
     svc.close()
 
 
-def test_loadgen_inprocess_emits_schema_valid_row():
+def test_loadgen_inprocess_emits_schema_valid_row(tmp_path):
     """The acceptance row: scripts/loadgen.py against the CPU-mesh service
     emits p50/p95/p99 + phase breakdown + effective_backend, oracle-checked,
-    with zero non-rejected failures (exit 0)."""
+    with zero non-rejected failures (exit 0) — and (round 13) every
+    ``--trace-out`` per-request row carries the SERVER-assigned trace_id
+    so client- and server-side records of one request join offline."""
     import json
     import subprocess
     import sys
@@ -542,26 +544,35 @@ def test_loadgen_inprocess_emits_schema_valid_row():
     from parallel_convolution_tpu.utils.platform import child_env_cpu
 
     script = Path(__file__).resolve().parents[1] / "scripts" / "loadgen.py"
+    trace_out = tmp_path / "lg_trace.jsonl"
+    env = child_env_cpu(8)
+    env["PCTPU_OBS"] = "1"
     p = subprocess.run(
         [sys.executable, str(script), "--in-process", "--n", "8",
          "--concurrency", "2", "--rows", "24", "--cols", "36",
-         "--iters", "2", "--mesh", "2x2", "--check"],
-        capture_output=True, text=True, timeout=300, env=child_env_cpu(8))
+         "--iters", "2", "--mesh", "2x2", "--check",
+         "--trace-out", str(trace_out)],
+        capture_output=True, text=True, timeout=300, env=env)
     assert p.returncode == 0, p.stdout + p.stderr
     row = json.loads(p.stdout.strip().splitlines()[-1])
     for field in ("workload", "backend", "effective_backend", "completed",
                   "rejected", "non_rejected_failures", "wall_s", "p50_ms",
                   "p95_ms", "p99_ms", "gpixels_per_s", "phases_ms",
-                  "platform", "mesh"):
+                  "platform", "mesh", "plan_key"):
         assert field in row, f"missing {field!r} in {sorted(row)}"
     assert row["completed"] == 8
     assert row["non_rejected_failures"] == 0
     assert row["oracle_mismatches"] == 0
     assert row["effective_backend"] == "shifted"
     assert row["platform"] == "cpu" and row["mesh"] == "2x2"
+    assert row["plan_key"]            # perf_gate.py's history key
     assert set(row["phases_ms"]) == {"queue", "compile", "device",
                                      "copy_in", "copy_out"}
     assert row["p50_ms"] <= row["p95_ms"] <= row["p99_ms"]
+    lines = [json.loads(l) for l in trace_out.read_text().splitlines()]
+    assert len(lines) == 8
+    assert all(ln["trace_id"] for ln in lines)
+    assert len({ln["trace_id"] for ln in lines}) == 8   # per-request ids
 
 
 def test_http_frontend_over_loopback():

@@ -1,0 +1,475 @@
+"""Round 13: causal tracing, critical-path attribution, perf sentry.
+
+Covers the acceptance surface of the tracing PR:
+
+* traceparent codec + context propagation (nesting, attach, links,
+  disabled-mode no-op with the metrics-style perf guard);
+* the end-to-end traced request: a connected span tree with exactly one
+  root (frontend → admission → queue → batch → compile|device →
+  exchange/compute), batch spans linking every co-batched request, and
+  single-flight waiters linking the leader's compile_build span;
+* ``/readyz`` readiness semantics (reshaping, queue bound, degrade tier);
+* ``scripts/perf_gate.py``: seeded pass, within-noise pass, synthetic
+  2x-slower regression, drift-bound flagging.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from parallel_convolution_tpu.obs import events, metrics, trace
+from parallel_convolution_tpu.parallel import mesh as mesh_lib
+from parallel_convolution_tpu.serving.frontend import InProcessClient
+from parallel_convolution_tpu.serving.service import ConvolutionService
+
+SCRIPTS = Path(__file__).resolve().parent.parent / "scripts"
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    was_enabled = metrics.enabled()
+    metrics.set_enabled(True)
+    metrics.reset()
+    events.deconfigure()
+    yield
+    events.deconfigure()
+    metrics.reset()
+    metrics.set_enabled(was_enabled)
+
+
+def _mesh(shape=(2, 2)):
+    return mesh_lib.make_grid_mesh(jax.devices()[: shape[0] * shape[1]],
+                                   shape)
+
+
+def _body(rows=24, cols=36, iters=2, **kw):
+    from parallel_convolution_tpu.utils import imageio
+
+    img = imageio.generate_test_image(rows, cols, "grey", seed=1)
+    return {
+        "image_b64": base64.b64encode(
+            np.ascontiguousarray(img).tobytes()).decode("ascii"),
+        "rows": rows, "cols": cols, "mode": "grey", "filter": "blur3",
+        "iters": iters, "backend": "shifted", **kw,
+    }
+
+
+# ------------------------------------------------------ traceparent codec
+def test_traceparent_round_trip():
+    ctx = trace.SpanContext(trace.new_trace_id(), trace.new_span_id())
+    assert trace.parse_traceparent(trace.format_traceparent(ctx)) == ctx
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "00-abc", 42,
+    "00-" + "g" * 32 + "-" + "1" * 16 + "-01",      # non-hex trace
+    "00-" + "0" * 32 + "-" + "1" * 16 + "-01",      # all-zero trace
+    "00-" + "1" * 32 + "-" + "0" * 16 + "-01",      # all-zero span
+    "00-" + "1" * 31 + "-" + "1" * 16 + "-01",      # short trace
+    "00-" + "1" * 32 + "-" + "1" * 16,              # missing flags
+])
+def test_traceparent_malformed_degrades_to_none(bad):
+    assert trace.parse_traceparent(bad) is None
+
+
+# --------------------------------------------------- span context basics
+def test_span_nesting_and_record_shape(tmp_path):
+    events.configure(tmp_path / "ev.jsonl")
+    with trace.span("outer", who="t") as a:
+        assert trace.current() == a.context
+        with trace.span("inner") as b:
+            assert b.context.trace_id == a.context.trace_id
+            assert b.parent_id == a.context.span_id
+            b.link(a.context, kind="extra")
+        assert trace.current() == a.context
+    assert trace.current() is None
+    recs = events.read_events(tmp_path / "ev.jsonl")
+    assert all(events.validate_event(r) == [] for r in recs)
+    by_name = {r["name"]: r for r in recs}
+    assert by_name["inner"]["parent_id"] == a.context.span_id
+    assert by_name["outer"]["parent_id"] == ""
+    assert by_name["outer"]["attrs"] == {"who": "t"}
+    assert by_name["inner"]["links"] == [
+        {**a.context.ref, "kind": "extra"}]
+    # children are emitted before parents (end-order); reconstruction
+    # is order-independent.
+    trees = trace.build_trees(trace.span_records(recs))
+    t = trees[a.context.trace_id]
+    assert t["roots"] == [a.context.span_id] and not t["orphans"]
+
+
+def test_span_error_status_and_stack_balance(tmp_path):
+    events.configure(tmp_path / "ev.jsonl")
+    with pytest.raises(RuntimeError):
+        with trace.span("boom"):
+            raise RuntimeError("kaput")
+    assert trace.current() is None    # the context var unwound
+    (rec,) = trace.span_records(events.read_events(tmp_path / "ev.jsonl"))
+    assert rec["status"] == "error"
+    assert "kaput" in rec["attrs"]["error"]
+
+
+def test_attach_and_emit_span(tmp_path):
+    events.configure(tmp_path / "ev.jsonl")
+    ctx = trace.SpanContext(trace.new_trace_id(), trace.new_span_id())
+    with trace.attach(ctx):
+        assert trace.current() == ctx
+        sid = trace.emit_span("synthetic", trace_id=ctx.trace_id,
+                              parent_id=ctx.span_id, start_ts=123.0,
+                              dur_s=0.5, detail="x")
+    assert trace.current() is None
+    (rec,) = trace.span_records(events.read_events(tmp_path / "ev.jsonl"))
+    assert rec["span_id"] == sid and rec["parent_id"] == ctx.span_id
+    assert rec["start_ts"] == 123.0 and rec["dur_s"] == 0.5
+
+
+def test_disabled_mode_is_noop_and_near_zero_overhead(tmp_path):
+    """The PCTPU_OBS=0 perf guard (the r11 metrics test, for spans): a
+    disabled span() must be one load + one branch returning the shared
+    null span — no contextvars, no ids, no allocation per call beyond
+    the kwargs dict."""
+    events.configure(tmp_path / "ev.jsonl")
+    n = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with trace.span("on"):
+            pass
+    enabled_s = time.perf_counter() - t0
+    metrics.set_enabled(False)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with trace.span("off"):
+            pass
+    disabled_s = time.perf_counter() - t0
+    assert disabled_s < 0.2                      # < 10 µs/call, absolute
+    assert disabled_s < enabled_s * 0.5 + 0.01   # far below the on path
+    with trace.span("x") as sp:
+        assert sp is trace.NULL_SPAN
+        assert trace.current() is None
+        sp.set(a=1)
+        sp.link({"trace_id": "t", "span_id": "s"})
+    assert trace.emit_span("y", trace_id="t") is None
+    recs = events.read_events(tmp_path / "ev.jsonl")
+    assert [r["name"] for r in trace.span_records(recs)] == ["on"] * n
+
+
+# --------------------------------------------- end-to-end traced request
+def _traced_service(tmp_path, mesh=None, **kw):
+    events.configure(tmp_path / "ev.jsonl")
+    kw.setdefault("max_delay_s", 0.05)
+    svc = ConvolutionService(mesh or _mesh(), max_batch=4, **kw)
+    return svc, InProcessClient(svc)
+
+
+def test_traced_request_yields_connected_single_root_tree(tmp_path):
+    """THE acceptance tree: frontend → admission → queue → batch →
+    compile|device → exchange/compute, exactly one root per trace, batch
+    span linking every co-batched request."""
+    svc, client = _traced_service(tmp_path)
+    results = []
+
+    def go(i):
+        results.append(client.request(
+            dict(_body(), request_id=f"q{i}"), timeout=60))
+
+    threads = [threading.Thread(target=go, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    svc.close()
+    for s, r in results:
+        assert s == 200 and r["ok"], r.get("detail")
+        assert r["trace_id"]
+    spans = trace.span_records(events.read_events(tmp_path / "ev.jsonl"))
+    trees = trace.build_trees(spans)
+    resp_tids = {r["trace_id"] for _, r in results}
+    assert resp_tids <= set(trees)
+    for tid in resp_tids:
+        t = trees[tid]
+        assert len(t["roots"]) == 1, f"trace {tid} roots {t['roots']}"
+        assert t["orphans"] == []
+        root = t["spans"][t["roots"][0]]
+        assert root["name"] == "request"
+        kid_names = {t["spans"][k]["name"]
+                     for k in t["children"].get(root["span_id"], [])}
+        assert {"admission", "queue"} <= kid_names
+    # Batch spans: every completed request's trace is linked by a batch.
+    linked = set()
+    batch_owner_trees = []
+    for tid, t in trees.items():
+        for sid, r in t["spans"].items():
+            if r["name"] == "batch":
+                linked.update(l["trace_id"] for l in r.get("links", []))
+                batch_owner_trees.append((t, sid))
+    assert resp_tids <= linked
+    # The payer's tree owns compile/device, device owns the attribution
+    # leaves (obs on: record_step emitted exchange/compute).
+    t, bsid = batch_owner_trees[0]
+    batch_kids = {t["spans"][k]["name"]: t["spans"][k]
+                  for k in t["children"][bsid]}
+    assert {"compile", "copy_in", "device", "copy_out"} <= set(batch_kids)
+    dev_kids = {t["spans"][k]["name"]
+                for k in t["children"][batch_kids["device"]["span_id"]]}
+    assert {"exchange", "compute"} <= dev_kids
+
+
+def test_traceparent_adopts_upstream_trace(tmp_path):
+    svc, client = _traced_service(tmp_path)
+    up = trace.SpanContext(trace.new_trace_id(), trace.new_span_id())
+    s, r = client.request(
+        dict(_body(), request_id="tp1",
+             traceparent=trace.format_traceparent(up)), timeout=60)
+    svc.close()
+    assert s == 200 and r["ok"]
+    assert r["trace_id"] == up.trace_id
+    spans = trace.span_records(events.read_events(tmp_path / "ev.jsonl"))
+    trees = trace.build_trees(spans)
+    t = trees[up.trace_id]
+    # The request span parents to the REMOTE caller span, which is
+    # absent from this log: reconstruction roots it (remote_parent),
+    # never orphans it.
+    assert len(t["roots"]) == 1 and t["orphans"] == []
+    root = t["spans"][t["roots"][0]]
+    assert root["name"] == "request"
+    assert root["parent_id"] == up.span_id
+    assert root["attrs"].get("remote_parent") is True
+
+
+def test_rejection_carries_trace_id(tmp_path):
+    svc, client = _traced_service(tmp_path)
+    bad = dict(_body(), iters=0, request_id="bad0")   # invalid contract
+    s, r = client.request(bad, timeout=60)
+    svc.close()
+    assert s == 400 and r["rejected"] == "invalid"
+    assert r["trace_id"]
+    spans = trace.span_records(events.read_events(tmp_path / "ev.jsonl"))
+    trees = trace.build_trees(spans)
+    t = trees[r["trace_id"]]
+    assert len(t["roots"]) == 1 and t["orphans"] == []
+    names = {sp["name"] for sp in t["spans"].values()}
+    assert names == {"request", "admission"}   # shed before the queue
+    adm = next(sp for sp in t["spans"].values()
+               if sp["name"] == "admission")
+    assert adm["attrs"]["outcome"] == "invalid"
+
+
+def test_single_flight_waiter_links_leader_compile_span(tmp_path):
+    """Two concurrent cold requests for one key: the leader's trace owns
+    the compile_build span; the waiter's compile span LINKS it."""
+    from parallel_convolution_tpu.serving.engine import WarmEngine
+
+    events.configure(tmp_path / "ev.jsonl")
+    eng = WarmEngine(_mesh(), fallback=False)
+    key = eng.key_for((1, 24, 36), iters=1)
+    gate = threading.Event()
+    inner = eng._build_entry
+
+    def slow_build(k):
+        gate.wait(10)          # hold the leader until the waiter queues
+        return inner(k)
+
+    eng._build_entry = slow_build
+    ctxs = {}
+
+    def run(who):
+        with trace.span("compile") as sp:
+            ctxs[who] = sp.context
+            eng.entry(key)
+
+    t1 = threading.Thread(target=run, args=("a",))
+    t1.start()
+    # The waiter must arrive while the build is in flight.
+    for _ in range(200):
+        if eng.stats["misses"] >= 1:
+            break
+        time.sleep(0.01)
+    t2 = threading.Thread(target=run, args=("b",))
+    t2.start()
+    for _ in range(200):
+        if eng.stats["single_flight_waits"] >= 1:
+            break
+        time.sleep(0.01)
+    gate.set()
+    t1.join(30)
+    t2.join(30)
+    assert eng.stats["compiles"] == 1
+    assert eng.stats["single_flight_waits"] >= 1
+    spans = trace.span_records(events.read_events(tmp_path / "ev.jsonl"))
+    builds = [s for s in spans if s["name"] == "compile_build"]
+    assert len(builds) == 1
+    waiters = [s for s in spans if s["name"] == "compile"
+               and any(l.get("kind") == "single_flight"
+                       for l in s.get("links", []))]
+    assert waiters, "waiter span did not link the leader's build"
+    assert waiters[0]["links"][0]["span_id"] == builds[0]["span_id"]
+    # And the entry remembers who paid (trace_report's critical path).
+    assert eng.entry(key).compile_ref == {
+        "trace_id": builds[0]["trace_id"],
+        "span_id": builds[0]["span_id"]}
+
+
+def test_zero_overhead_disabled_serving_path(tmp_path):
+    """PCTPU_OBS=0 end-to-end: a served request emits NO span events and
+    stamps an empty trace_id — and nothing crashes on the null spans."""
+    metrics.set_enabled(False)
+    svc, client = _traced_service(tmp_path)
+    s, r = client.request(dict(_body(), request_id="d0"), timeout=60)
+    svc.close()
+    assert s == 200 and r["ok"]
+    assert r["trace_id"] == ""
+    assert trace.span_records(
+        events.read_events(tmp_path / "ev.jsonl")) == []
+
+
+# ----------------------------------------------------------- readiness
+def test_readyz_reflects_reshape_queue_and_degrade(tmp_path):
+    svc, client = _traced_service(tmp_path)
+    try:
+        status, payload = client.readyz()
+        assert status == 200 and payload["ok"]
+        assert payload["queue_depth"] == 0
+        assert payload["queue_bound"] == svc.batcher.max_queue
+        assert payload["degraded"] == []
+        # Reshape in progress -> 503 with the reason visible.
+        svc._reshaping = True
+        status, payload = client.readyz()
+        assert status == 503 and payload["reshaping"] is True
+        svc._reshaping = False
+        # Queue at the admission bound -> 503 (submissions would shed).
+        orig_depth = svc.batcher.depth
+        svc.batcher.depth = lambda: svc.batcher.max_queue
+        status, payload = client.readyz()
+        assert status == 503 and payload["queue_full"] is True
+        svc.batcher.depth = orig_depth
+        # A degraded resident tier is REPORTED but keeps readiness true.
+        s, r = client.request(dict(_body(), request_id="w0"), timeout=60)
+        assert s == 200
+        entry = next(iter(svc.engine._entries.values()))
+        entry.effective_backend = "xla_conv"   # simulate a degraded key
+        status, payload = client.readyz()
+        assert status == 200
+        assert payload["degraded"] == [
+            {"requested": "shifted", "effective": "xla_conv"}]
+    finally:
+        svc.close()
+
+
+# ------------------------------------------------------- perf sentry
+def _gate(*args):
+    p = subprocess.run(
+        [sys.executable, str(SCRIPTS / "perf_gate.py"), *args],
+        capture_output=True, text=True, cwd=str(SCRIPTS.parent))
+    return p.returncode, p.stdout, p.stderr
+
+
+def _row(tmp_path, name, gpx, **kw):
+    p = tmp_path / name
+    p.write_text(json.dumps({
+        "workload": "bench blur3 48x64x1 2 iters",
+        "plan_key": "k1", "backend": "shifted",
+        "effective_backend": "shifted", "mesh": "2x4",
+        "gpixels_per_s": gpx, **kw}))
+    return str(p)
+
+def test_perf_gate_seed_pass_regress_and_noise(tmp_path):
+    hist = str(tmp_path / "hist.jsonl")
+    base = _row(tmp_path, "base.json", 1.0)
+    # Seed: no history -> recorded, gate passes.
+    rc, out, err = _gate("--history", hist, "--row", base, "--update",
+                         "--quiet")
+    assert rc == 0, (out, err)
+    assert "seeded" in Path(hist).read_text()
+    # Within-noise rerun (same number) passes.
+    rc, *_ = _gate("--history", hist, "--row", base, "--quiet")
+    assert rc == 0
+    # 10% down with a 30% floor: still within the gate.
+    rc, *_ = _gate("--history", hist, "--row",
+                   _row(tmp_path, "near.json", 0.9), "--quiet")
+    assert rc == 0
+    # The synthetic 2x-slower row exits NONZERO (the acceptance demo).
+    rc, out, _ = _gate("--history", hist, "--row",
+                       _row(tmp_path, "slow.json", 0.5))
+    assert rc == 1 and "regression" in out
+    # A different key has no baseline: seeded, not judged against k1.
+    rc, *_ = _gate("--history", hist, "--row",
+                   _row(tmp_path, "other.json", 0.01, plan_key="k2"),
+                   "--quiet")
+    assert rc == 0
+
+
+def test_perf_gate_noise_widens_threshold(tmp_path):
+    hist = Path(tmp_path / "hist.jsonl")
+    # A noisy history: rel stdev ~20% -> threshold 3*0.2=0.6 > floor.
+    with open(hist, "a") as f:
+        for v in (1.0, 0.7, 1.3, 0.8, 1.2):
+            f.write(json.dumps({"key": "k1|shifted|2x4",
+                                "gpixels_per_s": v}) + "\n")
+    # 0.55 is 45% below the median (1.0): fails the 0.3 floor but sits
+    # inside the noise-widened gate.
+    rc, *_ = _gate("--history", str(hist), "--row",
+                   _row(tmp_path, "r.json", 0.55), "--quiet")
+    assert rc == 0
+    rc, *_ = _gate("--history", str(hist), "--row",
+                   _row(tmp_path, "r2.json", 0.55), "--quiet",
+                   "--noise-mult", "0.0")
+    assert rc == 1                     # floor-only: the same row fails
+
+
+def test_perf_gate_drift_bound(tmp_path):
+    snap = {"metrics": [{
+        "name": "pctpu_plan_drift_ratio", "kind": "gauge",
+        "series": [
+            {"labels": {"key": "k1", "backend": "shifted"}, "value": 1.2},
+            {"labels": {"key": "k2", "backend": "pallas"}, "value": 20.0},
+        ]}]}
+    sp = tmp_path / "snap.json"
+    sp.write_text(json.dumps(snap))
+    hist = str(tmp_path / "hist.jsonl")
+    rc, out, _ = _gate("--history", hist, "--drift-metrics", str(sp),
+                       "--drift-bound", "10")
+    assert rc == 1 and "k2" in out     # 20x off the model: flagged
+    rc, *_ = _gate("--history", hist, "--drift-metrics", str(sp),
+                   "--drift-bound", "25", "--quiet")
+    assert rc == 0                     # within the wider bound
+
+
+# -------------------------------------------------------- trace report
+def test_trace_report_script_on_served_traffic(tmp_path):
+    """The CLI end of the tentpole: reconstructs the smoke's invariants
+    (rc 0, no orphans) and writes parseable Chrome trace JSON."""
+    svc, client = _traced_service(tmp_path)
+    for i in range(3):
+        s, r = client.request(dict(_body(), request_id=f"c{i}"),
+                              timeout=60)
+        assert s == 200, r
+    svc.close()
+    out = tmp_path / "report.json"
+    chrome = tmp_path / "chrome.json"
+    p = subprocess.run(
+        [sys.executable, str(SCRIPTS / "trace_report.py"),
+         "--events", str(tmp_path / "ev.jsonl"), "--out", str(out),
+         "--chrome", str(chrome), "--quiet"],
+        capture_output=True, text=True, cwd=str(SCRIPTS.parent))
+    assert p.returncode == 0, (p.stdout, p.stderr)
+    rep = json.loads(out.read_text())
+    assert rep["orphan_spans"] == 0 and rep["roots_per_trace_ok"]
+    assert rep["traces"] >= 3 and rep["batches"]
+    b = rep["batches"][0]
+    assert b["device_ms"] >= 0 and b["linked_traces"]
+    assert b["exposed_exchange_fraction_of_device"] is not None
+    ev = json.loads(chrome.read_text())["traceEvents"]
+    assert any(e.get("ph") == "X" and e["name"] == "request" for e in ev)
+    # Critical paths root at the request span.
+    for path in rep["critical_paths"].values():
+        assert path[0]["name"] == "request"
