@@ -67,6 +67,9 @@ KINDS = frozenset({
     "heartbeat",           # supervisor liveness tick
     "leg",                 # supervisor leg state change (start/done/...)
     "serve",               # service lifecycle (boot, close)
+    "router",              # replica-set router: failover, spill, replica
+    #                        ready-state flip, tenant-quota shed,
+    #                        kill/revive (round 14)
     "span",                # one closed trace span (obs.trace): trace_id/
     #                        span_id/parent_id + start_ts/dur_s/links
 })

@@ -52,7 +52,7 @@ from parallel_convolution_tpu.utils.config import (  # canonical registries
 from parallel_convolution_tpu.utils.jax_compat import shard_map
 
 __all__ = ["BACKENDS", "STORAGE_DTYPES", "sharded_iterate", "sharded_converge",
-           "iterate_prepared", "reshard_prepared"]
+           "sharded_converge_stream", "iterate_prepared", "reshard_prepared"]
 
 
 def _note_compile(builder: str, backend: str, grid, iters: int, fuse: int,
@@ -464,6 +464,69 @@ def _build_converge(mesh: Mesh, filt: Filter, tol: float, max_iters: int,
     return jax.jit(sharded, donate_argnums=0)
 
 
+@lru_cache(maxsize=64)
+def _build_converge_chunk(mesh: Mesh, filt: Filter, n: int, quantize: bool,
+                          valid_hw, block_hw, backend: str,
+                          boundary: str = "zero", fuse: int = 1,
+                          tile: tuple[int, int] | None = None,
+                          interior_split: bool = False,
+                          overlap: bool = False):
+    """Compile ONE convergence chunk: ``n`` iterations + the (prev, cur)
+    max-abs diff, returned to the host.
+
+    The progressive counterpart of :func:`_build_converge`: instead of
+    the whole ``while_loop`` living on-device, each ``check_every``-sized
+    chunk is its own fenced call so the HOST can observe the intermediate
+    field (stream a snapshot, decide to stop, checkpoint...).  The chunk
+    math is identical to one iteration of ``_build_converge``'s loop body
+    — n-1 iterations (fused where legal) then one single step forming the
+    (prev, cur) diff pair — so a host-driven chunk loop produces the same
+    bytes as the compiled while_loop, which ``tests/test_router.py``
+    asserts.  ``tol`` is NOT baked in: the host compares, so one compiled
+    chunk serves every tolerance.
+    """
+    fault_point("backend_compile")  # lru_cache miss == a fresh compile
+    grid = grid_shape(mesh)
+    _check_block_size(filt, block_hw)
+    # Fuse at most the n-1 pre-pair iterations (same rule as
+    # _build_converge); a 1-iteration chunk has no pre-pair work at all.
+    fuse = max(1, min(fuse, max(1, n - 1)))
+    if min(block_hw) < filt.radius * fuse:
+        raise ValueError(
+            f"fuse={fuse} needs blocks >= {filt.radius * fuse}, got "
+            f"{block_hw}")
+    _note_compile("converge_chunk", backend, grid, n, fuse, boundary,
+                  block_hw)
+    interp = _mesh_interpret(mesh)
+    step = _make_block_step(filt, grid, valid_hw, block_hw, quantize, backend,
+                            boundary=boundary, tile=tile, interpret=interp,
+                            overlap=overlap)
+    fused = (_make_block_step(filt, grid, valid_hw, block_hw, quantize,
+                              backend, fuse, boundary, tile, interp,
+                              interior_split, overlap)
+             if fuse > 1 and n > 1 else None)
+
+    def body(block):
+        if fused is None:
+            prev = lax.fori_loop(0, n - 1, lambda _, v: step(v), block)
+        else:
+            prev = lax.fori_loop(0, (n - 1) // fuse,
+                                 lambda _, v: fused(v), block)
+            prev = lax.fori_loop(0, (n - 1) % fuse,
+                                 lambda _, v: step(v), prev)
+        cur = step(prev)
+        delta = jnp.abs(cur.astype(jnp.float32) - prev.astype(jnp.float32))
+        diff = lax.pmax(jnp.max(delta), AXES)
+        return cur, diff
+
+    sharded = shard_map(
+        body, mesh=mesh, in_specs=P(None, *AXES),
+        out_specs=(P(None, *AXES), P()),
+        check_vma=False,  # pallas interpret-mode slices trip the vma checker
+    )
+    return jax.jit(sharded, donate_argnums=0)
+
+
 # Iteration-carry dtypes.  Quantized states are exact small integers, so
 # narrower carries lose nothing: bf16 holds 0..255 exactly at half the
 # HBM/ICI traffic of f32, and u8 — the reference's own ``unsigned char``
@@ -831,3 +894,56 @@ def sharded_converge(x, filt: Filter, tol: float, max_iters: int,
                              _norm_tile(tile), source="sharded_converge",
                              overlap=overlap)
     return out[:, : valid_hw[0], : valid_hw[1]].astype(jnp.float32), done
+
+
+def sharded_converge_stream(x, filt: Filter, tol: float, max_iters: int,
+                            check_every: int = 1, mesh: Mesh | None = None,
+                            quantize: bool = False, backend: str = "shifted",
+                            storage: str = "f32", boundary: str = "zero",
+                            fuse: int | None = 1,
+                            tile: tuple[int, int] | None = None,
+                            interior_split: bool = False,
+                            fallback: bool = False,
+                            overlap: bool | None = None):
+    """Progressive run-to-convergence: a generator over snapshot chunks.
+
+    Yields ``(image, iters_done, diff)`` after every ``check_every``-sized
+    chunk — ``image`` is the (C, H, W) float32 field at the valid extent
+    (a host copy, safe to keep), ``diff`` the max-abs single-iteration
+    change that the convergence decision is made on.  The stream ends
+    when ``diff < tol`` or ``iters_done >= max_iters``; the LAST yielded
+    image is bit-identical to :func:`sharded_converge` with the same
+    arguments (same chunk math, host-driven instead of ``while_loop`` —
+    the per-chunk diff readback is the fence that makes the field
+    observable, which is the point: a serving tier can stream best-so-far
+    results out of a long job instead of holding an all-or-nothing
+    deadline).
+    """
+    if mesh is None:
+        mesh = make_grid_mesh()
+    _check_storage(storage, quantize)
+    xs, valid_hw, block_hw = _prepare(x, mesh, filt.radius, storage)
+    backend, fuse, tile, overlap, _ = _resolve_auto(
+        mesh, filt, backend, fuse, tile, storage, quantize, boundary,
+        tuple(valid_hw), xs.shape[0], check_every=int(check_every),
+        overlap=overlap)
+    overlap = resolve_overlap(overlap, backend, mesh)
+    if fallback:
+        backend = _resolve_fallback(mesh, filt, backend, quantize, fuse,
+                                    boundary, _norm_tile(tile),
+                                    interior_split, storage,
+                                    block_hw=block_hw, overlap=overlap)
+        overlap = overlap and backend == "pallas_rdma"
+    _check_quantize_contract(xs, filt, quantize)
+    check_every, max_iters = int(check_every), int(max_iters)
+    done, diff = 0, float("inf")
+    while done < max_iters and diff >= tol:
+        n = min(check_every, max_iters - done)
+        fn = _build_converge_chunk(mesh, filt, n, quantize, tuple(valid_hw),
+                                   block_hw, backend, boundary, int(fuse),
+                                   _norm_tile(tile), interior_split, overlap)
+        xs, d = fn(xs)
+        diff = float(d)   # the readback fences the chunk
+        done += n
+        yield (np.asarray(xs[:, : valid_hw[0], : valid_hw[1]]
+                          .astype(jnp.float32)), done, diff)

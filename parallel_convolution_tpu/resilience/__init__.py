@@ -30,6 +30,11 @@ outage modes into a first-class, deterministic, replayable mechanism:
   the shrink ladder, and new-mesh construction — the glue between
   grid-agnostic checkpoints, the supervisor's reshape legs, and the
   serving engine's mid-process ``reshape()``.
+* :mod:`~parallel_convolution_tpu.resilience.breaker` — the per-replica
+  circuit breaker (closed → open → half-open) the serving router's
+  passive health signal rides; failure counting reuses
+  :func:`~parallel_convolution_tpu.resilience.retry.classify` so a
+  request's own contract bug never opens a replica's circuit.
 
 Everything here except ``degrade``'s probe is jax-free and import-light,
 so hooks can live in modules (``utils.platform``) that must parse
@@ -37,6 +42,9 @@ so hooks can live in modules (``utils.platform``) that must parse
 """
 
 from parallel_convolution_tpu.resilience import elastic  # noqa: F401
+from parallel_convolution_tpu.resilience.breaker import (  # noqa: F401
+    CircuitBreaker,
+)
 from parallel_convolution_tpu.resilience.faults import (  # noqa: F401
     InjectedFault,
     KNOWN_SITES,
@@ -55,7 +63,8 @@ from parallel_convolution_tpu.resilience.retry import (  # noqa: F401
 )
 
 __all__ = [
-    "InjectedFault", "KNOWN_SITES", "elastic", "fault_point", "injected",
-    "install_plan", "plan_from_env", "plan_from_spec", "uninstall_plan",
+    "CircuitBreaker", "InjectedFault", "KNOWN_SITES", "elastic",
+    "fault_point", "injected", "install_plan", "plan_from_env",
+    "plan_from_spec", "uninstall_plan",
     "RetryExhausted", "RetryPolicy", "classify", "with_retry",
 ]

@@ -23,18 +23,29 @@ stack, none of which duplicate compute code:
                  ``effective_backend`` is stamped into every response.
 ``frontend.py``  stdlib-only HTTP/JSON frontend plus an in-process
                  transport so tier-1 tests need no sockets.
+``router.py``    the replica-set front tier (round 14): consistent-hash
+                 routing by compile key over N independent replicas,
+                 active (``/readyz`` poll) + passive (circuit breaker)
+                 health, bounded-load spill, idempotent failover with
+                 request_id dedup, per-tenant token-bucket admission,
+                 and progressive-result streaming for convergence jobs.
 
-CLI surfaces: ``scripts/serve.py`` (boot the HTTP server) and
+CLI surfaces: ``scripts/serve.py`` (boot one replica's HTTP server),
+``scripts/router.py`` (boot the router over N replicas), and
 ``scripts/loadgen.py`` (closed/open-loop load generator emitting
 p50/p95/p99 + phase-breakdown rows in the bench-row schema).
 """
 
 from parallel_convolution_tpu.serving.engine import EngineKey, WarmEngine
+from parallel_convolution_tpu.serving.router import (
+    HTTPReplica, InProcessReplica, ReplicaRouter, TenantQuotas,
+)
 from parallel_convolution_tpu.serving.service import (
-    ConvolutionService, Rejected, Request, Response,
+    ConvolutionService, Rejected, Request, Response, Snapshot,
 )
 
 __all__ = [
-    "ConvolutionService", "EngineKey", "Rejected", "Request", "Response",
-    "WarmEngine",
+    "ConvolutionService", "EngineKey", "HTTPReplica", "InProcessReplica",
+    "Rejected", "ReplicaRouter", "Request", "Response", "Snapshot",
+    "TenantQuotas", "WarmEngine",
 ]

@@ -69,10 +69,12 @@ class Slot:
 class _Item:
     __slots__ = ("key", "payload", "slot", "enqueued_at", "deadline_at")
 
-    def __init__(self, key, payload, deadline_at):
+    def __init__(self, key, payload, deadline_at, slot=None):
         self.key = key
         self.payload = payload
-        self.slot = Slot()
+        # An externally-supplied slot lets the service's request_id dedup
+        # ledger hand hedged submissions the SAME rendezvous object.
+        self.slot = slot if slot is not None else Slot()
         self.enqueued_at = time.monotonic()
         self.deadline_at = deadline_at  # absolute monotonic, or None
 
@@ -112,10 +114,12 @@ class MicroBatcher:
             self.start()
 
     # -- producer side -------------------------------------------------------
-    def try_submit(self, key, payload, deadline_at=None) -> Slot | None:
+    def try_submit(self, key, payload, deadline_at=None,
+                   slot: Slot | None = None) -> Slot | None:
         """Enqueue; returns the item's :class:`Slot`, or None when the
-        queue is full or the batcher closed (the caller sheds load)."""
-        item = _Item(key, payload, deadline_at)
+        queue is full or the batcher closed (the caller sheds load).
+        ``slot`` substitutes a caller-owned rendezvous (dedup ledger)."""
+        item = _Item(key, payload, deadline_at, slot=slot)
         with self._cv:
             if self._closed or len(self._pending) >= self.max_queue:
                 self.stats["refused"] += 1

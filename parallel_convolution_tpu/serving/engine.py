@@ -118,7 +118,7 @@ class _Entry:
 
     __slots__ = ("key", "effective_backend", "fns", "lock", "plan_source",
                  "predicted_gpx", "plan_key", "effective_overlap",
-                 "splits", "compile_ref")
+                 "splits", "compile_ref", "converge_fns")
 
     def __init__(self, key: EngineKey, effective_backend: str,
                  plan_source: str = "explicit",
@@ -141,6 +141,11 @@ class _Entry:
         #                                      waiters (and reports) link
         #                                      to WHO paid for the compile
         self.fns: dict[int, object] = {}   # batch size -> jitted runner
+        self.converge_fns: dict[int, object] = {}  # chunk length n ->
+        #                                    jitted convergence chunk
+        #                                    (run_converge's progressive
+        #                                    executables; n varies only on
+        #                                    the final short chunk)
         self.splits: dict[int, dict] = {}  # batch size -> exchange split
         #                                    (pure model math, cached off
         #                                    the per-request hot path;
@@ -612,6 +617,83 @@ class WarmEngine:
                 entry.plan_key, entry.effective_backend,
                 entry.predicted_gpx,
                 B * C * H * W * key.iters / dev_s / self.mesh.size / 1e9)
+
+    # -- progressive convergence --------------------------------------------
+    def _converge_fn(self, entry: _Entry, n: int):
+        """The warm convergence-chunk executable for ``n`` iterations of
+        this entry's config (compiled under the entry lock, cached)."""
+        fn = entry.converge_fns.get(n)
+        if fn is not None:
+            return fn
+        with entry.lock:
+            fn = entry.converge_fns.get(n)
+            if fn is not None:
+                return fn
+            import jax
+
+            from parallel_convolution_tpu.parallel import step as step_lib
+
+            key = entry.key
+            filt = get_filter(key.filter_name)
+            probe = np.zeros(key.shape, np.float32)
+            xs, valid_hw, block_hw = step_lib._prepare(
+                probe, self.mesh, filt.radius, key.storage)
+            fn = step_lib._build_converge_chunk(
+                self.mesh, filt, n, key.quantize, valid_hw, block_hw,
+                entry.effective_backend, key.boundary, key.fuse, key.tile,
+                False, entry.effective_overlap)
+            jax.block_until_ready(fn(xs)[1])  # compile NOW: the stream's
+            #                                   first chunk must not pay it
+            entry.converge_fns[n] = fn
+            with self._lock:
+                self.stats["compiles"] += 1
+            return fn
+
+    def run_converge(self, key: EngineKey, image: np.ndarray, *,
+                     tol: float, max_iters: int, check_every: int):
+        """Progressive run-to-convergence through the warm cache.
+
+        ``image`` is ONE (C, H, W) f32 field; ``key.iters`` should equal
+        ``check_every`` (the chunk program's compile identity — the
+        service's converge keying does this).  A generator yielding
+        ``(image_f32, iters_done, diff)`` per chunk exactly like
+        ``step.sharded_converge_stream``, but with the chunk executables
+        cached on the warm entry (same LRU / single-flight / degrade
+        machinery as the batch path) so a stream of convergence jobs for
+        one config compiles once.
+
+        A mid-stream mesh reshape raises the same stale-grid ValueError
+        as :meth:`run_batch` — the service turns it into a typed,
+        retryable ``resharding`` row after the best-so-far snapshots
+        already streamed out.
+        """
+        import jax.numpy as jnp
+
+        from parallel_convolution_tpu.parallel import step as step_lib
+
+        entry = self.entry(key)
+        filt = get_filter(key.filter_name)
+        if tuple(image.shape) != key.shape:
+            raise ValueError(
+                f"image shape {tuple(image.shape)} does not match key "
+                f"{key.shape}")
+        xs, valid_hw, _ = step_lib._prepare(
+            np.ascontiguousarray(image, dtype=np.float32), self.mesh,
+            filt.radius, key.storage)
+        check_every, max_iters = int(check_every), int(max_iters)
+        done, diff = 0, float("inf")
+        while done < max_iters and diff >= tol:
+            if key.grid != self.grid():
+                raise ValueError(
+                    f"stale key grid {key.grid}: engine mesh is now "
+                    f"{self.grid()} (resharded mid-process)")
+            n = min(check_every, max_iters - done)
+            fn = self._converge_fn(entry, n)
+            xs, d = fn(xs)
+            diff = float(d)   # the readback fences the chunk
+            done += n
+            yield (np.asarray(xs[:, : valid_hw[0], : valid_hw[1]]
+                              .astype(jnp.float32)), done, diff)
 
     # -- introspection ------------------------------------------------------
     def degraded(self) -> list[dict]:

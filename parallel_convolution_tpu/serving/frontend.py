@@ -57,13 +57,17 @@ from parallel_convolution_tpu.obs import (
     metrics as obs_metrics, trace as obs_trace,
 )
 from parallel_convolution_tpu.serving.service import (
-    ConvolutionService, Rejected, Request, Response,
+    RETRYABLE_REJECTS, ConvolutionService, Rejected, Request, Response,
+    Snapshot,
 )
 
-__all__ = ["InProcessClient", "decode_request", "encode_response",
-           "make_http_server", "metrics_text"]
+__all__ = ["InProcessClient", "decode_converge", "decode_request",
+           "drain_body", "encode_response", "encode_stream_row",
+           "make_http_server", "metrics_text", "retry_after_header",
+           "send_json", "send_ndjson_stream"]
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+NDJSON_CONTENT_TYPE = "application/x-ndjson"
 
 
 def metrics_text() -> str:
@@ -72,8 +76,29 @@ def metrics_text() -> str:
         return "# PCTPU_OBS disabled\n"
     return obs_metrics.render_text()
 
+# Typed rejection -> HTTP status.  The split encodes "back off" vs "give
+# up": retryable sheds (RETRYABLE_REJECTS) are 429 (too many requests:
+# queue_full, tenant_quota) or 503 (service transiently unable:
+# resharding, replica_unavailable) and carry a Retry-After header;
+# contract errors are 400 and terminal execution failures 500 — retrying
+# those verbatim cannot succeed.  ``deadline`` stays 429 (the queue was
+# too deep for the request's own budget) but is NOT flagged retryable:
+# the body's ``retryable`` field, not the status code, is the contract.
 _REJECT_STATUS = {"invalid": 400, "queue_full": 429, "deadline": 429,
-                  "error": 429, "resharding": 429, "timeout": 504}
+                  "error": 500, "resharding": 503, "timeout": 504,
+                  "tenant_quota": 429, "replica_unavailable": 503}
+
+
+def retry_after_header(wire: dict) -> str | None:
+    """The Retry-After header value for a rejection body (None = no
+    header).  HTTP wants integer seconds, so sub-second hints round UP —
+    the precise float rides the body's ``retry_after_s`` for clients
+    that can do better (scripts/loadgen.py)."""
+    if not wire.get("retryable") or wire.get("retry_after_s") is None:
+        return None
+    import math
+
+    return str(max(1, math.ceil(float(wire["retry_after_s"]))))
 
 
 def decode_request(body: dict) -> Request:
@@ -116,6 +141,7 @@ def decode_request(body: dict) -> Request:
             deadline_s=(float(deadline_ms) / 1e3
                         if deadline_ms is not None else None),
             request_id=body.get("request_id"),
+            tenant=str(body.get("tenant") or ""),
         )
     except (KeyError, TypeError, ValueError) as e:
         raise ValueError(f"malformed request body: {e}") from e
@@ -124,11 +150,15 @@ def decode_request(body: dict) -> Request:
 def encode_response(result) -> tuple[int, dict]:
     """:class:`Response`/:class:`Rejected` → (http_status, wire dict)."""
     if isinstance(result, Rejected):
-        return _REJECT_STATUS.get(result.reason, 429), {
+        wire = {
             "ok": False, "rejected": result.reason,
+            "retryable": result.reason in RETRYABLE_REJECTS,
             "request_id": result.request_id, "detail": result.detail,
             "trace_id": result.trace_id,
         }
+        if wire["retryable"] and result.retry_after_s is not None:
+            wire["retry_after_s"] = round(float(result.retry_after_s), 4)
+        return _REJECT_STATUS.get(result.reason, 429), wire
     assert isinstance(result, Response)
     return 200, {
         "ok": True,
@@ -148,6 +178,106 @@ def encode_response(result) -> tuple[int, dict]:
         "phases": result.phases,
         "trace_id": result.trace_id,
     }
+
+
+def decode_converge(body: dict) -> tuple[Request, dict]:
+    """Wire dict → (:class:`Request`, converge params) for
+    ``POST /v1/converge`` (raises ValueError on malformed).
+
+    Same body as ``/v1/convolve`` minus ``iters``/``deadline_ms`` plus
+    ``tol`` / ``max_iters`` / ``check_every``; ``quantize`` defaults to
+    FALSE here (convergence runs float carries — the u8 store-back
+    semantics would clamp the diff trajectory)."""
+    try:
+        params = {"tol": float(body.get("tol", 1e-3)),
+                  "max_iters": int(body.get("max_iters", 500)),
+                  "check_every": int(body.get("check_every", 10))}
+    except (TypeError, ValueError) as e:
+        raise ValueError(f"malformed request body: {e}") from e
+    b = dict(body)
+    b.setdefault("quantize", False)
+    b.pop("deadline_ms", None)   # chunk streaming IS the deadline story
+    b["iters"] = 1               # keying uses check_every (service-side)
+    return decode_request(b), params
+
+
+def encode_stream_row(row) -> dict:
+    """:class:`Snapshot`/:class:`Rejected` → one NDJSON stream line."""
+    if isinstance(row, Rejected):
+        _, wire = encode_response(row)
+        wire["kind"] = "rejected"
+        return wire
+    assert isinstance(row, Snapshot)
+    return {
+        "kind": "final" if row.final else "snapshot",
+        "ok": True,
+        "iters": row.iters,
+        "diff": round(float(row.diff), 8),
+        "converged": row.converged,
+        "image_b64": base64.b64encode(
+            np.ascontiguousarray(row.image).tobytes()).decode("ascii"),
+        "request_id": row.request_id,
+        "effective_backend": row.effective_backend,
+        "effective_grid": row.effective_grid,
+        "plan_key": row.plan_key,
+        "trace_id": row.trace_id,
+    }
+
+
+def drain_body(handler) -> None:
+    """Consume an unread POST body on a ``BaseHTTPRequestHandler``.
+
+    Under HTTP/1.1 keep-alive (which /v1/converge's chunked streaming
+    requires) a response sent with the request body still unread leaves
+    those bytes in the socket — the server would parse them as the next
+    request line.  Shared by the replica and router frontends."""
+    try:
+        n = int(handler.headers.get("Content-Length", "0") or 0)
+    except ValueError:
+        n = 0
+    while n > 0:
+        chunk = handler.rfile.read(min(n, 65536))
+        if not chunk:
+            break
+        n -= len(chunk)
+
+
+def send_json(handler, status: int, payload: dict) -> None:
+    """One JSON response on a ``BaseHTTPRequestHandler``: Content-Length
+    framing plus the Retry-After header for retryable rejection bodies.
+    Shared by the replica frontend and the router frontend so the two
+    cannot drift ("a client cannot tell a router from a replica")."""
+    data = json.dumps(payload).encode()
+    handler.send_response(status)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(data)))
+    ra = retry_after_header(payload)
+    if ra is not None:
+        handler.send_header("Retry-After", ra)
+    handler.end_headers()
+    handler.wfile.write(data)
+
+
+def send_ndjson_stream(handler, rows) -> None:
+    """Chunked NDJSON on a ``BaseHTTPRequestHandler``: one line per
+    stream row, flushed as produced — the progressive-results
+    transport.  The terminal chunk is best-effort: a client that
+    disconnected mid-stream must not raise again out of the finally."""
+    handler.send_response(200)
+    handler.send_header("Content-Type", NDJSON_CONTENT_TYPE)
+    handler.send_header("Transfer-Encoding", "chunked")
+    handler.end_headers()
+    try:
+        for row in rows:
+            data = (json.dumps(row) + "\n").encode()
+            handler.wfile.write(b"%x\r\n" % len(data))
+            handler.wfile.write(data + b"\r\n")
+            handler.wfile.flush()
+    finally:
+        try:
+            handler.wfile.write(b"0\r\n\r\n")
+        except OSError:
+            pass
 
 
 class InProcessClient:
@@ -194,6 +324,48 @@ class InProcessClient:
             sp.set(status=status)
             return status, wire
 
+    def converge(self, body: dict, timeout: float | None = None,
+                 traceparent: str | None = None,
+                 transport: str = "in_process"):
+        """One progressive convergence request → (status, row iterator).
+
+        An immediate rejection returns its status with a one-row
+        iterator; an admitted job returns ``(200, rows)`` where ``rows``
+        yields NDJSON-shaped dicts (``kind: snapshot`` per chunk, then
+        ``kind: final`` — or ``kind: rejected`` if the job died
+        mid-stream, after the best-so-far rows).  The HTTP transport
+        streams exactly these lines chunked.
+        """
+        tp = traceparent if traceparent is not None else body.get(
+            "traceparent")
+        pctx = obs_trace.parse_traceparent(tp)
+        with obs_trace.span(
+                "request", parent=pctx, transport=transport,
+                progressive=True,
+                request_id=str(body.get("request_id") or ""),
+                **({"remote_parent": True} if pctx is not None
+                   else {})) as sp:
+            tid = sp.context.trace_id if sp.context is not None else ""
+            try:
+                req, params = decode_converge(body)
+            except ValueError as e:
+                sp.set(outcome="invalid")
+                return 400, iter([{
+                    "kind": "rejected", "ok": False, "rejected": "invalid",
+                    "retryable": False,
+                    "request_id": body.get("request_id") or "",
+                    "detail": str(e), "trace_id": tid}])
+            result = self.service.submit_progressive(req, **params)
+            if isinstance(result, Rejected):
+                status, wire = encode_response(result)
+                wire["kind"] = "rejected"
+                if not wire.get("trace_id"):
+                    wire["trace_id"] = tid
+                sp.set(outcome=result.reason)
+                return status, iter([wire])
+            sp.set(status=200)
+        return 200, (encode_stream_row(row) for row in result)
+
     def healthz(self) -> tuple[int, dict]:
         return 200, {"ok": True, **self.service.snapshot()}
 
@@ -222,18 +394,21 @@ def make_http_server(service: ConvolutionService, host: str = "127.0.0.1",
     client = InProcessClient(service)
 
     class Handler(BaseHTTPRequestHandler):
+        # HTTP/1.1 so /v1/converge can stream with chunked
+        # transfer-encoding; every non-stream response still carries
+        # Content-Length (keep-alive stays correct).
+        protocol_version = "HTTP/1.1"
+
         # Quiet by default: per-request lines go through log_message,
         # which a server script may re-point at its own logger.
         def log_message(self, fmt, *args):  # noqa: D102
             pass
 
         def _send(self, status: int, payload: dict) -> None:
-            data = json.dumps(payload).encode()
-            self.send_response(status)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(data)))
-            self.end_headers()
-            self.wfile.write(data)
+            send_json(self, status, payload)
+
+        def _send_stream(self, rows) -> None:
+            send_ndjson_stream(self, rows)
 
         def do_GET(self):  # noqa: N802 — http.server API
             if self.path == "/healthz":
@@ -253,7 +428,10 @@ def make_http_server(service: ConvolutionService, host: str = "127.0.0.1",
                 self._send(404, {"ok": False, "detail": "unknown path"})
 
         def do_POST(self):  # noqa: N802 — http.server API
-            if self.path != "/v1/convolve":
+            if self.path not in ("/v1/convolve", "/v1/converge"):
+                # Drain the body first: under HTTP/1.1 keep-alive an
+                # unread body would be parsed as the NEXT request line.
+                drain_body(self)
                 self._send(404, {"ok": False, "detail": "unknown path"})
                 return
             try:
@@ -264,6 +442,20 @@ def make_http_server(service: ConvolutionService, host: str = "127.0.0.1",
             except (ValueError, json.JSONDecodeError) as e:
                 self._send(400, {"ok": False, "rejected": "invalid",
                                  "detail": f"bad JSON body: {e}"})
+                return
+            # Tenant identity: the transport header wins over the body
+            # field (the router's QoS key rides either).
+            tenant = self.headers.get("x-tenant")
+            if tenant:
+                body["tenant"] = tenant
+            if self.path == "/v1/converge":
+                status, rows = client.converge(
+                    body, traceparent=self.headers.get("traceparent"),
+                    transport="http")
+                if status != 200:
+                    self._send(status, next(iter(rows)))
+                else:
+                    self._send_stream(rows)
                 return
             # W3C-style trace propagation: the transport header wins
             # over any body field (the HTTP twin of the in-process

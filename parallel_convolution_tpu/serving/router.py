@@ -1,0 +1,1072 @@
+"""Replica-set serving: health-routed failover router with tenant QoS.
+
+One :class:`~parallel_convolution_tpu.serving.service.ConvolutionService`
+is one engine on one mesh — a single transient fault, reshape, or queue
+spike is a full outage.  This module is the front tier that owns N
+INDEPENDENT replicas (in-process services for tier-1 and drills, HTTP
+services for deployment — one transport protocol, two adapters) and
+keeps serving through any single replica's failure, drain, or reshape.
+
+Design points:
+
+* **Consistent-hash routing by compile key.**  Requests hash by their
+  compile-identity fields (:func:`route_key` — the ``EngineKey`` string
+  proxy a router can compute without a mesh) onto a virtual-node hash
+  ring, so each replica's warm-executable cache holds ITS shard of the
+  key space instead of every replica compiling everything.  Adding or
+  removing one replica remaps only that replica's keys (the classic
+  consistent-hashing property, asserted in ``tests/test_router.py``).
+* **Bounded-load spill.**  The home replica is skipped — and the next
+  ring replica tried — when it is unready (``/readyz`` poll), its
+  circuit is open, or it already carries more than ``load_factor×`` its
+  fair share of in-flight requests (consistent hashing with bounded
+  loads: one hot key cannot melt one replica while others idle).
+* **Active + passive health.**  A poll thread hits every replica's
+  ``readyz`` (reshape/queue-bound state, round 13's probe) on an
+  interval; between polls, per-dispatch outcomes feed a per-replica
+  :class:`~parallel_convolution_tpu.resilience.breaker.CircuitBreaker`
+  (consecutive classified failures open it; half-open probes re-admit).
+* **Failover re-submits only idempotent work.**  Convolution/Jacobi
+  requests are pure; the router stamps a ``request_id`` so a hedged or
+  re-submitted request is DEDUPLICATED at the replica (one device
+  execution per id — ``service.submit``'s idempotency ledger) and never
+  double-charged against tenant quota (the router charges once, at
+  admission).
+* **Tenant QoS.**  Per-tenant token buckets (wall-clock refill) admit
+  requests before any routing; an exhausted bucket sheds a typed,
+  retryable ``Rejected("tenant_quota")`` carrying the exact refill time
+  — distinct from the replicas' global ``queue_full`` shedding, so one
+  greedy tenant cannot starve another (asserted in tier-1).  Tokens are
+  refunded when NO replica did work (shed/unavailable outcomes): quota
+  meters work, not misfortune.
+* **Progressive results.**  ``converge`` routes a convergence job the
+  same way and streams the replica's snapshot rows through (chunked
+  HTTP / iterator in-process); a job that dies mid-stream has already
+  delivered its best-so-far image + diff trajectory, and the router
+  fails over BEFORE the first row but never mid-stream (re-running a
+  half-delivered job would duplicate device work the client already
+  has).
+
+stdlib + numpy only; jax stays inside the replicas.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import itertools
+import json
+import threading
+import time
+
+from parallel_convolution_tpu.obs import (
+    events as obs_events, metrics as obs_metrics, trace as obs_trace,
+)
+from parallel_convolution_tpu.resilience.breaker import (
+    OPEN, CircuitBreaker,
+)
+from parallel_convolution_tpu.serving.frontend import (
+    InProcessClient, drain_body, send_json, send_ndjson_stream,
+)
+from parallel_convolution_tpu.serving.service import ReleasingStream
+
+__all__ = [
+    "HTTPReplica", "HashRing", "InProcessReplica", "ReplicaRouter",
+    "TenantQuotas", "TokenBucket", "make_router_http_server", "route_key",
+]
+
+
+# -- compile-key routing ------------------------------------------------------
+
+# Every wire field that lands in the replica's EngineKey (the compile
+# identity).  Image CONTENT is deliberately absent: equal configs share
+# one warm executable, so they must share one home replica.
+ROUTE_KEY_FIELDS = ("rows", "cols", "mode", "filter", "iters", "backend",
+                    "storage", "fuse", "boundary", "quantize", "overlap",
+                    "tile", "check_every")
+
+
+def route_key(body: dict) -> str:
+    """The consistent-hash key of one wire request: a canonical string
+    of its compile-identity fields (the ``EngineKey`` proxy)."""
+    return "|".join(f"{k}={body.get(k)!r}" for k in ROUTE_KEY_FIELDS)
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes.
+
+    ``candidates(key)`` returns every member exactly once, in ring order
+    from the key's point — index 0 is the HOME replica, the rest the
+    spill/failover order.  Membership changes remap only the touched
+    member's keys.
+    """
+
+    def __init__(self, names=(), vnodes: int = 64):
+        if vnodes < 1:
+            raise ValueError("vnodes >= 1 required")
+        self.vnodes = int(vnodes)
+        self._names: set[str] = set()
+        self._points: list[int] = []
+        self._owners: list[str] = []
+        for n in names:
+            self.add(n)
+
+    @staticmethod
+    def _hash(s: str) -> int:
+        return int(hashlib.sha1(s.encode()).hexdigest()[:16], 16)
+
+    def _rebuild(self) -> None:
+        pairs = sorted(
+            (self._hash(f"{name}#{i}"), name)
+            for name in self._names for i in range(self.vnodes))
+        self._points = [p for p, _ in pairs]
+        self._owners = [n for _, n in pairs]
+
+    def add(self, name: str) -> None:
+        self._names.add(str(name))
+        self._rebuild()
+
+    def remove(self, name: str) -> None:
+        self._names.discard(str(name))
+        self._rebuild()
+
+    def members(self) -> list[str]:
+        return sorted(self._names)
+
+    def candidates(self, key: str) -> list[str]:
+        """All members in ring order from ``key``'s point (home first)."""
+        if not self._points:
+            return []
+        out: list[str] = []
+        seen: set[str] = set()
+        start = bisect.bisect_left(self._points, self._hash(key))
+        n = len(self._owners)
+        for i in range(n):
+            owner = self._owners[(start + i) % n]
+            if owner not in seen:
+                seen.add(owner)
+                out.append(owner)
+                if len(seen) == len(self._names):
+                    break
+        return out
+
+
+# -- tenant QoS ---------------------------------------------------------------
+
+class TokenBucket:
+    """Wall-clock-refilled token bucket (``rate`` tokens/s, ``burst``
+    capacity).  ``rate <= 0`` means unlimited."""
+
+    def __init__(self, rate: float, burst: float, clock=time.monotonic):
+        self.rate = float(rate)
+        self.burst = max(1.0, float(burst))
+        self._clock = clock
+        self._tokens = self.burst
+        self._last = clock()
+        self._lock = threading.Lock()
+
+    def _refill(self, now: float) -> None:
+        if self.rate > 0:
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._last) * self.rate)
+        self._last = now
+
+    def try_take(self, n: float = 1.0) -> tuple[bool, float]:
+        """(granted, retry_after_s).  On refusal, ``retry_after_s`` is the
+        exact wall time until the bucket holds ``n`` tokens again."""
+        if self.rate <= 0:
+            return True, 0.0
+        with self._lock:
+            now = self._clock()
+            self._refill(now)
+            if self._tokens >= n:
+                self._tokens -= n
+                return True, 0.0
+            return False, (n - self._tokens) / self.rate
+
+    def refund(self, n: float = 1.0) -> None:
+        if self.rate <= 0:
+            return
+        with self._lock:
+            self._tokens = min(self.burst, self._tokens + n)
+
+    def level(self) -> float:
+        with self._lock:
+            self._refill(self._clock())
+            return self._tokens
+
+
+class TenantQuotas:
+    """Per-tenant admission buckets: one :class:`TokenBucket` per tenant
+    (created on first sight, FIFO-bounded), all sharing a default
+    (rate, burst) unless ``overrides[tenant] = (rate, burst)`` says
+    otherwise.  Isolation is the point: tenant A's bucket emptying can
+    never affect tenant B's — only the replicas' GLOBAL queue bound can,
+    and that sheds a differently-typed reason."""
+
+    def __init__(self, rate: float, burst: float, overrides=None,
+                 max_tenants: int = 1024, clock=time.monotonic):
+        from collections import OrderedDict
+
+        self.rate, self.burst = float(rate), float(burst)
+        self.overrides = dict(overrides or {})
+        self.max_tenants = int(max_tenants)
+        self._clock = clock
+        self._buckets: "OrderedDict[str, TokenBucket]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def bucket(self, tenant: str) -> TokenBucket:
+        with self._lock:
+            b = self._buckets.get(tenant)
+            if b is not None:
+                self._buckets.move_to_end(tenant)   # LRU touch
+                return b
+            rate, burst = self.overrides.get(
+                tenant, (self.rate, self.burst))
+            b = TokenBucket(rate, burst, clock=self._clock)
+            self._buckets[tenant] = b
+            while len(self._buckets) > self.max_tenants:
+                # Evict a FULL (idle, refilled) bucket when one exists:
+                # evicting by age alone would let a drained tenant reset
+                # its own quota by churning throwaway names until its
+                # empty bucket ages out.  Churned fresh buckets are full,
+                # so churn evicts churn, never a draining tenant.
+                victim = next(
+                    (t for t, bk in self._buckets.items()
+                     if t != tenant and bk.level() >= bk.burst), None)
+                if victim is None:
+                    victim = next(t for t in self._buckets if t != tenant)
+                self._buckets.pop(victim)
+            return b
+
+    def take(self, tenant: str) -> tuple[bool, float]:
+        return self.bucket(tenant).try_take()
+
+    def refund(self, tenant: str) -> None:
+        self.bucket(tenant).refund()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {t: round(b.level(), 3) for t, b in self._buckets.items()}
+
+
+# -- replica transports -------------------------------------------------------
+
+class InProcessReplica:
+    """One in-process service replica with kill/revive for drills.
+
+    ``factory`` builds a fresh ``ConvolutionService`` (its own mesh, its
+    own engine) — called at construction and on every :meth:`revive`.
+    :meth:`kill` drains and closes the live service; requests against a
+    killed replica raise ``ConnectionError`` exactly like a dead host,
+    which is what the router's breaker/failover machinery keys on.
+    """
+
+    def __init__(self, factory, name: str = "r0"):
+        self._factory = factory
+        self.name = str(name)
+        self._lock = threading.Lock()
+        self.service = None
+        self.client = None
+        self.revive()
+
+    def _live(self) -> InProcessClient:
+        client = self.client
+        if client is None:
+            raise ConnectionError(f"replica {self.name} is down")
+        return client
+
+    def request(self, body: dict, timeout: float | None = None,
+                traceparent: str | None = None):
+        return self._live().request(body, timeout=timeout,
+                                    traceparent=traceparent)
+
+    def converge(self, body: dict, timeout: float | None = None,
+                 traceparent: str | None = None):
+        return self._live().converge(body, timeout=timeout,
+                                     traceparent=traceparent)
+
+    def readyz(self):
+        return self._live().readyz()
+
+    def snapshot(self) -> dict:
+        return self._live().stats()[1]
+
+    def kill(self) -> None:
+        """Take the replica down (drains in-flight work first — admitted
+        requests are idempotent and complete; NEW requests raise)."""
+        with self._lock:
+            svc, self.service, self.client = self.service, None, None
+        if svc is not None:
+            svc.close()
+
+    def revive(self) -> None:
+        from parallel_convolution_tpu.serving.frontend import (
+            InProcessClient as _Client,
+        )
+
+        with self._lock:
+            if self.service is None:
+                self.service = self._factory()
+                self.client = _Client(self.service)
+
+    def close(self) -> None:
+        self.kill()
+
+
+class HTTPReplica:
+    """One HTTP service replica (``scripts/serve.py``).  Transport
+    failures surface as ``ConnectionError`` so the breaker classifies
+    them transient; typed HTTP rejections pass through as (status, body).
+    """
+
+    def __init__(self, url: str, name: str | None = None,
+                 timeout: float = 60.0, probe_timeout: float = 2.0):
+        self.base = url.rstrip("/")
+        self.name = name or self.base
+        self.timeout = timeout
+        # Health probes get their OWN short budget: the poll loop sweeps
+        # replicas serially, so one black-holing host must cost it ~2 s,
+        # not the request timeout.
+        self.probe_timeout = min(probe_timeout, timeout)
+
+    def _post(self, path: str, body: dict, timeout, traceparent):
+        import urllib.error
+        import urllib.request
+
+        headers = {"Content-Type": "application/json"}
+        if traceparent:
+            headers["traceparent"] = traceparent
+        req = urllib.request.Request(
+            f"{self.base}{path}", data=json.dumps(body).encode(),
+            headers=headers)
+        try:
+            return urllib.request.urlopen(
+                req, timeout=timeout or self.timeout)
+        except urllib.error.HTTPError as e:
+            return e   # carries .status/.code + readable body
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise ConnectionError(
+                f"replica {self.name} unreachable: {e}") from e
+
+    def request(self, body: dict, timeout: float | None = None,
+                traceparent: str | None = None):
+        resp = self._post("/v1/convolve", body, timeout, traceparent)
+        with resp if hasattr(resp, "__enter__") else _closing(resp) as r:
+            status = getattr(r, "status", None) or r.code
+            try:
+                return status, json.loads(r.read())
+            except ValueError as e:
+                raise ConnectionError(
+                    f"replica {self.name} sent unparseable body "
+                    f"(http {status}): {e}") from e
+
+    def converge(self, body: dict, timeout: float | None = None,
+                 traceparent: str | None = None):
+        resp = self._post("/v1/converge", body, timeout, traceparent)
+        status = getattr(resp, "status", None) or resp.code
+        if status != 200:
+            with resp if hasattr(resp, "__enter__") else _closing(resp) as r:
+                try:
+                    return status, iter([json.loads(r.read())])
+                except ValueError:
+                    return status, iter([{"ok": False, "kind": "rejected",
+                                          "rejected": "error",
+                                          "detail": f"http {status}"}])
+
+        def rows():
+            try:
+                with resp:
+                    for line in resp:   # http.client de-chunks for us
+                        line = line.strip()
+                        if line:
+                            yield json.loads(line)
+            except (OSError, ValueError) as e:
+                # TRANSPORT death, not a typed execution failure: the
+                # job itself may be fine elsewhere, so the row is
+                # retryable — unlike a replica-typed `error` row, which
+                # passes through retryable:false (RETRYABLE_REJECTS).
+                yield {"ok": False, "kind": "rejected",
+                       "rejected": "replica_unavailable",
+                       "retryable": True,
+                       "detail": f"stream broke: {e}"[:300]}
+
+        return 200, rows()
+
+    def _get(self, path: str, timeout: float | None = None):
+        import urllib.error
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(f"{self.base}{path}",
+                                        timeout=timeout or self.timeout) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read())
+            except Exception:  # noqa: BLE001
+                return e.code, {"ok": False}
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise ConnectionError(
+                f"replica {self.name} unreachable: {e}") from e
+
+    def readyz(self):
+        return self._get("/readyz", timeout=self.probe_timeout)
+
+    def snapshot(self) -> dict:
+        return self._get("/stats")[1]
+
+    def close(self) -> None:
+        pass
+
+
+class _closing:
+    """Context manager over urllib HTTPError responses (no __enter__)."""
+
+    def __init__(self, obj):
+        self.obj = obj
+
+    def __enter__(self):
+        return self.obj
+
+    def __exit__(self, *exc):
+        close = getattr(self.obj, "close", None)
+        if close is not None:
+            close()
+        return False
+
+
+# -- the router ---------------------------------------------------------------
+
+class _ReplicaState:
+    """Router-side record of one replica: transport + health + load."""
+
+    __slots__ = ("name", "transport", "breaker", "ready", "ready_payload",
+                 "in_flight", "stats")
+
+    def __init__(self, transport, breaker: CircuitBreaker):
+        self.name = transport.name
+        self.transport = transport
+        self.breaker = breaker
+        self.ready = True          # optimistic until the first poll
+        self.ready_payload: dict = {}
+        self.in_flight = 0
+        self.stats = {"routed": 0, "completed": 0, "sheds": 0,
+                      "failures": 0}
+
+
+# Rejections that mean "no device work happened anywhere" — the tenant's
+# token is refunded for these (quota meters work, not misfortune).
+_REFUND_REJECTS = frozenset(
+    {"queue_full", "resharding", "replica_unavailable"})
+# Replica sheds the router SPILLS past (the replica is healthy but
+# transiently unable) vs failures it FAILS OVER from (breaker food).
+_SPILL_REJECTS = frozenset({"queue_full", "resharding"})
+
+
+class ReplicaRouter:
+    """The replica-set front tier (see module docstring).
+
+    ``replicas`` are transports (:class:`InProcessReplica` /
+    :class:`HTTPReplica`) with unique ``.name``s.  ``quotas`` is an
+    optional :class:`TenantQuotas`.  ``hedge_s`` (off by default) fires
+    ONE extra attempt at the next ring candidate when the home replica
+    hasn't answered within the budget — first result wins, the loser's
+    work is absorbed by the replica-side request_id dedup when both
+    landed on the same replica (cross-replica hedges genuinely duplicate
+    work; that is the standard tail-latency trade).
+    """
+
+    def __init__(self, replicas, *, quotas: TenantQuotas | None = None,
+                 vnodes: int = 64, breaker_threshold: int = 3,
+                 breaker_cooldown_s: float = 1.0,
+                 poll_interval_s: float = 0.25, load_factor: float = 2.0,
+                 hedge_s: float | None = None, start_health: bool = True,
+                 clock=time.monotonic):
+        if not replicas:
+            raise ValueError("at least one replica required")
+        names = [r.name for r in replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"replica names must be unique, got {names}")
+        self._replicas = {
+            r.name: _ReplicaState(
+                r, CircuitBreaker(breaker_threshold, breaker_cooldown_s,
+                                  clock=clock))
+            for r in replicas}
+        self.ring = HashRing(names, vnodes=vnodes)
+        self.quotas = quotas
+        self.load_factor = float(load_factor)
+        self.hedge_s = hedge_s
+        self.poll_interval_s = float(poll_interval_s)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+        self.stats = obs_metrics.MirroredStats(obs_metrics.gauge(
+            "pctpu_router_stats", "replica-router admission/outcome counters",
+            ("key",)), initial={
+            "routed": 0, "completed": 0, "failovers": 0, "spills": 0,
+            "hedges": 0, "rejected_tenant_quota": 0,
+            "rejected_unavailable": 0, "progressive": 0,
+        })
+        self._closed = threading.Event()
+        self._poll_thread: threading.Thread | None = None
+        if start_health:
+            self.start_health()
+
+    # -- health ---------------------------------------------------------------
+    def start_health(self) -> None:
+        if self._poll_thread is None or not self._poll_thread.is_alive():
+            self._poll_thread = threading.Thread(
+                target=self._poll_loop, name="pctpu-router-health",
+                daemon=True)
+            self._poll_thread.start()
+
+    def _poll_loop(self) -> None:
+        while not self._closed.wait(self.poll_interval_s):
+            self.poll_once()
+
+    def poll_once(self) -> None:
+        """One active-health sweep: every replica's ``readyz``."""
+        for rep in self._replicas.values():
+            try:
+                status, payload = rep.transport.readyz()
+                ready, payload = status == 200, payload
+            except Exception as e:  # noqa: BLE001 — a dead replica
+                ready, payload = False, {"error": repr(e)[:200]}
+            if ready != rep.ready and obs_metrics.enabled():
+                obs_events.emit("router", event="replica_ready",
+                                replica=rep.name, ready=ready)
+                obs_metrics.counter(
+                    "pctpu_router_ready_flips_total",
+                    "replica ready-state transitions observed by the "
+                    "health poll", ("replica",)).inc(replica=rep.name)
+            rep.ready, rep.ready_payload = ready, payload
+
+    # -- admission ------------------------------------------------------------
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self.stats[key] += n
+
+    def _tenant_admit(self, tenant: str, rid: str, trace_id: str):
+        """None when admitted; the (status, wire) shed otherwise."""
+        if self.quotas is None:
+            return None
+        ok, retry_after = self.quotas.take(tenant)
+        if ok:
+            return None
+        self._bump("rejected_tenant_quota")
+        if obs_metrics.enabled():
+            obs_metrics.counter(
+                "pctpu_router_tenant_quota_total",
+                "tenant-bucket admission sheds", ("tenant",)).inc(
+                tenant=tenant)
+            obs_events.emit("router", event="tenant_quota", tenant=tenant,
+                            request_id=rid,
+                            retry_after_s=round(retry_after, 4))
+        return 429, {
+            "ok": False, "rejected": "tenant_quota", "retryable": True,
+            "retry_after_s": round(retry_after, 4), "tenant": tenant,
+            "request_id": rid, "trace_id": trace_id,
+            "detail": f"tenant {tenant!r} bucket empty; refills at "
+                      f"{self.quotas.bucket(tenant).rate}/s",
+        }
+
+    # -- dispatch -------------------------------------------------------------
+    def _load_bound(self) -> int:
+        """Bounded-load cap: ``load_factor ×`` the fair in-flight share,
+        floored at ``load_factor`` — at near-zero total in-flight the
+        fair share rounds to 1, and spilling the SECOND concurrent
+        request for a key off its home would trade a duplicate compile
+        on another replica for no protection at all (the cap exists for
+        sustained overload, not a cold-start burst)."""
+        live = [r for r in self._replicas.values()
+                if r.ready and r.breaker.state() != OPEN]
+        n_live = max(1, len(live))
+        total = sum(r.in_flight for r in self._replicas.values())
+        fair = self.load_factor * (total + 1) / n_live
+        return max(1, int(self.load_factor + 0.999), int(fair + 0.999))
+
+    def _record_counter(self, replica: str, outcome: str) -> None:
+        if obs_metrics.enabled():
+            obs_metrics.counter(
+                "pctpu_router_requests_total",
+                "routed dispatch outcomes per replica",
+                ("replica", "outcome")).inc(replica=replica, outcome=outcome)
+
+    def _try_one(self, rep: _ReplicaState, body: dict, timeout,
+                 traceparent):
+        """One dispatch to one replica.
+
+        Returns ``("ok", status, wire)``, ``("shed", status, wire)``
+        (typed retryable — spill past it), or ``("fail", status, wire)``
+        / ``("fail", None, None)`` (breaker food — fail over).
+        """
+        with self._lock:
+            rep.in_flight += 1
+            rep.stats["routed"] += 1
+        try:
+            status, wire = rep.transport.request(
+                body, timeout=timeout, traceparent=traceparent)
+        except Exception as e:  # noqa: BLE001 — transport death
+            rep.breaker.record_failure(e)
+            with self._lock:
+                rep.stats["failures"] += 1
+            self._record_counter(rep.name, "transport_error")
+            if obs_metrics.enabled():
+                obs_events.emit("router", event="failover",
+                                replica=rep.name, error=repr(e)[:200],
+                                request_id=body.get("request_id", ""))
+            return "fail", None, {"detail": repr(e)[:200]}
+        finally:
+            with self._lock:
+                rep.in_flight -= 1
+        reason = wire.get("rejected")
+        if status == 200 and wire.get("ok"):
+            rep.breaker.record_success()
+            with self._lock:
+                rep.stats["completed"] += 1
+            self._record_counter(rep.name, "completed")
+            return "ok", status, wire
+        if reason in _SPILL_REJECTS:
+            # The replica is healthy, just transiently unable: not
+            # breaker food, but do try the next ring candidate.
+            rep.breaker.record_success()
+            with self._lock:
+                rep.stats["sheds"] += 1
+            self._record_counter(rep.name, f"shed_{reason}")
+            return "shed", status, wire
+        if reason == "error" or status >= 500:
+            # A typed terminal execution failure (or an untyped 5xx) IS
+            # a replica-health signal — and the work is idempotent, so
+            # failing over is always safe.
+            rep.breaker.record_failure()
+            with self._lock:
+                rep.stats["failures"] += 1
+            self._record_counter(rep.name, "error")
+            return "fail", status, wire
+        # invalid / deadline / tenant-level outcomes: the request's own
+        # story; pass through verbatim.
+        rep.breaker.record_success()
+        self._record_counter(rep.name, reason or "other")
+        return "ok", status, wire
+
+    def _dispatch(self, key: str, body: dict, timeout, sp,
+                  offset: int = 0):
+        """The candidate walk: home replica, then ring order.
+
+        Pass 1 honors readiness + bounded load; pass 2 (only if pass 1
+        dispatched nothing) ignores them — when EVERY replica looks
+        unready, trying one beats returning unavailable unexamined.
+        ``offset`` rotates the walk's starting point (a hedge starts at
+        the NEXT ring candidate — re-walking from the home would just
+        dedup into the slow attempt it is meant to race).
+        """
+        order = self.ring.candidates(key)
+        home = order[0] if order else ""
+        if offset and order:
+            off = offset % len(order)
+            order = order[off:] + order[:off]
+        meta = {"home": home, "replica": "", "attempts": 0,
+                "failovers": 0, "spills": 0}
+        last_shed = last_fail = None
+        tp = (obs_trace.format_traceparent(sp.context)
+              if sp.context is not None else None)
+        dispatched_any = False
+        for relaxed in (False, True):
+            if relaxed and dispatched_any:
+                break
+            bound = self._load_bound()
+            for name in order:
+                rep = self._replicas[name]
+                if not relaxed:
+                    if not rep.ready or rep.in_flight >= bound:
+                        meta["spills"] += 1
+                        self._bump("spills")
+                        continue
+                if not rep.breaker.allow():
+                    meta["spills"] += 1
+                    self._bump("spills")
+                    continue
+                dispatched_any = True
+                meta["attempts"] += 1
+                verdict, status, wire = self._try_one(rep, body, timeout, tp)
+                if verdict == "ok":
+                    meta["replica"] = name
+                    if name != home:
+                        sp.set(spilled=True)
+                    return status, wire, meta
+                if verdict == "shed":
+                    last_shed = (status, wire, name)
+                    meta["spills"] += 1
+                    self._bump("spills")
+                else:
+                    last_fail = (status, wire, name)
+                    meta["failovers"] += 1
+                    self._bump("failovers")
+        if last_shed is not None:
+            status, wire, name = last_shed
+            meta["replica"] = name
+            return status, wire, meta
+        if last_fail is not None and last_fail[0] is not None:
+            status, wire, name = last_fail
+            meta["replica"] = name
+            return status, wire, meta
+        self._bump("rejected_unavailable")
+        return 503, {
+            "ok": False, "rejected": "replica_unavailable",
+            "retryable": True,
+            "retry_after_s": round(self.breaker_cooldown_s, 4),
+            "request_id": body.get("request_id", ""),
+            "detail": f"no live replica among {len(order)} "
+                      f"({meta['failovers']} failed, {meta['spills']} "
+                      "skipped)",
+        }, meta
+
+    # -- the public request path ---------------------------------------------
+    def request(self, body: dict, timeout: float | None = None,
+                tenant: str | None = None):
+        """Route one wire-format request; returns ``(status, wire)``.
+
+        The response carries a ``router`` stamp: the serving replica,
+        the home replica, and the attempt/failover/spill counts — which
+        is how ``loadgen`` observes failovers without server logs.
+        """
+        body = dict(body)
+        rid = body.get("request_id") or f"rt{next(self._ids)}"
+        body["request_id"] = rid
+        tenant = str(tenant or body.get("tenant") or "default")
+        body["tenant"] = tenant
+        self._bump("routed")
+        with obs_trace.span("route", request_id=rid, tenant=tenant) as sp:
+            tid = sp.context.trace_id if sp.context is not None else ""
+            shed = self._tenant_admit(tenant, rid, tid)
+            if shed is not None:
+                sp.set(outcome="tenant_quota")
+                status, wire = shed
+                wire["router"] = {"home": "", "replica": "", "attempts": 0,
+                                  "failovers": 0, "spills": 0}
+                return status, wire
+            key = route_key(body)
+            sp.set(key=key)
+            if self.hedge_s is not None:
+                status, wire, meta = self._dispatch_hedged(
+                    key, body, timeout, sp)
+            else:
+                status, wire, meta = self._dispatch(key, body, timeout, sp)
+            sp.set(outcome=wire.get("rejected", "completed"),
+                   replica=meta.get("replica", ""),
+                   failovers=meta.get("failovers", 0))
+            if status == 200 and wire.get("ok"):
+                self._bump("completed")
+            elif (self.quotas is not None
+                  and wire.get("rejected") in _REFUND_REJECTS):
+                self.quotas.refund(tenant)
+            wire.setdefault("router", meta)
+            return status, wire
+
+    def _dispatch_hedged(self, key: str, body: dict, timeout, sp):
+        """Tail-latency hedging: fire the normal dispatch, and if it has
+        not resolved within ``hedge_s``, fire ONE more full dispatch
+        concurrently (same request_id → the replica-side idempotency
+        ledger absorbs a same-replica duplicate).  First result wins."""
+        results: list = []
+        done = threading.Condition()
+
+        def attempt(offset: int = 0):
+            r = self._dispatch(key, body, timeout, sp, offset=offset)
+            with done:
+                results.append(r)
+                done.notify_all()
+
+        t1 = threading.Thread(target=attempt, daemon=True)
+        t1.start()
+        with done:
+            done.wait(self.hedge_s)
+            if not results:
+                self._bump("hedges")
+                if obs_metrics.enabled():
+                    obs_events.emit(
+                        "router", event="hedge",
+                        request_id=body.get("request_id", ""))
+                # The hedge starts one ring position past the home: the
+                # whole point is a DIFFERENT replica than the slow
+                # attempt (same-replica hedges just dedup into it).
+                threading.Thread(target=attempt, args=(1,),
+                                 daemon=True).start()
+            while not results:
+                done.wait(1.0)
+            # Prefer a 200 if both landed; else the first verdict.
+            for r in results:
+                if r[0] == 200 and r[1].get("ok"):
+                    return r
+            return results[0]
+
+    # -- progressive ----------------------------------------------------------
+    def converge(self, body: dict, timeout: float | None = None,
+                 tenant: str | None = None):
+        """Route one progressive convergence job; ``(status, rows)``.
+
+        Failover happens only BEFORE the first streamed row (a pre-stream
+        shed/failure walks the ring exactly like ``request``); once rows
+        flow, a mid-stream death ends the stream with a typed retryable
+        row — the client keeps its best-so-far snapshots.
+        """
+        body = dict(body)
+        rid = body.get("request_id") or f"rt{next(self._ids)}"
+        body["request_id"] = rid
+        tenant = str(tenant or body.get("tenant") or "default")
+        body["tenant"] = tenant
+        self._bump("routed")
+        self._bump("progressive")
+        with obs_trace.span("route", request_id=rid, tenant=tenant,
+                            progressive=True) as sp:
+            tid = sp.context.trace_id if sp.context is not None else ""
+            shed = self._tenant_admit(tenant, rid, tid)
+            if shed is not None:
+                sp.set(outcome="tenant_quota")
+                status, wire = shed
+                wire["kind"] = "rejected"
+                return status, iter([wire])
+            key = route_key(body)
+            tp = (obs_trace.format_traceparent(sp.context)
+                  if sp.context is not None else None)
+            order = self.ring.candidates(key)
+            last = None
+            dispatched_any = False
+            for relaxed in (False, True):
+                if relaxed and dispatched_any:
+                    # Same rule as `_dispatch`: the relaxed pass exists
+                    # for when EVERY replica looked unready — replicas
+                    # already tried (and failed/shed) must not get the
+                    # same job re-submitted.
+                    break
+                bound = self._load_bound()
+                for name in order:
+                    rep = self._replicas[name]
+                    if not relaxed and (not rep.ready
+                                        or rep.in_flight >= bound):
+                        self._bump("spills")
+                        continue
+                    if not rep.breaker.allow():
+                        self._bump("spills")
+                        continue
+                    dispatched_any = True
+                    try:
+                        status, rows = rep.transport.converge(
+                            body, timeout=timeout, traceparent=tp)
+                    except Exception as e:  # noqa: BLE001
+                        rep.breaker.record_failure(e)
+                        self._bump("failovers")
+                        self._record_counter(rep.name, "transport_error")
+                        last = (503, [{
+                            "kind": "rejected", "ok": False,
+                            "rejected": "replica_unavailable",
+                            "retryable": True, "request_id": rid,
+                            "detail": repr(e)[:200]}])
+                        continue
+                    if status != 200:
+                        first = list(rows)[:1]
+                        wire = first[0] if first else {}
+                        reason = wire.get("rejected")
+                        if reason in _SPILL_REJECTS:
+                            rep.breaker.record_success()
+                            self._bump("spills")
+                            last = (status, first or [{"ok": False}])
+                            continue
+                        if reason == "error" or status >= 500:
+                            rep.breaker.record_failure()
+                            self._bump("failovers")
+                            last = (status, first or [{"ok": False}])
+                            continue
+                        # invalid / deadline / tenant-level outcomes: the
+                        # request's own fault — no ring walk helps, and
+                        # it is NOT replica-health evidence (same
+                        # taxonomy as `_try_replica`).
+                        rep.breaker.record_success()
+                        sp.set(outcome=reason or "rejected")
+                        return status, iter(first or [{"ok": False}])
+                    rep.breaker.record_success()
+                    self._record_counter(rep.name, "progressive")
+                    sp.set(outcome="streaming", replica=name)
+                    # The stream counts against the replica's in-flight
+                    # load for its WHOLE lifetime (progressive jobs are
+                    # the longest-running work in the system — invisible
+                    # to bounded-load spill, they'd pile onto one
+                    # replica); released exactly once, even when the
+                    # caller drops the stream un-started.
+                    with self._lock:
+                        rep.in_flight += 1
+                        rep.stats["routed"] += 1
+                    released: list = []
+
+                    def release(rep=rep):
+                        with self._lock:
+                            if not released:
+                                released.append(True)
+                                rep.in_flight -= 1
+
+                    return 200, ReleasingStream(
+                        self._stream_through(rep, name, rows, release),
+                        release)
+            if last is not None:
+                # Same refund rule as `request`: the token comes back
+                # only when NO replica did work — a terminal `error`
+                # outcome executed on a device and stays charged.
+                wire = last[1][0] if last[1] else {}
+                if (self.quotas is not None
+                        and wire.get("rejected") in _REFUND_REJECTS):
+                    self.quotas.refund(tenant)
+                return last[0], iter(last[1])
+            self._bump("rejected_unavailable")
+            if self.quotas is not None:
+                self.quotas.refund(tenant)
+            return 503, iter([{
+                "kind": "rejected", "ok": False,
+                "rejected": "replica_unavailable", "retryable": True,
+                "retry_after_s": round(self.breaker_cooldown_s, 4),
+                "request_id": rid, "detail": "no live replica"}])
+
+    def _stream_through(self, rep: _ReplicaState, name: str, rows,
+                        release):
+        """Pass replica stream rows through, stamping the router and
+        converting a mid-stream transport death into a typed retryable
+        ``replica_unavailable`` row (a replica-typed ``error`` row
+        passes through verbatim, retryable:false — the taxonomy
+        split)."""
+        got_final = False
+        try:
+            try:
+                for row in rows:
+                    row = dict(row)
+                    row["router"] = {"replica": name}
+                    got_final = got_final or row.get("kind") == "final"
+                    yield row
+            except Exception as e:  # noqa: BLE001 — mid-stream death
+                rep.breaker.record_failure(e)
+                yield {"kind": "rejected", "ok": False,
+                       "rejected": "replica_unavailable",
+                       "retryable": True, "detail": repr(e)[:300],
+                       "router": {"replica": name}}
+                return
+            if got_final:
+                self._bump("completed")
+                with self._lock:
+                    rep.stats["completed"] += 1
+        finally:
+            release()
+
+    # -- lifecycle / introspection -------------------------------------------
+    def readyz(self):
+        """(status, payload): 200 iff at least one replica is ready."""
+        reps = {
+            name: {"ready": rep.ready,
+                   "breaker": rep.breaker.state(),
+                   "in_flight": rep.in_flight}
+            for name, rep in self._replicas.items()}
+        ready = any(v["ready"] and v["breaker"] != OPEN
+                    for v in reps.values())
+        return (200 if ready else 503), {
+            "ok": ready, "ready": ready, "replicas": reps}
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            stats = dict(self.stats)
+            per = {name: {"ready": rep.ready,
+                          "breaker": rep.breaker.snapshot(),
+                          "in_flight": rep.in_flight, **rep.stats}
+                   for name, rep in self._replicas.items()}
+        return {
+            "router": stats,
+            "replicas": per,
+            "ring": self.ring.members(),
+            **({"tenants": self.quotas.snapshot()}
+               if self.quotas is not None else {}),
+        }
+
+    def replica(self, name: str):
+        """The named replica's TRANSPORT (drills kill/revive through it)."""
+        return self._replicas[name].transport
+
+    def close(self, close_replicas: bool = True) -> None:
+        self._closed.set()
+        t = self._poll_thread
+        if t is not None and t.is_alive():
+            t.join(5.0)
+        if close_replicas:
+            for rep in self._replicas.values():
+                try:
+                    rep.transport.close()
+                except Exception:  # noqa: BLE001 — best-effort teardown
+                    pass
+
+
+# -- HTTP frontend ------------------------------------------------------------
+
+def make_router_http_server(router: ReplicaRouter, host: str = "127.0.0.1",
+                            port: int = 8080):
+    """The router's own stdlib HTTP frontend: same wire format as the
+    replica frontend (a client cannot tell a router from a replica,
+    except for the extra ``router`` stamp), plus router-level
+    ``/readyz``/``/stats``.  Tenant identity rides the ``x-tenant``
+    header or the ``tenant`` body field."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # noqa: D102
+            pass
+
+        def _send(self, status: int, payload: dict) -> None:
+            send_json(self, status, payload)
+
+        def do_GET(self):  # noqa: N802 — http.server API
+            if self.path == "/healthz":
+                self._send(200, {"ok": True, **router.snapshot()})
+            elif self.path == "/readyz":
+                self._send(*router.readyz())
+            elif self.path == "/stats":
+                self._send(200, router.snapshot())
+            elif self.path == "/metrics":
+                from parallel_convolution_tpu.serving.frontend import (
+                    metrics_text,
+                )
+
+                data = metrics_text().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            else:
+                self._send(404, {"ok": False, "detail": "unknown path"})
+
+        def do_POST(self):  # noqa: N802 — http.server API
+            if self.path not in ("/v1/convolve", "/v1/converge"):
+                # Drain the body first: under HTTP/1.1 keep-alive an
+                # unread body would be parsed as the NEXT request line.
+                drain_body(self)
+                self._send(404, {"ok": False, "detail": "unknown path"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
+            except (ValueError, json.JSONDecodeError) as e:
+                self._send(400, {"ok": False, "rejected": "invalid",
+                                 "detail": f"bad JSON body: {e}"})
+                return
+            tenant = self.headers.get("x-tenant")
+            if self.path == "/v1/converge":
+                status, rows = router.converge(body, tenant=tenant)
+                if status != 200:
+                    self._send(status, next(iter(rows)))
+                    return
+                send_ndjson_stream(self, rows)
+                return
+            self._send(*router.request(body, tenant=tenant))
+
+    return ThreadingHTTPServer((host, port), Handler)

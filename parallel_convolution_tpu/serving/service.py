@@ -36,7 +36,23 @@ from parallel_convolution_tpu.serving.batcher import MicroBatcher
 from parallel_convolution_tpu.serving.engine import EngineKey, WarmEngine
 from parallel_convolution_tpu.utils.tracing import PhaseTimer
 
-__all__ = ["ConvolutionService", "Rejected", "Request", "Response"]
+__all__ = ["ConvolutionService", "RETRYABLE_REJECTS", "Rejected",
+           "ReleasingStream", "Request", "Response", "Snapshot"]
+
+# The rejection reasons a client should BACK OFF AND RETRY (the condition
+# is transient server state: a full queue, a mesh reshape window, an
+# exhausted tenant bucket, a router with no live replica).  Everything
+# else — invalid, error, deadline, timeout — means the same request will
+# not fare better on a retry.  The frontend maps these to 429/503 with a
+# Retry-After header; scripts/loadgen.py honors them with capped backoff.
+RETRYABLE_REJECTS = frozenset(
+    {"queue_full", "resharding", "tenant_quota", "replica_unavailable"})
+
+# Default client back-off hints per retryable reason (seconds) — used
+# when the shed site doesn't compute a better one (the tenant bucket
+# computes its exact refill time).
+_RETRY_AFTER_DEFAULT = {"queue_full": 0.1, "resharding": 0.5,
+                        "tenant_quota": 1.0, "replica_unavailable": 0.5}
 
 
 @dataclasses.dataclass
@@ -64,7 +80,12 @@ class Request:
     #                                  RESOLVED value rides the key and
     #                                  every response stamps it
     deadline_s: float | None = None
-    request_id: str | None = None
+    request_id: str | None = None    # client-stamped idempotency id: a
+    #                                  hedged/retried submission with the
+    #                                  same id rides the FIRST one's slot
+    #                                  (one device execution per id)
+    tenant: str = ""                 # QoS identity (router token buckets;
+    #                                  "" = the default tenant)
 
 
 @dataclasses.dataclass
@@ -106,13 +127,87 @@ class Response:
 class Rejected:
     """A typed non-result: load shed, deadline miss, or failed execution."""
 
-    reason: str   # queue_full | deadline | invalid | error | resharding
+    reason: str   # queue_full | deadline | invalid | error | resharding |
+    #               tenant_quota | replica_unavailable | timeout
     request_id: str
     detail: str = ""
     trace_id: str = ""   # the request's causal trace id (when admitted
     #                      under an active trace; "" otherwise)
+    retry_after_s: float | None = None  # back-off hint for retryable
+    #                      sheds (the frontend's Retry-After header)
 
     ok = False
+
+    def __post_init__(self) -> None:
+        if self.retry_after_s is None:
+            # Every retryable rejection carries a back-off hint, however
+            # it was constructed (sites with better information — the
+            # tenant bucket's exact refill time — pass their own).
+            self.retry_after_s = _RETRY_AFTER_DEFAULT.get(self.reason)
+
+    @property
+    def retryable(self) -> bool:
+        """True iff a client should back off and retry this reason."""
+        return self.reason in RETRYABLE_REJECTS
+
+
+@dataclasses.dataclass
+class Snapshot:
+    """One progressive-convergence stream row: the best-so-far field.
+
+    A convergence job streams one of these per ``check_every``-iteration
+    chunk; the row with ``final=True`` carries the exact bytes a
+    non-progressive run of the same job would have returned (asserted in
+    ``tests/test_router.py``).  ``diff`` is the max-abs single-iteration
+    change the convergence decision reads — the stream IS the diff
+    trajectory, so a job that dies mid-run has still delivered its
+    best-so-far image plus the curve that says how converged it was.
+    """
+
+    image: np.ndarray                # uint8, same layout as the request
+    iters: int
+    diff: float
+    final: bool = False
+    converged: bool = False          # final=True only: diff < tol
+    request_id: str = ""
+    effective_backend: str = ""
+    effective_grid: str = ""
+    plan_key: str = ""
+    trace_id: str = ""
+
+    ok = True
+
+
+class ReleasingStream:
+    """Iterator over a stream of rows that calls ``release`` exactly
+    once when the stream ends, is closed, or is garbage-collected —
+    including when it was never started.  A plain generator can't do
+    that: its ``finally`` only runs once the body has been entered, so
+    an un-started, abandoned stream would pin its resource forever
+    (here: a ``max_progressive`` slot; in the router: a replica's
+    in-flight load count)."""
+
+    def __init__(self, gen, release):
+        self._gen = gen
+        self._release = release
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return next(self._gen)
+
+    def close(self) -> None:
+        try:
+            self._gen.close()
+        finally:
+            self._release()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
 
 
 class ConvolutionService:
@@ -129,7 +224,10 @@ class ConvolutionService:
     def __init__(self, mesh=None, *, capacity: int = 16,
                  max_batch: int = 8, max_delay_s: float = 0.005,
                  max_queue: int = 64, fallback: bool = True,
-                 retry_policy=None, start: bool = True, plans=None):
+                 retry_policy=None, start: bool = True, plans=None,
+                 dedup_capacity: int = 256, max_progressive: int = 2):
+        from collections import OrderedDict
+
         from parallel_convolution_tpu.resilience.retry import RetryPolicy
 
         self.engine = WarmEngine(mesh, capacity=capacity, fallback=fallback,
@@ -143,6 +241,25 @@ class ConvolutionService:
         self._lock = threading.Lock()
         self._reshape_lock = threading.Lock()
         self._reshaping = False
+        # request_id -> Slot: the idempotency ledger.  A hedged or
+        # router-retried submission with an already-seen CLIENT-stamped id
+        # joins the first submission's slot instead of executing again
+        # (one device execution per request_id — and at the router tier,
+        # one tenant-quota charge).  FIFO-bounded; a completed REJECTED
+        # entry is evicted on the next arrival so a genuine client retry
+        # after a shed re-executes.  NOTE the bound is by COUNT, not
+        # bytes: completed slots pin their Response images until evicted,
+        # so size dedup_capacity down for large-frame deployments
+        # (256 × a 3-channel 2048² response ≈ 3 GB worst case).
+        self.dedup_capacity = max(0, int(dedup_capacity))
+        self._dedup: OrderedDict[str, object] = OrderedDict()
+        self._dedup_lock = threading.Lock()
+        # Progressive convergence jobs bypass the micro-batcher (they are
+        # long, chunked, and fence per chunk) but are still bounded:
+        # at most this many run concurrently, beyond which submissions
+        # shed typed-retryable queue_full.
+        self.max_progressive = max(1, int(max_progressive))
+        self._progressive_active = 0
         # The legacy stats dict, now a view over the obs registry: every
         # write mirrors into pctpu_service_stats{key=...} (obs.metrics),
         # so the admission-control ledger is one /metrics scrape away.
@@ -153,7 +270,7 @@ class ConvolutionService:
             "rejected_queue_full": 0, "rejected_deadline": 0,
             "rejected_invalid": 0, "rejected_error": 0,
             "rejected_resharding": 0, "client_timeouts": 0,
-            "reshapes": 0,
+            "reshapes": 0, "deduped": 0, "progressive": 0,
         })
 
     # -- admission -----------------------------------------------------------
@@ -163,11 +280,13 @@ class ConvolutionService:
 
     def _shed(self, reason: str, rid: str, detail: str = "",
               counter: str | None = None, n: int = 1,
-              trace=None) -> Rejected:
+              trace=None, retry_after_s: float | None = None) -> Rejected:
         """One path for every typed rejection: the legacy counter bump,
         the admission event, and the Rejected value.  ``trace`` is the
         request's :class:`obs.trace.SpanContext` when it was admitted
-        under an active trace — the rejection then joins the tree."""
+        under an active trace — the rejection then joins the tree.
+        Retryable reasons carry a back-off hint (``retry_after_s``,
+        defaulted per reason) that the frontend turns into Retry-After."""
         if counter is not None:
             self._bump(counter, n)
         if obs_metrics.enabled():
@@ -180,8 +299,11 @@ class ConvolutionService:
                 detail=detail[:200],
                 **({"trace_id": trace.trace_id} if trace is not None
                    else {}))
+        # retry_after_s=None defers to Rejected.__post_init__'s
+        # per-reason default — one site owns the defaulting rule.
         return Rejected(reason, rid, detail=detail,
-                        trace_id=trace.trace_id if trace is not None else "")
+                        trace_id=trace.trace_id if trace is not None else "",
+                        retry_after_s=retry_after_s)
 
     def _validate(self, req: Request) -> tuple[EngineKey, str, np.ndarray]:
         """Terminal ValueError on any contract violation (→ ``invalid``).
@@ -227,9 +349,69 @@ class ConvolutionService:
         ``wait=True`` returns a :class:`Response` or :class:`Rejected`;
         ``wait=False`` returns the queue :class:`Slot` (or the immediate
         ``Rejected``) so callers can multiplex.
+
+        A CLIENT-stamped ``request_id`` is an idempotency key: a second
+        submission with the same id while the first is in flight (a
+        hedge) or completed (a router retry after a lost response) joins
+        the first one's slot — one device execution, one result, counted
+        in ``stats["deduped"]``.  A completed REJECTED outcome does NOT
+        stick: the retry after a shed re-executes.
         """
         rid = req.request_id or f"r{next(self._ids)}"
         self._bump("submitted")
+        placeholder = None
+        if req.request_id is not None and self.dedup_capacity:
+            from parallel_convolution_tpu.serving.batcher import Slot
+
+            with self._dedup_lock:
+                cached = self._dedup.get(rid)
+                if (cached is not None and cached.done()
+                        and isinstance(cached.result(0), Rejected)):
+                    # A shed/failed attempt: the retry is a fresh request.
+                    self._dedup.pop(rid, None)
+                    cached = None
+                if cached is None:
+                    placeholder = Slot()
+                    self._dedup[rid] = placeholder
+                    while len(self._dedup) > self.dedup_capacity:
+                        self._dedup.popitem(last=False)
+            if placeholder is None:
+                self._bump("deduped")
+                if not wait:
+                    return cached
+                result = cached.result(timeout)
+                if result is None:
+                    return self._shed("timeout", rid,
+                                      detail="client wait timed out",
+                                      counter="client_timeouts")
+                return result
+        outcome, root = self._admit(req, rid, placeholder)
+        if isinstance(outcome, Rejected):
+            if placeholder is not None:
+                with self._dedup_lock:
+                    self._dedup.pop(rid, None)
+                placeholder.set(outcome)
+            return outcome
+        if not wait:
+            return outcome
+        result = outcome.result(timeout)
+        if result is None:
+            # NOT a server-side shed: the caller gave up waiting while the
+            # request may still be executing (and will later count as
+            # completed).  Distinct reason + counter so an unresponsive
+            # service can never reconcile as healthy load shedding.
+            return self._shed("timeout", rid,
+                              detail="client wait timed out",
+                              counter="client_timeouts", trace=root)
+        return result
+
+    def _admit(self, req: Request, rid: str, slot=None):
+        """Validate + enqueue one request; returns ``(outcome, root)``
+        where outcome is the queue Slot or a typed Rejected and root the
+        request's trace context (so later sheds — the client-timeout
+        path — keep their trace linkage).  ``slot`` (the dedup
+        placeholder) becomes the item's slot so hedges that reserved it
+        rendezvous correctly."""
         # The request's causal root: the transport's `request` span when
         # one is active (frontend.InProcessClient / the HTTP handler),
         # else the admission span below becomes the root — either way a
@@ -249,13 +431,14 @@ class ConvolutionService:
                 return self._shed("resharding", rid,
                                   detail="mesh reshape in progress; retry",
                                   counter="rejected_resharding",
-                                  trace=root)
+                                  trace=root), root
             try:
                 key, plan_source, planar = self._validate(req)
             except Exception as e:  # noqa: BLE001 — typed contract errors
                 asp.set(outcome="invalid")
                 return self._shed("invalid", rid, detail=str(e),
-                                  counter="rejected_invalid", trace=root)
+                                  counter="rejected_invalid",
+                                  trace=root), root
             deadline_at = (time.monotonic() + req.deadline_s
                            if req.deadline_s is not None else None)
             payload = {"planar": planar, "rid": rid,
@@ -264,25 +447,15 @@ class ConvolutionService:
                        # The context the worker thread re-enters: queue
                        # span parent, batch-span link, response trace_id.
                        "trace": root}
-            slot = self.batcher.try_submit(key, payload, deadline_at)
-            if slot is None:
+            out_slot = self.batcher.try_submit(key, payload, deadline_at,
+                                               slot=slot)
+            if out_slot is None:
                 asp.set(outcome="queue_full")
                 return self._shed(
                     "queue_full", rid,
                     detail=f"queue depth >= {self.batcher.max_queue}",
-                    counter="rejected_queue_full", trace=root)
-        if not wait:
-            return slot
-        result = slot.result(timeout)
-        if result is None:
-            # NOT a server-side shed: the caller gave up waiting while the
-            # request may still be executing (and will later count as
-            # completed).  Distinct reason + counter so an unresponsive
-            # service can never reconcile as healthy load shedding.
-            return self._shed("timeout", rid,
-                              detail="client wait timed out",
-                              counter="client_timeouts", trace=root)
-        return result
+                    counter="rejected_queue_full", trace=root), root
+        return out_slot, root
 
     # -- execution (batcher worker thread) ------------------------------------
     def _execute_batch(self, key: EngineKey, items) -> None:
@@ -413,6 +586,148 @@ class ConvolutionService:
             obs_metrics.histogram(
                 "pctpu_batch_size", "co-batched requests per flush", (),
                 buckets=(1, 2, 4, 8, 16, 32, 64)).observe(len(live))
+
+    # -- progressive convergence ---------------------------------------------
+    def submit_progressive(self, req: Request, *, tol: float,
+                           max_iters: int, check_every: int = 10):
+        """Admit one progressive convergence job.
+
+        Returns an immediate :class:`Rejected` (invalid / resharding /
+        queue_full — the progressive-slot bound) or an ITERATOR of
+        :class:`Snapshot` rows, one per ``check_every``-iteration chunk,
+        ending with a ``final=True`` row whose image is byte-identical to
+        the non-progressive run.  A job that fails mid-stream ends with a
+        typed :class:`Rejected` row instead — AFTER the best-so-far
+        snapshots already streamed, which is the point: a long Jacobi job
+        interrupted by a fault or a mesh reshape has delivered its
+        best-so-far image plus the diff trajectory, not a timeout.
+
+        Progressive jobs bypass the micro-batcher (chunk fences make them
+        incompatible with co-batching) and are bounded by
+        ``max_progressive`` concurrent jobs; the convergence-chunk
+        executables are warm-cached on the engine entry like any other
+        key, so a stream of jobs for one config compiles once.
+        """
+        rid = req.request_id or f"r{next(self._ids)}"
+        self._bump("submitted")
+        parent = obs_trace.current()
+        root = parent
+        with obs_trace.span("admission", request_id=rid,
+                            backend=req.backend, progressive=True) as asp:
+            if root is None:
+                root = asp.context
+            asp.set(outcome="admitted")
+            if self._reshaping:
+                asp.set(outcome="resharding")
+                return self._shed("resharding", rid,
+                                  detail="mesh reshape in progress; retry",
+                                  counter="rejected_resharding", trace=root)
+            try:
+                tol, max_iters = float(tol), int(max_iters)
+                check_every = int(check_every)
+                if tol < 0 or max_iters < 1 or check_every < 1:
+                    raise ValueError(
+                        "tol >= 0, max_iters >= 1, check_every >= 1 "
+                        "required")
+                # The chunk program's compile identity is check_every
+                # iterations — that is what keys the warm entry.
+                key, _, planar = self._validate(
+                    dataclasses.replace(req, iters=check_every))
+            except Exception as e:  # noqa: BLE001 — typed contract errors
+                asp.set(outcome="invalid")
+                return self._shed("invalid", rid, detail=str(e),
+                                  counter="rejected_invalid", trace=root)
+            with self._lock:
+                # Decide under the lock, shed OUTSIDE it: _shed bumps
+                # counters through _bump, which takes this same
+                # (non-reentrant) lock.
+                slot_free = self._progressive_active < self.max_progressive
+                if slot_free:
+                    self._progressive_active += 1
+                    self.stats["progressive"] += 1
+            if not slot_free:
+                asp.set(outcome="queue_full")
+                return self._shed(
+                    "queue_full", rid,
+                    detail=f"progressive jobs >= {self.max_progressive}",
+                    counter="rejected_queue_full", trace=root)
+        release = self._progressive_release()
+        return ReleasingStream(
+            self._progressive_stream(req, rid, key, planar, tol,
+                                     max_iters, check_every, root, release),
+            release)
+
+    def _progressive_release(self):
+        """One idempotent slot-release closure per admitted job: called
+        by the stream generator's ``finally`` AND by the wrapper's
+        close/finalizer, whichever comes first."""
+        released: list = []
+
+        def release() -> None:
+            with self._lock:
+                if not released:
+                    released.append(True)
+                    self._progressive_active -= 1
+
+        return release
+
+    def _progressive_stream(self, req, rid, key, planar, tol, max_iters,
+                            check_every, root, release):
+        """The admitted job's generator (runs on the CONSUMER's thread)."""
+        from parallel_convolution_tpu.utils import imageio
+
+        rgb = np.asarray(req.image).ndim == 3
+        grid = f"{key.grid[0]}x{key.grid[1]}"
+        tid = root.trace_id if root is not None else ""
+
+        def to_u8(plane):
+            u8 = np.clip(np.rint(plane), 0.0, 255.0).astype(np.uint8)
+            return imageio.planar_to_interleaved(u8) if rgb else u8[0]
+
+        try:
+            try:
+                entry = self.engine.entry(key)
+            except Exception as e:  # noqa: BLE001 — typed, never a leak
+                yield self._shed("error", rid, detail=repr(e)[:300],
+                                 counter="rejected_error", trace=root)
+                return
+            with obs_trace.attach(root), obs_trace.span(
+                    "progressive", request_id=rid, backend=req.backend,
+                    check_every=check_every) as psp:
+                last_out, last = None, None
+                try:
+                    for out, done, diff in self.engine.run_converge(
+                            key, planar, tol=tol, max_iters=max_iters,
+                            check_every=check_every):
+                        last_out, last = out, (done, diff)
+                        yield Snapshot(
+                            image=to_u8(out), iters=done, diff=diff,
+                            request_id=rid,
+                            effective_backend=entry.effective_backend,
+                            effective_grid=grid, plan_key=entry.plan_key,
+                            trace_id=tid)
+                except Exception as e:  # noqa: BLE001 — typed stream end
+                    reason = ("resharding"
+                              if ("resharded" in str(e) or self._reshaping)
+                              else "error")
+                    psp.set(outcome=reason)
+                    yield self._shed(
+                        reason, rid, detail=repr(e)[:300],
+                        counter=f"rejected_{reason}", trace=root)
+                    return
+                converged = last is not None and last[1] < tol
+                psp.set(outcome="completed",
+                        iters=last[0] if last else 0, converged=converged)
+                yield Snapshot(
+                    image=to_u8(last_out), iters=last[0] if last else 0,
+                    diff=last[1] if last else 0.0, final=True,
+                    converged=converged, request_id=rid,
+                    effective_backend=entry.effective_backend,
+                    effective_grid=grid, plan_key=entry.plan_key,
+                    trace_id=tid)
+                self._bump("completed")
+        finally:
+            release()
 
     # -- elastic recovery ----------------------------------------------------
     def reshape(self, mesh) -> dict:

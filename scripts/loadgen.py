@@ -18,10 +18,19 @@ rejection counts.
   python scripts/loadgen.py --url http://127.0.0.1:8080 --n 200 --rate 50
 
 Exit status is 0 iff every request either completed or was shed with a
-TYPED rejection — a transport error, HTTP 5xx, or byte-size mismatch is
-a non-rejected failure and exits 1 (the ``run_t1.sh --serving-smoke``
-gate).  ``--check`` additionally byte-compares every completed response
-against the NumPy oracle.
+TYPED rejection — a transport error, HTTP 5xx terminal failure, or
+byte-size mismatch is a non-rejected failure and exits 1 (the
+``run_t1.sh --serving-smoke`` gate).  ``--check`` additionally
+byte-compares every completed response against the NumPy oracle.
+
+Round 14: RETRYABLE rejections (``retryable: true`` in the body —
+queue_full / resharding / tenant_quota / replica_unavailable) are
+honored with capped backoff (the body's ``retry_after_s``, else
+exponential) up to ``--shed-retries`` attempts instead of counting as
+final outcomes; the summary row reports ``rejected_retried``.  Multiple
+``--target`` URLs round-robin the request stream across a raw replica
+set, or point one ``--target`` at ``scripts/router.py`` — responses
+carrying a ``router`` stamp feed the row's ``failovers_observed``.
 """
 
 from __future__ import annotations
@@ -78,7 +87,13 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     tgt = ap.add_mutually_exclusive_group(required=True)
     tgt.add_argument("--url", default=None,
-                     help="HTTP frontend base URL (scripts/serve.py)")
+                     help="HTTP frontend base URL (scripts/serve.py); "
+                          "alias for a single --target")
+    tgt.add_argument("--target", action="append", default=None,
+                     metavar="URL",
+                     help="HTTP target base URL (repeatable: requests "
+                          "round-robin across a raw replica set, or give "
+                          "one router URL)")
     tgt.add_argument("--in-process", action="store_true",
                      help="build the service in this process (no sockets)")
     ap.add_argument("--n", type=int, default=50, help="total requests")
@@ -97,6 +112,14 @@ def main() -> int:
     ap.add_argument("--boundary", default="zero")
     ap.add_argument("--deadline-ms", type=float, default=None,
                     help="per-request latency budget (missed -> typed shed)")
+    ap.add_argument("--tenant", default=None,
+                    help="tenant identity stamped into every request "
+                         "(the router's QoS key)")
+    ap.add_argument("--shed-retries", type=int, default=4,
+                    help="max capped-backoff retries of a RETRYABLE "
+                         "rejection before accepting it as the outcome")
+    ap.add_argument("--backoff-cap-s", type=float, default=1.0,
+                    help="ceiling on one shed-retry backoff sleep")
     ap.add_argument("--timeout", type=float, default=120.0,
                     help="client-side wait per request")
     ap.add_argument("--seed", type=int, default=0, help="image seed")
@@ -137,7 +160,10 @@ def main() -> int:
     }
     if args.deadline_ms is not None:
         body["deadline_ms"] = args.deadline_ms
+    if args.tenant:
+        body["tenant"] = args.tenant
 
+    targets = args.target or ([args.url] if args.url else None)
     service = None
     if args.in_process:
         from parallel_convolution_tpu.obs import events as obs_events
@@ -158,13 +184,12 @@ def main() -> int:
             mesh, max_batch=args.max_batch,
             max_delay_s=args.max_delay_ms / 1e3, max_queue=args.max_queue)
         client = InProcessClient(service)
-        transport_request = (
-            lambda b: client.request(b, timeout=args.timeout))
+        transports = [lambda b: client.request(b, timeout=args.timeout)]
         transport_snapshot = service.snapshot
     else:
-        http = _HTTPTransport(args.url, args.timeout)
-        transport_request = http.request
-        transport_snapshot = http.snapshot
+        https = [_HTTPTransport(url, args.timeout) for url in targets]
+        transports = [h.request for h in https]
+        transport_snapshot = https[0].snapshot
 
     if args.warm and service is not None:
         service.warmup([{"rows": args.rows, "cols": args.cols,
@@ -183,15 +208,34 @@ def main() -> int:
 
     results = []                      # (index, latency_s, status, resp)
     results_lock = threading.Lock()
+    retried = [0]                     # capped-backoff shed retries issued
 
     def one_request(i: int) -> None:
+        # Round-robin across targets; request_id is stable across shed
+        # retries ON PURPOSE (it is the idempotency key — a retry that
+        # races a late completion dedups at the replica).
+        request = transports[i % len(transports)]
         b = dict(body, request_id=f"lg{i}")
         t0 = time.perf_counter()
         ts = time.time()
-        try:
-            status, resp = transport_request(b)
-        except Exception as e:  # noqa: BLE001 — a transport failure row
-            status, resp = -1, {"ok": False, "detail": repr(e)[:300]}
+        attempt = 0
+        while True:
+            try:
+                status, resp = request(b)
+            except Exception as e:  # noqa: BLE001 — a transport failure row
+                status, resp = -1, {"ok": False, "detail": repr(e)[:300]}
+            retryable = (not resp.get("ok") and resp.get("retryable")
+                         and resp.get("rejected") != "timeout")
+            if not retryable or attempt >= args.shed_retries:
+                break
+            # Honor the server's back-off hint, capped; else exponential.
+            attempt += 1
+            with results_lock:
+                retried[0] += 1
+            hint = resp.get("retry_after_s")
+            delay = (float(hint) if hint is not None
+                     else 0.05 * 2 ** (attempt - 1))
+            time.sleep(min(delay, args.backoff_cap_s))
         lat = time.perf_counter() - t0
         with results_lock:
             results.append((i, ts, lat, status, resp))
@@ -308,6 +352,17 @@ def main() -> int:
     grids = sorted({r.get("effective_grid", "") for _, r in completed})
     batch_sizes = [r.get("batch_size", 1) for _, r in completed]
     plan_keys = sorted({r.get("plan_key", "") for _, r in completed} - {""})
+    # Router-stamped responses make failovers CLIENT-observable: count
+    # requests that completed OFF their consistent-hash home (spilled
+    # past a dead/unready replica) or after a failed dispatch.
+    failovers_observed = sum(
+        1 for _, r in completed
+        if r.get("router", {}).get("failovers", 0) > 0
+        or (r.get("router", {}).get("replica")
+            and r.get("router", {}).get("home")
+            and r["router"]["replica"] != r["router"]["home"]))
+    replicas_seen = sorted({r.get("router", {}).get("replica", "")
+                            for _, r in completed} - {""})
 
     row = {
         "workload": (f"serve {args.filter_name} {args.rows}x{args.cols}"
@@ -326,6 +381,9 @@ def main() -> int:
                      else (plan_keys or "")),
         "completed": len(completed),
         "rejected": rejected,
+        "rejected_retried": retried[0],
+        "failovers_observed": failovers_observed,
+        **({"replicas_seen": replicas_seen} if replicas_seen else {}),
         "non_rejected_failures": non_rejected_failures,
         "wall_s": round(wall, 4),
         "p50_ms": round(1e3 * _percentile(lats, 0.50), 3) if lats else None,

@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Boot the replica-set router (serving/router.py) behind HTTP.
+
+Two deployment shapes, one wire format:
+
+  # N in-process replicas (each its own ConvolutionService + mesh) —
+  # the one-host / CPU-smoke shape:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \\
+    python scripts/router.py --port 8090 --replicas 3 --mesh 2x2 \\
+      --tenant-rate 50 --tenant-burst 16
+
+  # routing over already-running scripts/serve.py replicas:
+  python scripts/router.py --port 8090 \\
+      --target http://host-a:8080 --target http://host-b:8080
+
+  curl -s localhost:8090/readyz | python -m json.tool   # 200 iff any
+  #   replica is ready; per-replica breaker states in the payload
+  python scripts/loadgen.py --target http://127.0.0.1:8090 --n 200 ...
+
+Clients cannot tell the router from a replica (same ``/v1/convolve`` /
+``/v1/converge`` bodies) except for the extra ``router`` stamp in each
+response: the serving replica, the consistent-hash home, and the
+attempt/failover/spill counts.  Tenant identity rides the ``x-tenant``
+header or a ``tenant`` body field; ``--tenant-rate 0`` disables quota.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+
+import _path  # noqa: F401  (repo root + JAX_PLATFORMS re-apply)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8090,
+                    help="0 = pick a free port (printed on boot)")
+    ap.add_argument("--target", action="append", default=[], metavar="URL",
+                    help="HTTP replica base URL (repeatable; "
+                         "scripts/serve.py instances)")
+    ap.add_argument("--replicas", type=int, default=0, metavar="N",
+                    help="boot N in-process replicas instead of --target")
+    ap.add_argument("--mesh", default=None,
+                    help="RxC grid per in-process replica")
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. cpu) before init")
+    ap.add_argument("--plans", default=None, metavar="PLANS_JSON",
+                    help="tuner plan file for in-process replicas")
+    # Replica service knobs (in-process only):
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--max-delay-ms", type=float, default=5.0)
+    ap.add_argument("--max-queue", type=int, default=64)
+    # Router knobs:
+    ap.add_argument("--tenant-rate", type=float, default=0.0,
+                    help="per-tenant token refill rate (req/s); 0 = no "
+                         "tenant quota")
+    ap.add_argument("--tenant-burst", type=float, default=16.0,
+                    help="per-tenant bucket capacity")
+    ap.add_argument("--vnodes", type=int, default=64,
+                    help="virtual nodes per replica on the hash ring")
+    ap.add_argument("--breaker-threshold", type=int, default=3,
+                    help="consecutive failures that open a replica's "
+                         "circuit")
+    ap.add_argument("--breaker-cooldown-s", type=float, default=1.0)
+    ap.add_argument("--poll-interval-s", type=float, default=0.25,
+                    help="active /readyz health-poll period")
+    ap.add_argument("--load-factor", type=float, default=2.0,
+                    help="bounded-load spill: a replica carries at most "
+                         "this multiple of the fair in-flight share")
+    ap.add_argument("--hedge-ms", type=float, default=None,
+                    help="fire one extra attempt when the home replica "
+                         "hasn't answered within this budget (off by "
+                         "default)")
+    args = ap.parse_args()
+
+    if bool(args.target) == bool(args.replicas):
+        ap.error("exactly one of --target ... or --replicas N required")
+
+    if args.platform:
+        from parallel_convolution_tpu.utils.platform import force_platform
+
+        force_platform(args.platform, warn=True)
+
+    from parallel_convolution_tpu.obs import events as obs_events
+    from parallel_convolution_tpu.resilience import faults
+    from parallel_convolution_tpu.serving.router import (
+        HTTPReplica, InProcessReplica, ReplicaRouter, TenantQuotas,
+        make_router_http_server,
+    )
+
+    faults.install_from_env()
+    obs_events.install_from_env()
+
+    if args.target:
+        replicas = [HTTPReplica(url, name=f"r{i}")
+                    for i, url in enumerate(args.target)]
+    else:
+        from parallel_convolution_tpu.parallel.mesh import mesh_from_spec
+        from parallel_convolution_tpu.serving.service import (
+            ConvolutionService,
+        )
+        from parallel_convolution_tpu.utils.platform import (
+            enable_compile_cache,
+        )
+
+        enable_compile_cache()
+
+        def factory():
+            return ConvolutionService(
+                mesh_from_spec(args.mesh), max_batch=args.max_batch,
+                max_delay_s=args.max_delay_ms / 1e3,
+                max_queue=args.max_queue, plans=args.plans)
+
+        replicas = [InProcessReplica(factory, name=f"r{i}")
+                    for i in range(args.replicas)]
+
+    quotas = (TenantQuotas(args.tenant_rate, args.tenant_burst)
+              if args.tenant_rate > 0 else None)
+    router = ReplicaRouter(
+        replicas, quotas=quotas, vnodes=args.vnodes,
+        breaker_threshold=args.breaker_threshold,
+        breaker_cooldown_s=args.breaker_cooldown_s,
+        poll_interval_s=args.poll_interval_s,
+        load_factor=args.load_factor,
+        hedge_s=args.hedge_ms / 1e3 if args.hedge_ms else None)
+
+    server = make_router_http_server(router, args.host, args.port)
+    host, port = server.server_address[:2]
+    obs_events.emit("router", event="boot", url=f"http://{host}:{port}",
+                    replicas=[r.name for r in replicas])
+    print(json.dumps({"routing": f"http://{host}:{port}",
+                      "replicas": [r.name for r in replicas],
+                      "tenant_quota": bool(quotas)}), flush=True)
+
+    stopping = []
+
+    def _stop(signum, frame):
+        import threading
+
+        if stopping:
+            return
+        stopping.append(signum)
+        print(json.dumps({"stopping": signum,
+                          "final": router.snapshot()}), flush=True)
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGINT, _stop)
+    signal.signal(signal.SIGTERM, _stop)
+    try:
+        server.serve_forever()
+    finally:
+        server.server_close()
+        router.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,287 @@
+#!/usr/bin/env python
+"""Replica-router smoke: the ``run_t1.sh --router-smoke`` leg.
+
+Boot THREE in-process replicas behind ``serving.router.ReplicaRouter``
+(per-tenant token buckets armed), push 100 requests across 2 tenants —
+one polite, one greedy enough to overrun its bucket — kill one KEY-HOME
+replica mid-run, and assert the whole round-14 layer held together:
+
+1. **zero non-rejected failures** — every request either completed or
+   ended in a typed RETRYABLE rejection (client backoff honored, capped);
+2. every completed response **byte-identical to the NumPy oracle**;
+3. **>= 1 observed failover** — a request completed off its
+   consistent-hash home after the kill (the serve-through-failure gate);
+4. **tenant-quota sheds typed correctly** — the greedy tenant saw
+   ``rejected: tenant_quota`` with ``retryable: true`` + a
+   ``retry_after_s`` hint, and the polite tenant saw NONE (bucket
+   isolation);
+5. **warm caches partition** — before the kill, each of the distinct
+   compile keys is resident on EXACTLY ONE replica (consistent-hash
+   partitioning: no duplicate builds); after the kill + failover, a key
+   may appear on at most its home + one re-home.
+
+The summary row lands in ``--out`` (``evidence/router_smoke.json``, the
+supervisor leg's done_file) with ``"failures": 0`` iff every gate held,
+then feeds ``scripts/perf_gate.py`` against the smoke's OWN history file
+(seed + re-gate — NOT the committed ``evidence/perf_history.jsonl``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import _path  # noqa: F401  (repo root + JAX_PLATFORMS re-apply)
+
+SCRIPTS = Path(__file__).resolve().parent
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n", type=int, default=100)
+    ap.add_argument("--rows", type=int, default=48)
+    ap.add_argument("--cols", type=int, default=64)
+    ap.add_argument("--mesh", default="2x2", help="grid per replica")
+    ap.add_argument("--out", default="evidence/router_smoke.json")
+    ap.add_argument("--history",
+                    default="evidence/router_smoke_history.jsonl",
+                    help="the smoke's OWN perf history, seeded fresh each "
+                         "run; never point this at the committed "
+                         "evidence/perf_history.jsonl")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from parallel_convolution_tpu.obs import events as obs_events
+    from parallel_convolution_tpu.ops import filters, oracle
+    from parallel_convolution_tpu.parallel.mesh import mesh_from_spec
+    from parallel_convolution_tpu.serving.router import (
+        InProcessReplica, ReplicaRouter, TenantQuotas, route_key,
+    )
+    from parallel_convolution_tpu.serving.service import ConvolutionService
+    from parallel_convolution_tpu.utils import imageio
+
+    obs_events.install_from_env()
+    failures: list[str] = []
+    t0 = time.time()
+
+    img = imageio.generate_test_image(args.rows, args.cols, "grey", seed=7)
+    b64 = base64.b64encode(np.ascontiguousarray(img).tobytes()).decode()
+    iters_pool = [1, 2, 3]
+    oracles = {it: oracle.run_serial_u8(img, filters.get_filter("blur3"),
+                                        it) for it in iters_pool}
+
+    def factory():
+        return ConvolutionService(mesh_from_spec(args.mesh),
+                                  max_delay_s=0.002, max_queue=256)
+
+    replicas = [InProcessReplica(factory, name=f"r{i}") for i in range(3)]
+    # The greedy tenant's bucket is sized to overrun under this run's
+    # offered rate (it still completes via backoff); the polite tenant
+    # is unlimited — its gate is seeing ZERO quota sheds (isolation).
+    router = ReplicaRouter(
+        replicas,
+        quotas=TenantQuotas(rate=200.0, burst=16.0,
+                            overrides={"greedy": (25.0, 4.0),
+                                       "polite": (0.0, 1.0)}),
+        breaker_threshold=2, breaker_cooldown_s=0.2, poll_interval_s=0.05)
+
+    def body_for(i: int, tenant: str) -> dict:
+        return {"image_b64": b64, "rows": args.rows, "cols": args.cols,
+                "mode": "grey", "filter": "blur3",
+                "iters": iters_pool[i % len(iters_pool)],
+                "request_id": f"rs{i}", "tenant": tenant}
+
+    # ---- phase 1: warm the key space, then check cache partitioning.
+    # Distinct warm-phase request_ids: reusing rs0..rs2 would let the
+    # replica dedup ledger serve 3 of phase 2's measured requests from
+    # cache (zero-latency rows, a silently smaller real sample).
+    for it in iters_pool:
+        warm_body = dict(body_for(it - 1, "polite"),
+                         request_id=f"warm{it}")
+        status, wire = router.request(warm_body)
+        if not wire.get("ok"):
+            failures.append(f"warm request iters={it} failed: {wire}")
+    # Residency by iters: read each replica's resident keys directly —
+    # the consistent-hash partition gate (each key warm on EXACTLY one
+    # replica; compile counters cannot hide a duplicate build).
+    residency: dict[int, list[str]] = {it: [] for it in iters_pool}
+    for rep in replicas:
+        for key in rep.service.engine._entries:
+            residency[key.iters].append(rep.name)
+    partition_ok = all(len(v) == 1 for v in residency.values())
+    if not partition_ok:
+        failures.append(f"warm caches not partitioned: { {k: v for k, v in residency.items()} }")
+    homes = {it: router.ring.candidates(
+        route_key(body_for(it - 1, "polite")))[0] for it in iters_pool}
+    for it, owner in residency.items():
+        if owner and owner[0] != homes[it]:
+            failures.append(
+                f"key iters={it} resident on {owner[0]}, home {homes[it]}")
+
+    # ---- phase 2: 100 requests across 2 tenants, kill a home mid-run.
+    results, lock = [], threading.Lock()
+    counter = [0]
+
+    def one(i: int) -> None:
+        tenant = "greedy" if i % 2 else "polite"
+        body = body_for(i, tenant)
+        quota_shed = False
+        for attempt in range(5):
+            status, wire = router.request(dict(body))
+            if wire.get("rejected") == "tenant_quota":
+                quota_shed = True
+                if wire.get("retry_after_s") is None or not wire.get(
+                        "retryable"):
+                    with lock:
+                        results.append({"i": i, "ok": False,
+                                        "tenant": tenant,
+                                        "bad_quota_shape": True,
+                                        "wire": wire})
+                    return
+            if wire.get("ok") or not wire.get("retryable"):
+                break
+            time.sleep(min(float(wire.get("retry_after_s") or 0.02), 0.2))
+        it = iters_pool[i % len(iters_pool)]
+        byte_ok = None
+        if wire.get("ok"):
+            got = np.frombuffer(base64.b64decode(wire["image_b64"]),
+                                np.uint8).reshape(args.rows, args.cols)
+            byte_ok = bool(np.array_equal(got, oracles[it]))
+        with lock:
+            results.append({
+                "i": i, "ok": bool(wire.get("ok")), "tenant": tenant,
+                "byte_ok": byte_ok, "quota_shed_seen": quota_shed,
+                "rejected": wire.get("rejected"),
+                "retryable": wire.get("retryable"),
+                "router": wire.get("router", {}),
+            })
+
+    def traffic() -> None:
+        while True:
+            with lock:
+                i = counter[0]
+                if i >= args.n:
+                    return
+                counter[0] += 1
+            one(i)
+            time.sleep(0.005)   # pace: the stream must span the kill
+
+    workers = [threading.Thread(target=traffic, daemon=True)
+               for _ in range(4)]
+    for w in workers:
+        w.start()
+    time.sleep(0.5)
+    victim = homes[iters_pool[0]]
+    router.replica(victim).kill()
+    obs_events.emit("router", event="kill", replica=victim)
+    for w in workers:
+        w.join(600)
+    wall = time.time() - t0
+
+    completed = [r for r in results if r["ok"]]
+    byte_fails = [r for r in completed if not r["byte_ok"]]
+    non_rejected = [r for r in results
+                    if not r["ok"] and not r.get("retryable")
+                    and not r.get("bad_quota_shape")]
+    bad_quota = [r for r in results if r.get("bad_quota_shape")]
+    failovers = sum(
+        1 for r in completed
+        if r["router"].get("failovers", 0) > 0
+        or (r["router"].get("replica") and r["router"].get("home")
+            and r["router"]["replica"] != r["router"]["home"]))
+    greedy_quota_sheds = sum(1 for r in results
+                             if r["tenant"] == "greedy"
+                             and r.get("quota_shed_seen"))
+    polite_quota_sheds = sum(1 for r in results
+                             if r["tenant"] == "polite"
+                             and r.get("quota_shed_seen"))
+    snap = router.snapshot()
+
+    if byte_fails:
+        failures.append(f"{len(byte_fails)} oracle byte mismatches")
+    if non_rejected:
+        failures.append(
+            f"{len(non_rejected)} non-rejected failures, e.g. "
+            f"{non_rejected[0]}")
+    if bad_quota:
+        failures.append(
+            f"{len(bad_quota)} tenant_quota sheds missing retryable/"
+            "retry_after_s")
+    if failovers < 1:
+        failures.append("no failover observed despite a killed home")
+    if greedy_quota_sheds < 1:
+        failures.append("greedy tenant never hit its bucket")
+    if polite_quota_sheds:
+        failures.append(
+            f"polite tenant saw {polite_quota_sheds} quota sheds "
+            "(bucket isolation broken)")
+
+    # Post-kill residency: a key may live on at most home + one re-home.
+    post = {it: [] for it in iters_pool}
+    for rep in replicas:
+        if rep.service is None:
+            continue
+        for key in rep.service.engine._entries:
+            post[key.iters].append(rep.name)
+    for it, owners in post.items():
+        if len(owners) > 2:
+            failures.append(
+                f"key iters={it} resident on {len(owners)} replicas "
+                f"({owners}): duplicate builds beyond failover re-homing")
+
+    channels = 1
+    px = args.rows * args.cols * channels * sum(
+        iters_pool[r["i"] % len(iters_pool)] for r in completed)
+    row = {
+        "workload": f"router-smoke blur3 {args.rows}x{args.cols} "
+                    f"3 replicas kill-1",
+        "n": args.n,
+        "completed": len(completed),
+        "failovers_observed": failovers,
+        "tenant_quota_sheds_greedy": greedy_quota_sheds,
+        "tenant_quota_sheds_polite": polite_quota_sheds,
+        "partition_ok": partition_ok,
+        "residency_pre_kill": {str(k): v for k, v in residency.items()},
+        "residency_post_kill": {str(k): v for k, v in post.items()},
+        "killed": victim,
+        "router": snap["router"],
+        "effective_backend": "shifted",
+        "mesh": args.mesh,
+        "wall_s": round(wall, 3),
+        "gpixels_per_s": round(px / wall / 1e9, 6) if wall else None,
+        "failures": len(failures),
+        "failure_detail": failures[:6],
+    }
+    router.close()
+
+    # ---- perf sentry feed: seed the smoke's own history, then re-gate.
+    out = Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(row, indent=2))
+    hist = Path(args.history)
+    hist.parent.mkdir(parents=True, exist_ok=True)
+    hist.write_text("")   # the smoke's OWN history: truncate per run
+    gate = [sys.executable, str(SCRIPTS / "perf_gate.py"),
+            "--history", str(hist), "--row", str(out), "--quiet"]
+    rc_seed = subprocess.run([*gate, "--update"], check=False).returncode
+    rc_pass = subprocess.run(gate, check=False).returncode
+    if rc_seed != 0:
+        failures.append(f"perf_gate seed run exited {rc_seed}")
+    if rc_pass != 0:
+        failures.append(f"perf_gate re-gate exited {rc_pass}")
+    row["failures"] = len(failures)
+    row["failure_detail"] = failures[:8]
+    out.write_text(json.dumps(row, indent=2))
+    print(json.dumps(row), flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,6 +5,25 @@
 # Run from anywhere: resolves to the repo root first.
 #
 #   scripts/run_t1.sh                  the tier-1 pytest gate
+#   scripts/run_t1.sh --router-smoke   replica-set router end-to-end on the
+#                                      CPU mesh: 3 in-process replicas
+#                                      (2x2 each) behind the consistent-
+#                                      hash router with tenant quotas, 100
+#                                      requests across 2 tenants, one KEY-
+#                                      HOME replica killed mid-run.  Gates:
+#                                      zero non-rejected failures, every
+#                                      completed byte-identical to the
+#                                      oracle, >= 1 observed failover,
+#                                      greedy-tenant quota sheds typed
+#                                      retryable while the polite tenant
+#                                      sees none, warm caches partitioned
+#                                      (each key on exactly one replica
+#                                      pre-kill, <= home+1 after), and the
+#                                      summary row passes perf_gate.py
+#                                      against the smoke's own history.
+#                                      Row (failures: 0) lands in
+#                                      evidence/router_smoke.json (the
+#                                      supervisor leg's done_file).
 #   scripts/run_t1.sh --serving-smoke  boot the in-process serving stack on
 #                                      the 8-virtual-device CPU mesh, push
 #                                      50 loadgen requests, exit nonzero on
@@ -103,6 +122,14 @@ if [ "${1:-}" = "--tuning-smoke" ]; then
       --filter blur3 --iters 2 --mesh 2x4 --dry-run \
       --emit-plans --out evidence/tuning_smoke_plans.json \
       --verify-auto --summary-out evidence/tuning_smoke.json
+fi
+
+if [ "${1:-}" = "--router-smoke" ]; then
+  exec timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    PCTPU_OBS=1 \
+    python scripts/router_smoke.py --n 100 --rows 48 --cols 64 \
+      --mesh 2x2 --out evidence/router_smoke.json
 fi
 
 if [ "${1:-}" = "--serving-smoke" ]; then

@@ -45,6 +45,15 @@ lands the summary row in a file (the ``--elastic-smoke`` tier-1 leg's
 done_file):
 
   python scripts/soak.py --reshape 8 --seed 0
+
+``--router-kill N`` is the REPLICA-ROUTER drill (round 14): boot three
+in-process replicas behind ``serving.router.ReplicaRouter``, push
+continuous traffic while killing and reviving one replica per cycle
+(N cycles), and require ZERO non-rejected failures with every completed
+response byte-identical to the oracle — the serve-through-any-single-
+replica-failure property, plus at least one client-observed failover:
+
+  python scripts/soak.py --router-kill 3 --seed 0
 """
 
 from __future__ import annotations
@@ -332,6 +341,156 @@ def run_serve_trial(spec: str, seed: int, out_path: str) -> int:
     return 0 if ok else 1
 
 
+def run_router_kill(args) -> int:
+    """Kill/revive drill: 3 in-process replicas behind the router.
+
+    Traffic threads push oracle-checked requests (a few distinct compile
+    keys, so routing exercises multiple ring points) while the killer
+    thread cycles through replicas: kill → keep serving → revive.  The
+    gates, in order of importance:
+
+    1. zero non-rejected failures (retryable sheds are re-driven with
+       capped backoff, mirroring loadgen's client contract);
+    2. every completed response byte-identical to the NumPy oracle;
+    3. with ``N >= 1`` kill cycles, at least one observed failover
+       (a request served off its consistent-hash home after a failure).
+    """
+    import threading
+
+    import numpy as np
+
+    from parallel_convolution_tpu.ops import filters, oracle
+    from parallel_convolution_tpu.parallel.mesh import mesh_from_spec
+    from parallel_convolution_tpu.serving.router import (
+        InProcessReplica, ReplicaRouter,
+    )
+    from parallel_convolution_tpu.serving.service import ConvolutionService
+    from parallel_convolution_tpu.utils import imageio
+    import base64
+
+    n_cycles = args.router_kill
+    rng = random.Random(args.seed)
+    img = imageio.generate_test_image(40, 56, "grey", seed=args.seed)
+    b64 = base64.b64encode(np.ascontiguousarray(img).tobytes()).decode()
+    # Distinct iteration counts = distinct compile keys = distinct ring
+    # points: the kill must be able to hit a key's home replica.
+    iters_pool = [1, 2, 3]
+    oracles = {it: oracle.run_serial_u8(img, filters.get_filter("blur3"), it)
+               for it in iters_pool}
+
+    def factory():
+        return ConvolutionService(mesh_from_spec("2x2"),
+                                  max_delay_s=0.002, max_queue=256)
+
+    replicas = [InProcessReplica(factory, name=f"r{i}") for i in range(3)]
+    router = ReplicaRouter(replicas, breaker_threshold=2,
+                           breaker_cooldown_s=0.2, poll_interval_s=0.05)
+    n_requests = 40 + 20 * n_cycles
+    results, lock = [], threading.Lock()
+    stop = threading.Event()
+
+    def body_for(i: int) -> dict:
+        return {"image_b64": b64, "rows": 40, "cols": 56, "mode": "grey",
+                "filter": "blur3", "iters": iters_pool[i % len(iters_pool)],
+                "request_id": f"rk{i}"}
+
+    def one(i: int) -> None:
+        it = iters_pool[i % len(iters_pool)]
+        body = body_for(i)
+        for attempt in range(6):
+            status, wire = router.request(dict(body), tenant="drill")
+            if wire.get("ok") or not wire.get("retryable"):
+                break
+            time.sleep(min(float(wire.get("retry_after_s") or 0.05), 0.5))
+        ok = bool(wire.get("ok"))
+        byte_ok = None
+        if ok:
+            got = np.frombuffer(base64.b64decode(wire["image_b64"]),
+                                np.uint8).reshape(40, 56)
+            byte_ok = bool(np.array_equal(got, oracles[it]))
+        with lock:
+            results.append({
+                "i": i, "ok": ok, "byte_ok": byte_ok,
+                "rejected": wire.get("rejected"),
+                "retryable": wire.get("retryable"),
+                "router": wire.get("router", {}),
+            })
+
+    # The kill must be able to CAUSE a failover: victims are the
+    # consistent-hash HOME replicas of the live keys, not random picks.
+    from parallel_convolution_tpu.serving.router import route_key
+
+    homes = []
+    for it in iters_pool:
+        cands = router.ring.candidates(route_key(body_for(it)))
+        if cands and cands[0] not in homes:
+            homes.append(cands[0])
+
+    def traffic() -> None:
+        while not stop.is_set():
+            with lock:
+                i = counter[0]
+                if i >= n_requests:
+                    return
+                counter[0] += 1
+            one(i)
+            time.sleep(0.01)   # pace: traffic must span the kill cycles
+
+    counter = [0]
+    workers = [threading.Thread(target=traffic, daemon=True)
+               for _ in range(4)]
+    for w in workers:
+        w.start()
+
+    kills = []
+    for cycle in range(n_cycles):
+        time.sleep(0.4)
+        victim = homes[cycle % len(homes)]
+        router.replica(victim).kill()
+        kills.append(victim)
+        time.sleep(0.4)
+        router.replica(victim).revive()
+    for w in workers:
+        w.join(300)
+    stop.set()
+    router.close()
+
+    completed = [r for r in results if r["ok"]]
+    byte_fails = [r for r in completed if not r["byte_ok"]]
+    non_rejected = [r for r in results
+                    if not r["ok"] and not r.get("retryable")]
+    # A failover, client-observed: the request completed OFF its
+    # consistent-hash home (the dead replica's keys re-homed) or the
+    # router reported a failed dispatch before success.
+    failovers = sum(
+        1 for r in completed
+        if r["router"].get("failovers", 0) > 0
+        or (r["router"].get("replica") and r["router"].get("home")
+            and r["router"]["replica"] != r["router"]["home"]))
+    failures = len(byte_fails) + len(non_rejected)
+    if n_cycles >= 1 and failovers < 1:
+        # the drill exists to prove serve-through-failure: a run where
+        # no kill was ever observed proves nothing — fail it loudly.
+        failures += 1
+    summary = {
+        "summary": "router-kill", "n": n_requests, "cycles": n_cycles,
+        "seed": args.seed, "kills": kills,
+        "completed": len(completed),
+        "final_retryable_sheds": sum(1 for r in results
+                                     if not r["ok"] and r.get("retryable")),
+        "failovers_observed": failovers,
+        "byte_mismatches": len(byte_fails),
+        "non_rejected_failures": len(non_rejected),
+        "failures": failures,
+    }
+    if args.summary_out:
+        p = Path(args.summary_out)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(json.dumps(summary) + "\n")
+    print(json.dumps(summary), flush=True)
+    return 1 if failures else 0
+
+
 RESHAPE_TARGETS = [(1, 2), (2, 2), (1, 1)]
 
 
@@ -549,6 +708,12 @@ def main() -> int:
                          "resume-on-1x2,2x2,1x1 reshard drills through "
                          "the supervised runner; every resumed output "
                          "must byte-match the single-device oracle")
+    ap.add_argument("--router-kill", type=int, default=0, metavar="N",
+                    help="replica-router drill: 3 in-process replicas "
+                         "behind the router, N kill/revive cycles under "
+                         "continuous traffic; gates on zero non-rejected "
+                         "failures, byte-identical results, and >= 1 "
+                         "observed failover")
     ap.add_argument("--summary-out", default=None, metavar="FILE",
                     help="also write the final summary row to FILE "
                          "(the tier-1 --elastic-smoke leg's done_file)")
@@ -580,6 +745,8 @@ def main() -> int:
         ap.error("--serve requires --faults N")
     if args.reshape and args.faults:
         ap.error("--reshape and --faults are separate modes")
+    if args.router_kill:
+        return run_router_kill(args)
     if args.faults or args.reshape:
         return run_fault_soak(args)
 

@@ -1,0 +1,529 @@
+"""Replica-set router: ring, breaker, quota, failover, progressive.
+
+The round-14 acceptance properties (ISSUE 9), all on the 8-virtual-device
+CPU mesh:
+
+* consistent-hash stability — adding/removing one replica remaps only
+  that replica's keys;
+* circuit breaker walks closed → open → half-open → closed
+  deterministically (injected clock), and a request's own contract bug
+  never opens a replica's circuit;
+* hedge dedup — two submissions with one request_id cost ONE device
+  execution (engine batch/compile counters flat);
+* tenant bucket isolation — a greedy tenant sheds typed retryable
+  ``tenant_quota`` while another tenant's stream completes untouched;
+* the progressive stream ends with the EXACT final image bytes of the
+  equivalent non-progressive run;
+* serve-through-reshape — the router keeps serving (spill + retryable
+  sheds only) while one replica walks the round-10 reshape ladder.
+"""
+
+from __future__ import annotations
+
+import base64
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from parallel_convolution_tpu.ops import filters, oracle
+from parallel_convolution_tpu.parallel import mesh as mesh_lib, step
+from parallel_convolution_tpu.resilience import degrade, faults
+from parallel_convolution_tpu.resilience.breaker import (
+    CLOSED, HALF_OPEN, OPEN, CircuitBreaker,
+)
+from parallel_convolution_tpu.serving.frontend import encode_response
+from parallel_convolution_tpu.serving.router import (
+    HashRing, InProcessReplica, ReplicaRouter, TenantQuotas, TokenBucket,
+    route_key,
+)
+from parallel_convolution_tpu.serving.service import (
+    ConvolutionService, Rejected, Request, Snapshot,
+)
+from parallel_convolution_tpu.utils import imageio
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_state():
+    yield
+    faults.uninstall_plan()
+    degrade.clear_probe_cache()
+
+
+def _mesh(shape=(1, 2)):
+    return mesh_lib.make_grid_mesh(jax.devices()[: shape[0] * shape[1]],
+                                   shape)
+
+
+def _img(rows=32, cols=48, seed=5):
+    return imageio.generate_test_image(rows, cols, "grey", seed=seed)
+
+
+def _body(img, **kw):
+    body = {"image_b64": base64.b64encode(
+        np.ascontiguousarray(img).tobytes()).decode("ascii"),
+        "rows": img.shape[0], "cols": img.shape[1], "mode": "grey"}
+    body.update(kw)
+    return body
+
+
+def _factory(shape=(1, 2), **kw):
+    kw.setdefault("max_delay_s", 0.002)
+
+    def make():
+        return ConvolutionService(_mesh(shape), **kw)
+
+    return make
+
+
+def _router(n=2, shape=(1, 2), **kw):
+    kw.setdefault("poll_interval_s", 0.05)
+    kw.setdefault("breaker_cooldown_s", 0.2)
+    reps = [InProcessReplica(_factory(shape), name=f"r{i}")
+            for i in range(n)]
+    return ReplicaRouter(reps, **kw)
+
+
+# ----------------------------------------------------------- hash ring
+
+
+def test_ring_remaps_only_touched_replica_keys():
+    keys = [f"key-{i}" for i in range(240)]
+    ring = HashRing(["a", "b", "c"])
+    before = {k: ring.candidates(k)[0] for k in keys}
+    assert set(before.values()) == {"a", "b", "c"}  # all replicas used
+
+    # Removal: every key NOT homed on c keeps its home.
+    ring.remove("c")
+    after_rm = {k: ring.candidates(k)[0] for k in keys}
+    for k in keys:
+        if before[k] != "c":
+            assert after_rm[k] == before[k]
+        else:
+            assert after_rm[k] in ("a", "b")
+
+    # Addition: keys either keep their home or move to the NEW member.
+    ring.add("c")
+    restored = {k: ring.candidates(k)[0] for k in keys}
+    assert restored == before  # same membership -> same mapping
+    ring.add("d")
+    after_add = {k: ring.candidates(k)[0] for k in keys}
+    for k in keys:
+        assert after_add[k] in (before[k], "d")
+    assert any(after_add[k] == "d" for k in keys)
+
+
+def test_ring_candidates_cover_all_members_home_first():
+    ring = HashRing(["a", "b", "c"], vnodes=16)
+    order = ring.candidates("some-key")
+    assert sorted(order) == ["a", "b", "c"]
+    assert order[0] == ring.candidates("some-key")[0]  # deterministic
+
+
+def test_route_key_covers_compile_identity_not_content():
+    img = _img()
+    b1 = _body(img, filter="blur3", iters=2)
+    b2 = _body(_img(seed=99), filter="blur3", iters=2)   # other CONTENT
+    b3 = _body(img, filter="blur3", iters=3)             # other key
+    assert route_key(b1) == route_key(b2)
+    assert route_key(b1) != route_key(b3)
+
+
+# ------------------------------------------------------ circuit breaker
+
+
+def test_breaker_walks_closed_open_halfopen_closed():
+    clock = [0.0]
+    br = CircuitBreaker(threshold=3, cooldown_s=5.0,
+                        clock=lambda: clock[0])
+    assert br.state() == CLOSED and br.allow()
+    for _ in range(2):
+        br.record_failure(ConnectionError("down"))
+    assert br.state() == CLOSED          # below threshold
+    br.record_success()
+    br.record_failure(ConnectionError("down"))
+    assert br.state() == CLOSED          # success reset the streak
+    for _ in range(3):
+        br.record_failure(ConnectionError("down"))
+    assert br.state() == OPEN
+    assert not br.allow()                # cooling down
+    clock[0] += 5.0
+    assert br.allow()                    # the half-open probe slot
+    assert br.state() == HALF_OPEN
+    assert not br.allow()                # one probe at a time
+    br.record_failure(ConnectionError("still down"))
+    assert br.state() == OPEN            # probe failed -> re-open
+    clock[0] += 5.0
+    assert br.allow()
+    br.record_success()
+    assert br.state() == CLOSED and br.allow()
+
+
+def test_breaker_ignores_terminal_classified_failures():
+    br = CircuitBreaker(threshold=1, cooldown_s=5.0)
+    br.record_failure(ValueError("the request's own contract bug"))
+    assert br.state() == CLOSED
+    br.record_failure(ConnectionError("replica down"))
+    assert br.state() == OPEN
+
+
+# -------------------------------------------------------- token buckets
+
+
+def test_token_bucket_refills_on_wall_clock():
+    clock = [0.0]
+    b = TokenBucket(rate=2.0, burst=2.0, clock=lambda: clock[0])
+    assert b.try_take()[0] and b.try_take()[0]
+    ok, retry_after = b.try_take()
+    assert not ok and retry_after == pytest.approx(0.5)
+    clock[0] += 0.5
+    assert b.try_take()[0]               # one token refilled
+    b.refund()
+    assert b.try_take()[0]               # refund restored it
+
+
+def test_tenant_buckets_are_isolated():
+    clock = [0.0]
+    q = TenantQuotas(rate=1.0, burst=1.0, clock=lambda: clock[0])
+    assert q.take("greedy")[0]
+    assert not q.take("greedy")[0]       # greedy's bucket is empty
+    for _ in range(3):
+        ok, _ = q.take("victim")
+        clock[0] += 1.0
+        assert ok                        # victim's bucket untouched
+
+
+# ---------------------------------------------- frontend reject semantics
+
+
+@pytest.mark.parametrize("reason,status,retryable", [
+    ("queue_full", 429, True),
+    ("tenant_quota", 429, True),
+    ("resharding", 503, True),
+    ("replica_unavailable", 503, True),
+    ("deadline", 429, False),
+    ("invalid", 400, False),
+    ("error", 500, False),
+    ("timeout", 504, False),
+])
+def test_reject_status_and_retryable_split(reason, status, retryable):
+    rej = Rejected(reason, "rq1", detail="x")
+    got_status, wire = encode_response(rej)
+    assert got_status == status
+    assert wire["retryable"] is retryable
+    if retryable:
+        assert wire["retry_after_s"] > 0   # the back-off hint
+    else:
+        assert "retry_after_s" not in wire
+
+
+# ------------------------------------------------------- request dedup
+
+
+def test_hedge_dedup_one_device_execution_per_request_id():
+    svc = ConvolutionService(_mesh(), max_delay_s=0.02)
+    img = _img()
+    req = Request(image=img, iters=2, request_id="hedge-1")
+    results = []
+    lock = threading.Lock()
+
+    def submit():
+        r = svc.submit(req, timeout=120)
+        with lock:
+            results.append(r)
+
+    threads = [threading.Thread(target=submit) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300)
+    assert len(results) == 4 and all(r.ok for r in results)
+    want = oracle.run_serial_u8(img, filters.get_filter("blur3"), 2)
+    for r in results:
+        np.testing.assert_array_equal(r.image, want)
+    # One device execution, one image: the four hedges shared one slot.
+    assert svc.engine.stats["images"] == 1
+    assert svc.engine.stats["batches"] == 1
+    assert svc.stats["deduped"] == 3
+    compiles = svc.engine.stats["compiles"]
+    # A later duplicate (completed entry) is served from the ledger with
+    # ZERO additional device work or compilation.
+    r = svc.submit(req, timeout=120)
+    assert r.ok and svc.engine.stats["images"] == 1
+    assert svc.engine.stats["compiles"] == compiles
+    svc.close()
+
+
+def test_dedup_rejected_outcome_does_not_stick():
+    svc = ConvolutionService(_mesh(), max_delay_s=0.02)
+    bad = Request(image=_img(), filter_name="nope", request_id="rid-x")
+    r1 = svc.submit(bad, timeout=60)
+    assert isinstance(r1, Rejected) and r1.reason == "invalid"
+    good = Request(image=_img(), iters=1, request_id="rid-x")
+    r2 = svc.submit(good, timeout=120)
+    assert r2.ok   # the retry after a shed re-executed
+    svc.close()
+
+
+# ------------------------------------------------- routing and failover
+
+
+def test_router_partitions_keys_and_serves_oracle_bytes():
+    router = _router(n=2)
+    img = _img()
+    want = {it: oracle.run_serial_u8(img, filters.get_filter("blur3"), it)
+            for it in (1, 2)}
+    homes = {}
+    for it in (1, 2):
+        for _ in range(2):
+            status, wire = router.request(
+                _body(img, filter="blur3", iters=it))
+            assert status == 200 and wire["ok"], wire
+            got = np.frombuffer(base64.b64decode(wire["image_b64"]),
+                                np.uint8).reshape(img.shape)
+            np.testing.assert_array_equal(got, want[it])
+            homes.setdefault(it, wire["router"]["replica"])
+            # same key -> same replica, every time
+            assert wire["router"]["replica"] == homes[it]
+            assert wire["router"]["home"] == homes[it]
+    # each key resident on exactly the one replica that serves it
+    for it, home in homes.items():
+        for name in ("r0", "r1"):
+            resident = [k.iters for k in router.replica(
+                name).service.engine._entries]
+            assert (it in resident) == (name == home)
+    router.close()
+
+
+def test_router_failover_on_killed_home_byte_identical():
+    router = _router(n=3)
+    img = _img()
+    body = _body(img, filter="blur3", iters=2)
+    want = oracle.run_serial_u8(img, filters.get_filter("blur3"), 2)
+    status, wire = router.request(dict(body))
+    assert status == 200 and wire["ok"]
+    home = wire["router"]["replica"]
+    router.replica(home).kill()
+    status, wire = router.request(dict(body))
+    assert status == 200 and wire["ok"], wire
+    assert wire["router"]["replica"] != home
+    assert (wire["router"]["failovers"] >= 1
+            or wire["router"]["spills"] >= 1)
+    got = np.frombuffer(base64.b64decode(wire["image_b64"]),
+                        np.uint8).reshape(img.shape)
+    np.testing.assert_array_equal(got, want)
+    # Revived home takes its keys back (ring membership never changed).
+    router.replica(home).revive()
+    router.poll_once()
+    status, wire = router.request(dict(body))
+    assert status == 200 and wire["router"]["replica"] == home
+    router.close()
+
+
+def test_router_all_replicas_down_typed_unavailable():
+    router = _router(n=2)
+    for name in ("r0", "r1"):
+        router.replica(name).kill()
+    status, wire = router.request(_body(_img(), iters=1))
+    assert status == 503
+    assert wire["rejected"] == "replica_unavailable"
+    assert wire["retryable"] is True and wire["retry_after_s"] > 0
+    router.close()
+
+
+def test_router_tenant_isolation_greedy_cannot_shed_victim():
+    router = _router(
+        n=2, quotas=TenantQuotas(rate=1.0, burst=2.0,
+                                 overrides={"victim": (0.0, 1.0)}))
+    img = _img()
+    body = _body(img, filter="blur3", iters=1)
+    greedy_sheds = 0
+    for _ in range(6):
+        status, wire = router.request(dict(body), tenant="greedy")
+        if not wire.get("ok"):
+            assert wire["rejected"] == "tenant_quota", wire
+            assert wire["retryable"] is True
+            assert wire["retry_after_s"] > 0
+            assert status == 429
+            greedy_sheds += 1
+    assert greedy_sheds >= 3   # burst 2, refill 1/s: the flood sheds
+    for _ in range(4):         # ...and the victim never notices
+        status, wire = router.request(dict(body), tenant="victim")
+        assert status == 200 and wire["ok"], wire
+    assert router.stats["rejected_tenant_quota"] == greedy_sheds
+    router.close()
+
+
+def test_router_readyz_reflects_replica_states():
+    router = _router(n=2)
+    router.poll_once()
+    status, payload = router.readyz()
+    assert status == 200 and payload["ready"]
+    assert set(payload["replicas"]) == {"r0", "r1"}
+    router.replica("r0").kill()
+    router.replica("r1").kill()
+    router.poll_once()
+    status, payload = router.readyz()
+    assert status == 503 and not payload["ready"]
+    router.close()
+
+
+# --------------------------------------------------- progressive results
+
+
+def test_progressive_stream_ends_with_exact_final_bytes():
+    svc = ConvolutionService(_mesh((2, 2)), max_delay_s=0.002)
+    img = _img(40, 56, seed=3)
+    tol, max_iters, check_every = 0.05, 45, 10
+    stream = svc.submit_progressive(
+        Request(image=img, filter_name="jacobi3", quantize=False),
+        tol=tol, max_iters=max_iters, check_every=check_every)
+    rows = list(stream)
+    assert all(isinstance(r, Snapshot) for r in rows)
+    assert rows[-1].final and not any(r.final for r in rows[:-1])
+    # the diff trajectory is monotone non-increasing for this smoother
+    diffs = [r.diff for r in rows[:-1]]
+    assert diffs == sorted(diffs, reverse=True)
+    # exact final bytes: the non-progressive run of the same job
+    x = imageio.interleaved_to_planar(img).astype(np.float32)
+    want, want_iters = step.sharded_converge(
+        x, filters.get_filter("jacobi3"), tol=tol, max_iters=max_iters,
+        check_every=check_every, mesh=svc.engine.mesh, quantize=False)
+    want_u8 = np.clip(np.rint(np.asarray(want)), 0,
+                      255).astype(np.uint8)[0]
+    assert rows[-1].iters == int(want_iters)
+    np.testing.assert_array_equal(rows[-1].image, want_u8)
+    # a second job on the warm key compiles nothing new
+    compiles = svc.engine.stats["compiles"]
+    rows2 = list(svc.submit_progressive(
+        Request(image=img, filter_name="jacobi3", quantize=False),
+        tol=tol, max_iters=max_iters, check_every=check_every))
+    assert rows2[-1].final
+    np.testing.assert_array_equal(rows2[-1].image, want_u8)
+    assert svc.engine.stats["compiles"] == compiles
+    svc.close()
+
+
+def test_progressive_through_router_and_invalid_typed():
+    router = _router(n=2, shape=(2, 2))
+    img = _img(40, 56, seed=3)
+    cbody = _body(img, filter="jacobi3", tol=0.05, max_iters=30,
+                  check_every=10)
+    status, rows = router.converge(dict(cbody))
+    rows = list(rows)
+    assert status == 200
+    kinds = [r["kind"] for r in rows]
+    assert kinds[-1] == "final" and "snapshot" in kinds
+    assert all(r["router"]["replica"] == rows[0]["router"]["replica"]
+               for r in rows)
+    # malformed: typed invalid, not a stream — and NOT replica-health
+    # evidence: the client's own contract bug must count no failover
+    # and feed no breaker (same taxonomy as the request path).
+    failovers_before = router.stats["failovers"]
+    status, rows = router.converge(
+        _body(img, filter="jacobi3", tol="not-a-number"))
+    rows = list(rows)
+    assert status == 400 and rows[0]["rejected"] == "invalid"
+    assert router.stats["failovers"] == failovers_before
+    assert all(rep.breaker.state() == "closed"
+               and rep.breaker.snapshot()["failures"] == 0
+               for rep in router._replicas.values())
+    router.close()
+
+
+def test_progressive_slot_released_when_stream_dropped_unstarted():
+    """An admitted stream abandoned before its first row must free its
+    max_progressive slot (a plain generator's finally never runs if the
+    body is never entered) — via close() and via the GC finalizer."""
+    svc = ConvolutionService(_mesh(), max_delay_s=0.002,
+                             max_progressive=1)
+    img = _img()
+
+    def job():
+        return svc.submit_progressive(
+            Request(image=img, filter_name="jacobi3", quantize=False),
+            tol=1e-6, max_iters=20, check_every=10)
+
+    s1 = job()
+    assert not isinstance(s1, Rejected)
+    s1.close()                          # dropped un-started, explicitly
+    s2 = job()
+    assert not isinstance(s2, Rejected)  # the slot came back
+    del s2                               # dropped un-started, via GC
+    import gc
+
+    gc.collect()
+    s3 = job()
+    assert not isinstance(s3, Rejected)
+    assert list(s3)[-1].final            # and a real run still works
+    svc.close()
+
+
+def test_progressive_bounded_and_resharding_typed():
+    svc = ConvolutionService(_mesh(), max_delay_s=0.002,
+                             max_progressive=1)
+    img = _img()
+    stream1 = svc.submit_progressive(
+        Request(image=img, filter_name="jacobi3", quantize=False),
+        tol=1e-6, max_iters=30, check_every=10)
+    assert not isinstance(stream1, Rejected)
+    next(iter_ := iter(stream1))          # job 1 occupies the only slot
+    r = svc.submit_progressive(
+        Request(image=img, filter_name="jacobi3", quantize=False),
+        tol=1e-6, max_iters=30, check_every=10)
+    assert isinstance(r, Rejected) and r.reason == "queue_full"
+    assert r.retryable
+    list(iter_)                           # drain job 1, slot frees
+    r2 = svc.submit_progressive(
+        Request(image=img, filter_name="jacobi3", quantize=False),
+        tol=1e-6, max_iters=10, check_every=10)
+    assert not isinstance(r2, Rejected)
+    list(r2)
+    svc.close()
+
+
+# ------------------------------------------------- serve-through-reshape
+
+
+def test_router_serves_through_replica_reshape():
+    router = _router(n=2, shape=(2, 2))
+    img = _img()
+    body = _body(img, filter="blur3", iters=2)
+    want = oracle.run_serial_u8(img, filters.get_filter("blur3"), 2)
+    status, wire = router.request(dict(body))
+    assert status == 200
+    home = wire["router"]["replica"]
+
+    stop = threading.Event()
+    outcomes, lock = [], threading.Lock()
+
+    def traffic():
+        while not stop.is_set():
+            s, w = router.request(dict(body))
+            with lock:
+                outcomes.append(w)
+
+    t = threading.Thread(target=traffic, daemon=True)
+    t.start()
+    # The round-10 ladder mid-traffic: drain, swap 2x2 -> 1x2, re-warm.
+    info = router.replica(home).service.reshape("1x2")
+    assert info["grid"] == (1, 2)
+    stop.set()
+    t.join(120)
+    # Post-reshape the router still serves this key, byte-identically.
+    status, wire = router.request(dict(body))
+    assert status == 200 and wire["ok"]
+    got = np.frombuffer(base64.b64decode(wire["image_b64"]),
+                        np.uint8).reshape(img.shape)
+    np.testing.assert_array_equal(got, want)
+    # Everything during the window either completed byte-identical or
+    # shed typed-retryable (resharding spill paths) — never an error.
+    for w in outcomes:
+        if w.get("ok"):
+            got = np.frombuffer(base64.b64decode(w["image_b64"]),
+                                np.uint8).reshape(img.shape)
+            np.testing.assert_array_equal(got, want)
+        else:
+            assert w.get("retryable"), w
+    router.close()

@@ -606,6 +606,28 @@ def test_http_frontend_over_loopback():
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/healthz", timeout=30) as resp:
             assert __import__("json").loads(resp.read())["ok"]
+        # keep-alive regression: a 404'd POST must DRAIN its body —
+        # under HTTP/1.1 an unread body would be parsed as the next
+        # request line, corrupting a valid request reusing the
+        # connection.
+        import http.client
+        json_mod = __import__("json")
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        try:
+            conn.request("POST", "/nope",
+                         body=json_mod.dumps(_wire_body(img)).encode(),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 404
+            resp.read()
+            conn.request("POST", "/v1/convolve",
+                         body=json_mod.dumps(_wire_body(img)).encode(),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 200
+            assert json_mod.loads(resp.read())["ok"]
+        finally:
+            conn.close()
     finally:
         server.shutdown()
         server.server_close()
