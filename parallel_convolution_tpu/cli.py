@@ -127,7 +127,11 @@ def _resolve_perf_knobs(args, mesh) -> None:
         if args.backend is None:
             args.backend = "pallas_sep"
         if args.storage is None:
-            args.storage = "bf16"
+            # Multigrid carries signed float residual/correction fields
+            # (mg_converge rejects anything but f32) — --fast only
+            # upgrades storage for the plain iterate/jacobi paths.
+            args.storage = ("f32" if getattr(args, "solver", "jacobi")
+                            == "multigrid" else "bf16")
         if args.fuse is None:
             R, C = grid_shape(mesh)
             block = min(-(-args.rows // R), -(-args.cols // C))
@@ -160,7 +164,7 @@ def _mesh_from_flag(spec: str | None):
 
 def main(argv: list[str] | None = None) -> int:
     from parallel_convolution_tpu.resilience import faults
-    from parallel_convolution_tpu.utils.config import BOUNDARIES
+    from parallel_convolution_tpu.utils.config import BOUNDARIES, SOLVERS
     from parallel_convolution_tpu.utils.platform import apply_platform_env
 
     apply_platform_env()
@@ -180,6 +184,14 @@ def main(argv: list[str] | None = None) -> int:
                           "or periodic torus wrap")
     run.add_argument("--converge", type=float, default=None, metavar="TOL",
                      help="run to convergence (loops becomes max iters)")
+    run.add_argument("--solver", default="jacobi", choices=list(SOLVERS),
+                     help="convergence strategy (with --converge): plain "
+                          "jacobi sweeps, or the geometric multigrid "
+                          "V-cycle (same stopping measure, orders of "
+                          "magnitude fewer fine-grid work units)")
+    run.add_argument("--mg-levels", type=int, default=None, metavar="N",
+                     help="multigrid level-count cap (default: coarsen "
+                          "to the planner's floor)")
     run.add_argument("--check-every", type=int, default=10)
     run.add_argument("--sharded-io", action="store_true",
                      help="block-stream the image between disk and devices "
@@ -358,23 +370,48 @@ def main(argv: list[str] | None = None) -> int:
 
     mesh = _mesh_from_flag(args.mesh)
     _resolve_perf_knobs(args, mesh)
+    if args.solver != "jacobi" and args.converge is None:
+        print(f"--solver {args.solver} requires --converge TOL: without "
+              "it the run is a fixed-count iterate and the solver choice "
+              "would be silently ignored", file=sys.stderr)
+        return 2
+    if args.solver == "multigrid" and args.storage != "f32":
+        print(f"--solver multigrid requires --storage f32 (got "
+              f"{args.storage}): residual/correction fields need full "
+              "float carries", file=sys.stderr)
+        return 2
     if args.converge is not None:
+        mg = args.solver == "multigrid"
         solver = JacobiSolver(
             filt=args.filter_name, tol=args.converge, max_iters=args.loops,
             check_every=args.check_every, mesh=mesh, backend=args.backend,
-            quantize=True, fuse=args.fuse, tile=tile,
+            # Multigrid carries signed float residual/correction fields —
+            # the u8 store-back would clamp the error equation (typed
+            # ValueError in mg_converge); jacobi keeps the historical
+            # quantized semantics.
+            quantize=not mg, fuse=args.fuse, tile=tile,
             boundary=args.boundary, storage=args.storage,
             interior_split=args.interior_split, overlap=args.overlap,
+            solver=args.solver, mg_levels=args.mg_levels,
         )
         img = imageio.read_raw(args.image, args.rows, args.cols, args.mode)
         x = imageio.interleaved_to_planar(img).astype(np.float32)
         out, iters = solver.solve(x)
         imageio.write_raw(
             args.output,
-            imageio.planar_to_interleaved(out.astype(np.uint8)),
+            imageio.planar_to_interleaved(
+                np.clip(np.rint(out), 0, 255).astype(np.uint8)),
         )
-        print(f"converged after {iters} iters (tol {args.converge}) "
-              f"-> {args.output}")
+        if mg and solver.last_mg is not None:
+            res = solver.last_mg
+            print(f"converged after {res.cycles} V-cycles "
+                  f"({res.work_units} fine-grid work units, "
+                  f"{res.levels} levels {res.level_shapes}, "
+                  f"residual {res.residual:.3g}, tol {args.converge}) "
+                  f"-> {args.output}")
+        else:
+            print(f"converged after {iters} iters (tol {args.converge}) "
+                  f"-> {args.output}")
         return 0
 
     model = ConvolutionModel(filt=args.filter_name, mesh=mesh,

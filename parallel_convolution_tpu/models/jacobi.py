@@ -44,8 +44,24 @@ class JacobiSolver:
     #                ConvolutionModel; fused chunks only)
     overlap: bool | None = None  # interior-first overlapped halo pipeline
     #                (see ConvolutionModel; resolved in sharded_converge)
+    solver: str = "jacobi"  # convergence strategy (utils.config.SOLVERS):
+    #                "jacobi" = the reference's sweep loop; "multigrid" =
+    #                the geometric V-cycle (solvers.multigrid) — same
+    #                stopping measure, ~orders of magnitude fewer
+    #                fine-grid work units on smooth problems
+    mg_levels: int | None = None  # multigrid level-count cap (None =
+    #                coarsen to the planner's floor); ignored for jacobi
+    last_mg: object = dataclasses.field(default=None, repr=False,
+                                        compare=False)  # the MGResult of
+    #                the most recent multigrid solve (cycles, work_units,
+    #                per-level grids) — None until one runs
 
     def __post_init__(self) -> None:
+        from parallel_convolution_tpu.utils.config import SOLVERS
+
+        if self.solver not in SOLVERS:
+            raise ValueError(
+                f"solver must be one of {SOLVERS}, got {self.solver!r}")
         if isinstance(self.filt, str):
             self.filt = get_filter(self.filt)
         if self.mesh is None:
@@ -61,7 +77,25 @@ class JacobiSolver:
         return self
 
     def solve(self, x) -> tuple[np.ndarray, int]:
-        """(C, H, W) f32 field → (smoothed field, iterations run)."""
+        """(C, H, W) f32 field → (solved field, work count).
+
+        The work count is solver-shaped: Jacobi iterations run, or
+        V-cycles run for ``solver="multigrid"`` (whose full accounting —
+        fine-grid ``work_units``, the per-level schedule — lands in
+        ``self.last_mg``, an :class:`solvers.multigrid.MGResult`).
+        """
+        if self.solver == "multigrid":
+            from parallel_convolution_tpu.solvers import multigrid
+
+            out, res = multigrid.mg_converge(
+                x, self.filt, tol=self.tol, max_iters=self.max_iters,
+                mesh=self.mesh, quantize=self.quantize,
+                backend=self.backend, storage=self.storage,
+                boundary=self.boundary, fuse=self.fuse, tile=self.tile,
+                overlap=self.overlap, mg_levels=self.mg_levels,
+            )
+            self.last_mg = res
+            return np.asarray(out), res.cycles
         out, iters = step_lib.sharded_converge(
             x, self.filt, tol=self.tol, max_iters=self.max_iters,
             check_every=self.check_every, mesh=self.mesh,

@@ -240,8 +240,14 @@ def record_step(*, backend: str, grid, block_hw, radius: int, fuse: int,
                 iters: int, channels: int, storage: str, boundary: str,
                 wall_s: float | None, shape, quantize: bool = True,
                 tile=None, platform: str = "cpu", device_kind: str = "",
-                source: str = "step", overlap: bool = False) -> dict | None:
+                source: str = "step", overlap: bool = False,
+                mg_level: int | None = None) -> dict | None:
     """Record one compiled-iterate call: wall, halo bytes, exchange split.
+
+    ``mg_level`` (round 15) attributes the call to one multigrid grid
+    level: the exchange event carries the level and the sweep counter
+    gains the ``pctpu_mg_level`` label, so per-level exchange/compute
+    cost is a label filter away (level 0 = the fine grid).
 
     ``wall_s=None`` means the caller dispatched asynchronously and has no
     honest device wall (``iterate_prepared`` — fencing there would
@@ -281,6 +287,14 @@ def record_step(*, backend: str, grid, block_hw, radius: int, fuse: int,
         hbytes.inc(by[d], backend=backend, direction=d)
     rounds.inc(by["rounds"], backend=backend)
     iters_m.inc(iters, backend=backend)
+    if mg_level is not None:
+        # Per-level multigrid attribution: sweeps executed at each grid
+        # level, labeled so one series shows where cycle time goes.
+        metrics.counter(
+            "pctpu_mg_sweeps_total",
+            "multigrid smoothing sweeps executed per grid level",
+            ("backend", "pctpu_mg_level")).inc(
+            iters, backend=backend, pctpu_mg_level=str(int(mg_level)))
     events.emit(
         "exchange", source=source, backend=backend,
         grid=f"{grid[0]}x{grid[1]}", block=list(block_hw),
@@ -290,6 +304,7 @@ def record_step(*, backend: str, grid, block_hw, radius: int, fuse: int,
         exchange_fraction=round(frac, 4),
         overlap=bool(split["overlap"]),
         exchange_hidden_fraction=round(hidden_of_ex, 4),
+        **({"mg_level": int(mg_level)} if mg_level is not None else {}),
         **({"wall_s": round(wall_s, 6)} if wall_s is not None else {}))
     # Trace attribution (round 13): when this step runs under an active
     # span (the serving device span, a traced converge call), split the

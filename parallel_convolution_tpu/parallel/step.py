@@ -37,7 +37,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from parallel_convolution_tpu.obs import events as obs_events, metrics as obs_metrics
 from parallel_convolution_tpu.ops import conv
 from parallel_convolution_tpu.ops.filters import Filter
-from parallel_convolution_tpu.parallel import halo
+from parallel_convolution_tpu.parallel import halo, kernels as kernel_forms
 from parallel_convolution_tpu.parallel.mesh import (
     AXES,
     block_sharding,
@@ -163,7 +163,9 @@ def resolve_overlap(overlap: bool | None, backend: str, mesh: Mesh) -> bool:
     """
     if overlap is None or not overlap:
         return False
-    if backend != "pallas_rdma":
+    if not kernel_forms.overlap_capable(backend):
+        # The per-form capability bit (kernel registry): only forms that
+        # REGISTER an overlapped pipeline may keep the request.
         _warn_overlap_once(
             f"backend:{backend}",
             f"overlap=True requested but backend {backend!r} has no "
@@ -191,13 +193,61 @@ def _axis_class_index(a, n: int):
     return jnp.where(a == 0, 0, jnp.where(a == n - 1, 2, 1)).astype(jnp.int32)
 
 
-def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
-                     backend: str, fuse: int = 1, boundary: str = "zero",
+def _boundary_geometry(grid, valid_hw, block_hw, boundary: str):
+    """Shared geometry checks of every rank-2 form: periodic divisibility
+    and whether the pad-to-multiple rim needs re-masking."""
+    periodic = boundary == "periodic"
+    if periodic and (valid_hw[0] != block_hw[0] * grid[0]
+                     or valid_hw[1] != block_hw[1] * grid[1]):
+        raise ValueError(
+            "periodic boundary requires dimensions divisible by the mesh "
+            f"grid: image {valid_hw} on grid {grid}"
+        )
+    needs_mask = not periodic and (valid_hw[0] != block_hw[0] * grid[0]
+                                   or valid_hw[1] != block_hw[1] * grid[1])
+    return periodic, needs_mask
+
+
+def _build_rdma_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
+                     fuse: int = 1, boundary: str = "zero",
                      tile: tuple[int, int] | None = None,
                      interpret: bool | None = None,
                      interior_split: bool = False,
                      overlap: bool = False):
-    """``fuse`` iterations on a local block per halo exchange.
+    """The ``pallas_rdma`` kernel form: exchange + stencil fused in ONE
+    kernel (remote DMA over ICI instead of collective-permute +
+    concatenate + re-read).  fuse=T>1 widens the in-kernel exchange to
+    T*r-deep ghosts and runs T levels before returning — the kernel
+    re-zeroes out-of-image positions per level against valid_hw, so the
+    outer mask is only needed on the single-level path.  The only form
+    registered ``overlap_capable`` (the interior-first pipeline)."""
+    periodic, needs_mask = _boundary_geometry(grid, valid_hw, block_hw,
+                                              boundary)
+
+    def step(v):
+        from parallel_convolution_tpu.ops import pallas_rdma
+
+        p = pallas_rdma.fused_rdma_step(
+            v, filt, grid, boundary, quantize=quantize,
+            out_dtype=v.dtype, tile=tile, interpret=interpret,
+            fuse=fuse, valid_hw=None if periodic else tuple(valid_hw),
+            overlap=overlap,
+        )
+        if needs_mask and fuse == 1:
+            p = p * _valid_mask(valid_hw, block_hw).astype(p.dtype)
+        return p
+
+    return step
+
+
+def _build_halo_step(backend: str, filt: Filter, grid, valid_hw, block_hw,
+                     quantize: bool, fuse: int = 1, boundary: str = "zero",
+                     tile: tuple[int, int] | None = None,
+                     interpret: bool | None = None,
+                     interior_split: bool = False,
+                     overlap: bool = False):
+    """The halo-exchange kernel forms (every backend but ``pallas_rdma``):
+    ``fuse`` iterations on a local block per collective halo exchange.
 
     fuse=1 is the reference's loop shape: exchange 1-deep halos, stencil,
     [quantize], re-mask.  fuse=T>1 is temporal fusion: exchange a T*r-deep
@@ -212,18 +262,9 @@ def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
     quantized u8 values, half the HBM/ICI traffic); accumulation is always
     f32 inside the correlate implementations.
     """
-    periodic = boundary == "periodic"
-    if periodic and (valid_hw[0] != block_hw[0] * grid[0]
-                     or valid_hw[1] != block_hw[1] * grid[1]):
-        raise ValueError(
-            "periodic boundary requires dimensions divisible by the mesh "
-            f"grid: image {valid_hw} on grid {grid}"
-        )
-    needs_mask = not periodic and (valid_hw[0] != block_hw[0] * grid[0]
-                                   or valid_hw[1] != block_hw[1] * grid[1])
+    periodic, needs_mask = _boundary_geometry(grid, valid_hw, block_hw,
+                                              boundary)
     r = filt.radius
-
-    rdma = backend == "pallas_rdma"
     pallas_like = backend in ("pallas", "pallas_sep")
     sep = backend == "pallas_sep"
 
@@ -235,30 +276,12 @@ def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
                 p, filt, quantize=quantize, out_dtype=out_dtype,
                 separable=sep, tile=tile, interpret=interpret,
             )
-        out = _correlate_for_backend(backend)(p, filt)
+        out = _XLA_CORRELATES[backend](p, filt)
         if quantize:
             out = conv.quantize_f32(out)
         return out
 
     def step(v):
-        if rdma:
-            # Exchange + stencil fused in ONE kernel (remote DMA over ICI
-            # instead of collective-permute + concatenate + re-read).
-            # fuse=T>1 widens the in-kernel exchange to T*r-deep ghosts
-            # and runs T levels before returning — the kernel re-zeroes
-            # out-of-image positions per level against valid_hw, so the
-            # outer mask is only needed on the single-level path.
-            from parallel_convolution_tpu.ops import pallas_rdma
-
-            p = pallas_rdma.fused_rdma_step(
-                v, filt, grid, boundary, quantize=quantize,
-                out_dtype=v.dtype, tile=tile, interpret=interpret,
-                fuse=fuse, valid_hw=None if periodic else tuple(valid_hw),
-                overlap=overlap,
-            )
-            if needs_mask and fuse == 1:
-                p = p * _valid_mask(valid_hw, block_hw).astype(p.dtype)
-            return p
         depth = r * fuse
         fault_point("halo_exchange")  # trace-time: a launch-build failure
         p = halo.halo_exchange(v, depth, grid, boundary)
@@ -310,6 +333,28 @@ def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
         return p.astype(v.dtype)
 
     return step
+
+
+def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
+                     backend: str, fuse: int = 1, boundary: str = "zero",
+                     tile: tuple[int, int] | None = None,
+                     interpret: bool | None = None,
+                     interior_split: bool = False,
+                     overlap: bool = False):
+    """One smoothing-step builder, dispatched through the kernel-form
+    registry (``parallel.kernels``): ``(rank=2, backend, boundary)``
+    resolves to the registered form, whose ``build`` returns the
+    per-block step function.  Unknown backends/boundaries fail HERE with
+    the registry's ValueError naming what exists — the old if-ladder's
+    error surface, now covering every registered stencil form."""
+    form = kernel_forms.resolve(2, backend, boundary)
+    if form.stencil_form != "smooth":
+        raise ValueError(
+            f"kernel form {backend!r} is a {form.stencil_form} operator, "
+            "not a smoother; transfer operators are driven by "
+            "solvers.multigrid, not the iterate path")
+    return form.build(filt, grid, valid_hw, block_hw, quantize, fuse,
+                      boundary, tile, interpret, interior_split, overlap)
 
 
 def _mesh_interpret(mesh: Mesh) -> bool:
@@ -545,16 +590,6 @@ if tuple(STORAGE_DTYPES) != _STORAGES:  # not assert: must survive python -O
         f"storage registries drifted: {tuple(STORAGE_DTYPES)} != {_STORAGES}")
 
 
-def _correlate_for_backend(backend: str):
-    if backend == "shifted":
-        return conv.correlate_padded
-    if backend == "xla_conv":
-        return _correlate_padded_xla
-    if backend == "separable":
-        return conv.correlate_padded_separable
-    raise ValueError(f"unknown backend {backend!r}; choose from {BACKENDS}")
-
-
 def _correlate_padded_xla(padded: jnp.ndarray, filt: Filter) -> jnp.ndarray:
     r = filt.radius
     lhs = padded.astype(jnp.float32)[:, None, :, :]
@@ -564,6 +599,38 @@ def _correlate_padded_xla(padded: jnp.ndarray, filt: Filter) -> jnp.ndarray:
         precision=lax.Precision.HIGHEST,
     )
     return out[:, 0]
+
+
+# The pure-XLA correlate implementations, keyed by form name (consumed by
+# _build_halo_step; the old _correlate_for_backend ladder).
+_XLA_CORRELATES = {
+    "shifted": conv.correlate_padded,
+    "xla_conv": _correlate_padded_xla,
+    "separable": conv.correlate_padded_separable,
+}
+
+
+def _register_smoother_forms() -> None:
+    """Install the six historical backends as rank-2 smoother forms.
+
+    This IS the old if-ladder, stated once as data: each backend name
+    maps to its builder, and ``pallas_rdma`` alone declares the
+    overlapped-pipeline capability bit (the knowledge the three
+    per-call-site clamps used to re-derive by string comparison)."""
+    from functools import partial
+
+    from parallel_convolution_tpu.utils.config import BOUNDARIES
+
+    for name in BACKENDS:
+        kernel_forms.register(kernel_forms.KernelForm(
+            name=name, rank=2, stencil_form="smooth",
+            boundaries=tuple(BOUNDARIES),
+            overlap_capable=(name == "pallas_rdma"),
+            build=(_build_rdma_step if name == "pallas_rdma"
+                   else partial(_build_halo_step, name))))
+
+
+_register_smoother_forms()
 
 
 # Module-scope so jit's function-identity cache holds: a per-call lambda
@@ -776,7 +843,7 @@ def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
                                     interior_split,
                                     storage=_storage_name(xs.dtype),
                                     block_hw=block_hw, overlap=overlap)
-        overlap = overlap and backend == "pallas_rdma"
+        overlap = kernel_forms.clamp_overlap(overlap, backend)
     fn = _build_iterate(mesh, filt, iters, quantize, tuple(valid_hw),
                         block_hw, backend, fuse, boundary, _norm_tile(tile),
                         interior_split, overlap)
@@ -843,14 +910,35 @@ def sharded_converge(x, filt: Filter, tol: float, max_iters: int,
                      fuse: int | None = 1,
                      tile: tuple[int, int] | None = None,
                      interior_split: bool = False, fallback: bool = False,
-                     overlap: bool | None = None):
+                     overlap: bool | None = None, solver: str = "jacobi",
+                     mg_levels: int | None = None):
     """Run-to-convergence (BASELINE config 5).  Returns (result, iters_run).
 
     ``fuse``/``tile`` mirror :func:`sharded_iterate`: fused chunks run
     between convergence checks (any fuse ≥ 1, any check_every), so config
     5 rides the same optimized kernels as the fixed-count path — including
     ``fallback=True`` backend degradation.
+
+    ``solver="multigrid"`` dispatches to the geometric V-cycle
+    (``solvers.multigrid.mg_converge``, lazily imported — the solver
+    package imports this module): the returned count is then V-CYCLES
+    run, ``max_iters`` bounds fine-grid work units, and ``check_every``
+    is ignored (the cycle is the check cadence).  Same stopping measure
+    either way: the max-abs change of one fine-grid sweep.
     """
+    if solver == "multigrid":
+        from parallel_convolution_tpu.solvers import multigrid
+
+        out, res = multigrid.mg_converge(
+            x, filt, tol=tol, max_iters=max_iters, mesh=mesh,
+            quantize=quantize, backend=backend, storage=storage,
+            boundary=boundary, fuse=fuse, tile=tile, fallback=fallback,
+            overlap=overlap, mg_levels=mg_levels)
+        return out, res.cycles
+    if solver != "jacobi":
+        from parallel_convolution_tpu.utils.config import SOLVERS
+
+        raise ValueError(f"solver must be one of {SOLVERS}, got {solver!r}")
     if mesh is None:
         mesh = make_grid_mesh()
     _check_storage(storage, quantize)
@@ -865,7 +953,7 @@ def sharded_converge(x, filt: Filter, tol: float, max_iters: int,
                                     boundary, _norm_tile(tile),
                                     interior_split, storage,
                                     block_hw=block_hw, overlap=overlap)
-        overlap = overlap and backend == "pallas_rdma"
+        overlap = kernel_forms.clamp_overlap(overlap, backend)
     _check_quantize_contract(xs, filt, quantize)
     fn = _build_converge(mesh, filt, float(tol), int(max_iters),
                          int(check_every), quantize, valid_hw, block_hw,
@@ -904,7 +992,9 @@ def sharded_converge_stream(x, filt: Filter, tol: float, max_iters: int,
                             tile: tuple[int, int] | None = None,
                             interior_split: bool = False,
                             fallback: bool = False,
-                            overlap: bool | None = None):
+                            overlap: bool | None = None,
+                            solver: str = "jacobi",
+                            mg_levels: int | None = None):
     """Progressive run-to-convergence: a generator over snapshot chunks.
 
     Yields ``(image, iters_done, diff)`` after every ``check_every``-sized
@@ -918,7 +1008,27 @@ def sharded_converge_stream(x, filt: Filter, tol: float, max_iters: int,
     observable, which is the point: a serving tier can stream best-so-far
     results out of a long job instead of holding an all-or-nothing
     deadline).
+
+    ``solver="multigrid"`` yields one snapshot per V-CYCLE instead
+    (``iters_done`` counts cycles; ``max_iters`` bounds fine-grid work
+    units); callers that need the work-unit accounting per row use
+    ``solvers.multigrid.mg_converge_stream`` directly, which this
+    delegates to.
     """
+    if solver == "multigrid":
+        from parallel_convolution_tpu.solvers import multigrid
+
+        for out, cycles, residual, _wu in multigrid.mg_converge_stream(
+                x, filt, tol=tol, max_iters=max_iters, mesh=mesh,
+                quantize=quantize, backend=backend, storage=storage,
+                boundary=boundary, fuse=fuse, tile=tile, fallback=fallback,
+                overlap=overlap, mg_levels=mg_levels):
+            yield (out, cycles, residual)
+        return
+    if solver != "jacobi":
+        from parallel_convolution_tpu.utils.config import SOLVERS
+
+        raise ValueError(f"solver must be one of {SOLVERS}, got {solver!r}")
     if mesh is None:
         mesh = make_grid_mesh()
     _check_storage(storage, quantize)
@@ -933,7 +1043,7 @@ def sharded_converge_stream(x, filt: Filter, tol: float, max_iters: int,
                                     boundary, _norm_tile(tile),
                                     interior_split, storage,
                                     block_hw=block_hw, overlap=overlap)
-        overlap = overlap and backend == "pallas_rdma"
+        overlap = kernel_forms.clamp_overlap(overlap, backend)
     _check_quantize_contract(xs, filt, quantize)
     check_every, max_iters = int(check_every), int(max_iters)
     done, diff = 0, float("inf")

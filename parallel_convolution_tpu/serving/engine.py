@@ -53,7 +53,7 @@ from parallel_convolution_tpu.obs import (
 )
 from parallel_convolution_tpu.ops.filters import get_filter
 from parallel_convolution_tpu.utils.config import (
-    BACKENDS, BOUNDARIES, STORAGES,
+    BACKENDS, BOUNDARIES, SOLVERS, STORAGES,
 )
 from parallel_convolution_tpu.utils.tracing import PhaseTimer
 
@@ -85,6 +85,14 @@ class EngineKey:
     #                                  halo pipeline knob (resolve_key
     #                                  settles None/auto before keying, so
     #                                  equal executables share one key)
+    solver: str = "jacobi"           # convergence strategy (SOLVERS):
+    #                                  "multigrid" keys the V-cycle's
+    #                                  compiled level programs (converge
+    #                                  jobs only — the batch path is
+    #                                  solver-less and rejects it)
+    mg_levels: int | None = None     # multigrid level-count cap (part of
+    #                                  the compile identity: it changes
+    #                                  the level schedule)
 
     def validate(self) -> None:
         """Terminal (ValueError) on any out-of-registry field — the typed
@@ -111,6 +119,19 @@ class EngineKey:
                 len(self.tile) != 2 or min(self.tile) < 1):
             raise ValueError(f"tile must be two positive ints, "
                              f"got {self.tile}")
+        if self.solver not in SOLVERS:
+            raise ValueError(f"unknown solver {self.solver!r}")
+        if self.mg_levels is not None and int(self.mg_levels) < 1:
+            raise ValueError(f"mg_levels must be >= 1, got {self.mg_levels}")
+        if self.solver == "multigrid":
+            # V-cycle residual/correction fields are signed floats — a
+            # u8 store-back would clamp the error equation to garbage.
+            if self.quantize:
+                raise ValueError("solver='multigrid' requires "
+                                 "quantize=False")
+            if self.storage != "f32":
+                raise ValueError("solver='multigrid' requires "
+                                 "storage='f32'")
 
 
 class _Entry:
@@ -118,7 +139,7 @@ class _Entry:
 
     __slots__ = ("key", "effective_backend", "fns", "lock", "plan_source",
                  "predicted_gpx", "plan_key", "effective_overlap",
-                 "splits", "compile_ref", "converge_fns")
+                 "splits", "compile_ref", "converge_fns", "mg_levels")
 
     def __init__(self, key: EngineKey, effective_backend: str,
                  plan_source: str = "explicit",
@@ -140,6 +161,12 @@ class _Entry:
         #                                      compile_build span ref —
         #                                      waiters (and reports) link
         #                                      to WHO paid for the compile
+        self.mg_levels: int | None = None  # multigrid keys: the level
+        #                                    count the planner ACTUALLY
+        #                                    scheduled (resolved at the
+        #                                    first converge stream; the
+        #                                    post-resolution stamp rows
+        #                                    carry — never the cap)
         self.fns: dict[int, object] = {}   # batch size -> jitted runner
         self.converge_fns: dict[int, object] = {}  # chunk length n ->
         #                                    jitted convergence chunk
@@ -656,11 +683,16 @@ class WarmEngine:
         ``image`` is ONE (C, H, W) f32 field; ``key.iters`` should equal
         ``check_every`` (the chunk program's compile identity — the
         service's converge keying does this).  A generator yielding
-        ``(image_f32, iters_done, diff)`` per chunk exactly like
+        ``(image_f32, done, diff, work_units)`` per chunk exactly like
         ``step.sharded_converge_stream``, but with the chunk executables
         cached on the warm entry (same LRU / single-flight / degrade
         machinery as the batch path) so a stream of convergence jobs for
-        one config compiles once.
+        one config compiles once.  ``work_units`` is the fine-grid work
+        spent so far — for jacobi the iteration count itself; for
+        ``key.solver == "multigrid"`` (one yield per V-CYCLE, ``done``
+        counting cycles, ``diff`` the fine-grid residual norm) the
+        pixel-weighted per-level accounting that makes the two solvers
+        comparable under one budget.
 
         A mid-stream mesh reshape raises the same stale-grid ValueError
         as :meth:`run_batch` — the service turns it into a typed,
@@ -677,6 +709,33 @@ class WarmEngine:
             raise ValueError(
                 f"image shape {tuple(image.shape)} does not match key "
                 f"{key.shape}")
+        if key.solver == "multigrid":
+            # The V-cycle's level programs are module-level lru-cached
+            # (solvers.multigrid) on (mesh, filter, geometry, backend) —
+            # a stream of jobs for one config compiles once, exactly the
+            # warm-cache property the chunk path has.  The stale-grid
+            # guard runs per cycle: the generator reads self.grid() each
+            # readback, so a mid-stream reshape surfaces as the same
+            # typed ValueError, never an execution on the wrong mesh.
+            from parallel_convolution_tpu.solvers import multigrid
+
+            entry.mg_levels = len(multigrid.plan_levels(
+                self.mesh, image.shape[1:], filt.radius, key.boundary,
+                key.mg_levels))
+            stream = multigrid.mg_converge_stream(
+                np.ascontiguousarray(image, dtype=np.float32), filt,
+                tol=tol, max_iters=max_iters, mesh=self.mesh,
+                quantize=key.quantize, backend=entry.effective_backend,
+                storage=key.storage, boundary=key.boundary,
+                tile=key.tile, overlap=entry.effective_overlap,
+                mg_levels=key.mg_levels)
+            for out, cycles, residual, wu in stream:
+                if key.grid != self.grid():
+                    raise ValueError(
+                        f"stale key grid {key.grid}: engine mesh is now "
+                        f"{self.grid()} (resharded mid-process)")
+                yield (out, cycles, residual, wu)
+            return
         xs, valid_hw, _ = step_lib._prepare(
             np.ascontiguousarray(image, dtype=np.float32), self.mesh,
             filt.radius, key.storage)
@@ -693,7 +752,7 @@ class WarmEngine:
             diff = float(d)   # the readback fences the chunk
             done += n
             yield (np.asarray(xs[:, : valid_hw[0], : valid_hw[1]]
-                              .astype(jnp.float32)), done, diff)
+                              .astype(jnp.float32)), done, diff, float(done))
 
     # -- introspection ------------------------------------------------------
     def degraded(self) -> list[dict]:

@@ -142,6 +142,11 @@ def decode_request(body: dict) -> Request:
                         if deadline_ms is not None else None),
             request_id=body.get("request_id"),
             tenant=str(body.get("tenant") or ""),
+            # solver: convergence strategy (converge jobs; the batch path
+            # sheds non-jacobi as invalid server-side).
+            solver=str(body.get("solver") or "jacobi"),
+            mg_levels=(None if body.get("mg_levels") is None
+                       else int(body["mg_levels"])),
         )
     except (KeyError, TypeError, ValueError) as e:
         raise ValueError(f"malformed request body: {e}") from e
@@ -214,6 +219,13 @@ def encode_stream_row(row) -> dict:
         "iters": row.iters,
         "diff": round(float(row.diff), 8),
         "converged": row.converged,
+        # Solver-shaped accounting (round 15): which convergence
+        # strategy produced the row (iters counts V-cycles for
+        # multigrid, diff is then the fine-grid residual norm) and the
+        # solver-comparable fine-grid work spent so far.
+        "solver": row.solver,
+        "work_units": round(float(row.work_units), 3),
+        "mg_levels": row.mg_levels,
         "image_b64": base64.b64encode(
             np.ascontiguousarray(row.image).tobytes()).decode("ascii"),
         "request_id": row.request_id,

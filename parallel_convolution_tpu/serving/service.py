@@ -86,6 +86,11 @@ class Request:
     #                                  (one device execution per id)
     tenant: str = ""                 # QoS identity (router token buckets;
     #                                  "" = the default tenant)
+    solver: str = "jacobi"           # convergence strategy (converge jobs
+    #                                  only: the batch path sheds
+    #                                  "multigrid" as invalid — there is
+    #                                  no fixed-count V-cycle workload)
+    mg_levels: int | None = None     # multigrid level-count cap
 
 
 @dataclasses.dataclass
@@ -165,7 +170,10 @@ class Snapshot:
     """
 
     image: np.ndarray                # uint8, same layout as the request
-    iters: int
+    iters: int                       # jacobi iterations — or V-CYCLES
+    #                                  for solver="multigrid" (one row
+    #                                  per cycle; diff is then the
+    #                                  fine-grid residual norm)
     diff: float
     final: bool = False
     converged: bool = False          # final=True only: diff < tol
@@ -174,6 +182,16 @@ class Snapshot:
     effective_grid: str = ""
     plan_key: str = ""
     trace_id: str = ""
+    solver: str = "jacobi"           # which convergence strategy produced
+    #                                  this row (utils.config.SOLVERS)
+    work_units: float = 0.0          # fine-grid work spent so far — the
+    #                                  solver-comparable budget unit
+    #                                  (= iters for jacobi; the
+    #                                  pixel-weighted per-level sum for
+    #                                  multigrid)
+    mg_levels: int | None = None     # multigrid only: the level count the
+    #                                  planner actually scheduled
+    #                                  (post-resolution, never the cap)
 
     ok = True
 
@@ -305,7 +323,9 @@ class ConvolutionService:
                         trace_id=trace.trace_id if trace is not None else "",
                         retry_after_s=retry_after_s)
 
-    def _validate(self, req: Request) -> tuple[EngineKey, str, np.ndarray]:
+    def _validate(self, req: Request,
+                  progressive: bool = False) -> tuple[EngineKey, str,
+                                                      np.ndarray]:
         """Terminal ValueError on any contract violation (→ ``invalid``).
 
         Returns ``(key, plan_source, planar)`` — provenance is
@@ -313,6 +333,13 @@ class ConvolutionService:
         from parallel_convolution_tpu.ops.filters import get_filter
         from parallel_convolution_tpu.utils import imageio
 
+        if req.solver != "jacobi" and not progressive:
+            # Only convergence jobs have a solver choice: a fixed-count
+            # V-cycle workload does not exist, so the batch path sheds it
+            # here instead of compiling a meaningless key.
+            raise ValueError(
+                f"solver={req.solver!r} is only valid for convergence "
+                "jobs (/v1/converge); the batch path is solver-less")
         img = np.asarray(req.image)
         if img.dtype != np.uint8 or img.ndim not in (2, 3) or (
                 img.ndim == 3 and img.shape[-1] != 3):
@@ -326,7 +353,9 @@ class ConvolutionService:
             fuse=None if req.fuse is None else int(req.fuse),
             boundary=req.boundary,
             quantize=bool(req.quantize), backend=req.backend,
-            overlap=req.overlap)
+            overlap=req.overlap, solver=req.solver,
+            mg_levels=(None if req.mg_levels is None
+                       else int(req.mg_levels)))
         key.validate()
         filt = get_filter(key.filter_name)
         R, C = key.grid
@@ -630,9 +659,15 @@ class ConvolutionService:
                         "tol >= 0, max_iters >= 1, check_every >= 1 "
                         "required")
                 # The chunk program's compile identity is check_every
-                # iterations — that is what keys the warm entry.
+                # iterations — that is what keys the warm entry.  A
+                # multigrid job's cadence is the V-cycle itself, so its
+                # key pins iters=1: two jobs differing only in
+                # check_every must share the compiled level programs.
                 key, _, planar = self._validate(
-                    dataclasses.replace(req, iters=check_every))
+                    dataclasses.replace(
+                        req, iters=(1 if req.solver == "multigrid"
+                                    else check_every)),
+                    progressive=True)
             except Exception as e:  # noqa: BLE001 — typed contract errors
                 asp.set(outcome="invalid")
                 return self._shed("invalid", rid, detail=str(e),
@@ -696,16 +731,18 @@ class ConvolutionService:
                     check_every=check_every) as psp:
                 last_out, last = None, None
                 try:
-                    for out, done, diff in self.engine.run_converge(
+                    for out, done, diff, wu in self.engine.run_converge(
                             key, planar, tol=tol, max_iters=max_iters,
                             check_every=check_every):
-                        last_out, last = out, (done, diff)
+                        last_out, last = out, (done, diff, wu)
                         yield Snapshot(
                             image=to_u8(out), iters=done, diff=diff,
                             request_id=rid,
                             effective_backend=entry.effective_backend,
                             effective_grid=grid, plan_key=entry.plan_key,
-                            trace_id=tid)
+                            trace_id=tid, solver=key.solver,
+                            work_units=round(float(wu), 3),
+                            mg_levels=entry.mg_levels)
                 except Exception as e:  # noqa: BLE001 — typed stream end
                     reason = ("resharding"
                               if ("resharded" in str(e) or self._reshaping)
@@ -724,7 +761,9 @@ class ConvolutionService:
                     converged=converged, request_id=rid,
                     effective_backend=entry.effective_backend,
                     effective_grid=grid, plan_key=entry.plan_key,
-                    trace_id=tid)
+                    trace_id=tid, solver=key.solver,
+                    work_units=round(float(last[2]), 3) if last else 0.0,
+                    mg_levels=entry.mg_levels)
                 self._bump("completed")
         finally:
             release()

@@ -1,0 +1,14 @@
+"""Iterative solvers over the sharded stencil machinery.
+
+``solvers.multigrid`` is the geometric multigrid V-cycle (round 15):
+restriction and prolongation are themselves stencil forms registered in
+the kernel-form registry (``parallel.kernels``), smoothing rides the
+exact per-backend iterate programs ``parallel.step`` compiles, and
+coarse levels collapse onto sub-grid meshes through the round-10
+reshard machinery.  The solver registry (``SOLVERS``) lives in the
+jax-free ``utils.config`` next to BACKENDS/STORAGES.
+"""
+
+from parallel_convolution_tpu.solvers import multigrid, transfer  # noqa: F401
+
+__all__ = ["multigrid", "transfer"]

@@ -1,0 +1,187 @@
+"""Multigrid transfer operators as first-class stencil forms.
+
+Restriction (full weighting) and prolongation (bilinear) are the
+inter-grid couplings of the V-cycle — and they are *stencils*: full
+weighting is exactly the 3×3 ``[[1,2,1],[2,4,2],[1,2,1]]/16`` tap array
+(the ``blur3`` pyramid kernel already in the filter registry) applied at
+every coarse-aligned fine point, bilinear prolongation is its adjoint up
+to scaling.  The wafer-scale stencil paper (PAPERS.md) makes the same
+move: treating transfer operators as ordinary stencil programs lets
+them ride the existing halo machinery instead of being host-side
+bolt-ons.
+
+Both operators register in the kernel-form registry
+(``parallel.kernels``) under their own ``stencil_form`` classes
+(``restrict`` / ``prolong``), keyed ``(rank=2, name, boundary)`` — the
+same dispatch surface the smoothers resolve through — and build
+per-block functions that run INSIDE ``shard_map`` on the level's mesh:
+
+* ghost cells come from the same two-phase ``halo.halo_exchange``
+  (depth 1: both operators touch at most one neighbor point);
+* out-of-image positions are re-masked through the same
+  ``step._valid_mask`` invariant, so the pad-to-multiple rim behaves
+  exactly like the serial zero-pad formula the unit tests check
+  against.
+
+Grid-alignment contract — THE load-bearing detail (measured, not
+asserted: the even-centered zero-boundary variant diverges at ≥3
+levels because the coarse ghost line drifts off the fine ghost line by
+h per level):
+
+* ``zero``     — ODD-centered: coarse ``k`` sits at fine ``2k+1``, so
+  the coarse ghost ring (coarse index −1 → fine ``2·(−1)+1 = −1``)
+  coincides EXACTLY with the fine ghost ring at every level, and the
+  zero boundary stays representable all the way down.  Coarse extent
+  ``(n−1)//2`` (for even ``n`` the last coarse point stays one fine
+  cell inside the boundary — the outside choice re-introduces the
+  misalignment).
+* ``periodic`` — EVEN-centered: coarse ``k`` at fine ``2k`` (a torus
+  has no boundary line to align; wrap preserves itself under even
+  coarsening).  Coarse extent ``n//2``; the level planner refuses to
+  coarsen a torus level whose extents cannot keep grid-divisible
+  alignment.
+
+With EVEN per-device fine blocks (the level planner's padding rule)
+both centerings keep every coarse point's stencil within the device's
+fine block plus a depth-1 halo — no gather, no resharding inside the
+operator.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from parallel_convolution_tpu.ops import conv
+from parallel_convolution_tpu.ops.filters import get_filter
+from parallel_convolution_tpu.parallel import halo, kernels as kernel_forms
+
+__all__ = ["FW_FILTER", "build_prolong_bilinear", "build_restrict_fw",
+           "coarse_extent"]
+
+# Full weighting IS the /16 pyramid stencil — the registry's blur3 taps.
+FW_FILTER = get_filter("blur3")
+
+
+def coarse_extent(n: int, boundary: str = "zero") -> int:
+    """Coarse-grid extent of a fine extent ``n`` under the centering the
+    boundary requires: ``(n−1)//2`` for zero (odd-centered — coarse k at
+    fine 2k+1, last point strictly inside), ``n//2`` for periodic
+    (even-centered wrap; the planner enforces even ``n``)."""
+    n = int(n)
+    return n // 2 if boundary == "periodic" else (n - 1) // 2
+
+
+def _check_even_block(block_hw, op: str) -> None:
+    bh, bw = block_hw
+    if bh % 2 or bw % 2:
+        raise ValueError(
+            f"{op} needs even per-device blocks (coarse-aligned fine "
+            f"points stay device-local), got {block_hw}; the level "
+            "planner pads extents to 2*grid multiples")
+
+
+def build_restrict_fw(grid, valid_hw, block_hw, boundary: str = "zero"):
+    """Per-block full-weighting restriction ``(C, bh, bw) → (C, bh/2,
+    bw/2)`` for use inside ``shard_map`` on the fine level's mesh.
+
+    One depth-1 halo exchange, one ``blur3``-tap correlation (the full
+    weighting stencil), the centering subsample (odd fine indices for
+    zero, even for periodic), and the coarse validity mask — the coarse
+    output obeys the same masking invariant as every iterate: positions
+    beyond ``coarse_extent(valid)`` are zero.
+    """
+    _check_even_block(block_hw, "restrict_fw")
+    periodic = boundary == "periodic"
+    cvalid = (coarse_extent(valid_hw[0], boundary),
+              coarse_extent(valid_hw[1], boundary))
+    cblock = (block_hw[0] // 2, block_hw[1] // 2)
+    needs_mask = not periodic and (
+        cvalid[0] != cblock[0] * grid[0] or cvalid[1] != cblock[1] * grid[1])
+    # Local index of coarse point 0's fine image: 1 (odd-centered, zero)
+    # or 0 (even-centered, periodic).  Device-locality: with even blocks,
+    # fine 2k+off for local coarse k lands in [off, bh-2+off] — inside
+    # the block either way; the FW taps then reach at most one cell
+    # beyond, which the depth-1 halo provides.
+    off = 0 if periodic else 1
+
+    def restrict(v):
+        from parallel_convolution_tpu.parallel.step import _valid_mask
+
+        p = halo.halo_exchange(v, 1, grid, boundary)
+        c = conv.correlate_padded(p, FW_FILTER)[:, off::2, off::2]
+        if needs_mask:
+            c = c * _valid_mask(cvalid, cblock).astype(c.dtype)
+        return c.astype(v.dtype)
+
+    return restrict
+
+
+def build_prolong_bilinear(grid, valid_hw, block_hw, boundary: str = "zero"):
+    """Per-block bilinear prolongation ``(C, bh/2, bw/2) → (C, bh, bw)``
+    for use inside ``shard_map`` on the FINE level's mesh (the coarse
+    correction arrives resharded onto the fine mesh at half blocks).
+
+    Coarse-aligned fine points copy their coarse point; the points
+    between average the two (four, at the diagonal) bracketing coarse
+    points — the tensor product of the 1D ``[1/2, 1, 1/2]`` interpolation
+    stencil, realized as two interleave passes over the depth-1
+    halo-padded coarse block.  Beyond-extent coarse reads are exactly the
+    boundary's ghost convention: 0 for zero (the adjoint of the
+    odd-centered restriction's inside rule), wrap for periodic.
+    """
+    _check_even_block(block_hw, "prolong_bilinear")
+    periodic = boundary == "periodic"
+    m, n = block_hw[0] // 2, block_hw[1] // 2
+    needs_mask = not periodic and (
+        valid_hw[0] != block_hw[0] * grid[0]
+        or valid_hw[1] != block_hw[1] * grid[1])
+
+    def interleave(a, b, axis):
+        """Alternate a/b along ``axis``: out[2i] = a[i], out[2i+1] = b[i]."""
+        stacked = jnp.stack([a, b], axis=axis + 1)
+        shape = list(a.shape)
+        shape[axis] *= 2
+        return stacked.reshape(shape)
+
+    def prolong(c):
+        from parallel_convolution_tpu.parallel.step import _valid_mask
+
+        p = halo.halo_exchange(c, 1, grid, boundary)  # (C, m+2, n+2)
+        if periodic:
+            # Even-centered: fine 2k = coarse k; fine 2k+1 = mean(k, k+1).
+            a = p[:, 1:m + 1, :]
+            b = p[:, 2:m + 2, :]
+            rows = interleave(a, (a + b) * 0.5, axis=1)   # (C, 2m, n+2)
+            al = rows[:, :, 1:n + 1]
+            bl = rows[:, :, 2:n + 2]
+            out = interleave(al, (al + bl) * 0.5, axis=2)  # (C, 2m, 2n)
+        else:
+            # Odd-centered: fine 2k+1 = coarse k; fine 2k = mean(k-1, k)
+            # (coarse ghost −1 reads 0 — the fine boundary line itself).
+            a = p[:, 0:m, :]
+            b = p[:, 1:m + 1, :]
+            rows = interleave((a + b) * 0.5, b, axis=1)   # (C, 2m, n+2)
+            al = rows[:, :, 0:n]
+            bl = rows[:, :, 1:n + 1]
+            out = interleave((al + bl) * 0.5, bl, axis=2)  # (C, 2m, 2n)
+        if needs_mask:
+            out = out * _valid_mask(valid_hw, block_hw).astype(out.dtype)
+        return out.astype(c.dtype)
+
+    return prolong
+
+
+def _register_transfer_forms() -> None:
+    from parallel_convolution_tpu.utils.config import BOUNDARIES
+
+    kernel_forms.register(kernel_forms.KernelForm(
+        name="restrict_fw", rank=2, stencil_form="restrict",
+        boundaries=tuple(BOUNDARIES), overlap_capable=False,
+        build=build_restrict_fw))
+    kernel_forms.register(kernel_forms.KernelForm(
+        name="prolong_bilinear", rank=2, stencil_form="prolong",
+        boundaries=tuple(BOUNDARIES), overlap_capable=False,
+        build=build_prolong_bilinear))
+
+
+_register_transfer_forms()

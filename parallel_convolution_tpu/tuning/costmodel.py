@@ -304,3 +304,18 @@ def predict_seconds_per_px_iter(backend: str, storage: str, fuse: int,
 def predict_gpx_per_chip(seconds_per_px_iter: float) -> float:
     """Gpixels/sec/chip implied by a per-px-iter time (the bench unit)."""
     return 1.0 / (seconds_per_px_iter * 1e9)
+
+
+def predict_vcycle_seconds(
+        terms: list[tuple[float, int, int]]) -> float:
+    """Price of one multigrid V-cycle: the SUM of its per-level sweeps.
+
+    ``terms`` is one ``(seconds_per_px_iter, pixels, sweeps)`` triple per
+    grid level (from :func:`predict_seconds_per_px_iter` on that level's
+    own block/grid geometry).  Coarse levels are cheaper — fewer pixels,
+    and often a smaller mesh — but never free: the sum keeps
+    ``backend="auto"`` comparisons between a V-cycle and a single-level
+    solver honest, rather than letting coarse sweeps vanish from the
+    bill.
+    """
+    return sum(spp * px * n for spp, px, n in terms)

@@ -340,6 +340,124 @@ def bench_iterate(
     }
 
 
+def bench_converge(
+    shape: tuple[int, int],
+    filt: Filter,
+    tol: float,
+    max_iters: int,
+    mesh=None,
+    channels: int = 1,
+    backend: str = "shifted",
+    storage: str = "f32",
+    boundary: str = "zero",
+    check_every: int = 10,
+    fuse: int | None = 1,
+    tile: tuple[int, int] | None = None,
+    solver: str = "jacobi",
+    mg_levels: int | None = None,
+    overlap: bool | None = None,
+    seed: int = 0,
+) -> dict:
+    """One run-to-convergence row, solver-comparable by construction.
+
+    The row's ``work_units_to_tol`` is the fine-grid work spent reaching
+    ``tol`` — iterations for jacobi, the pixel-weighted per-level sum for
+    multigrid — so a multigrid row and a jacobi row on the same problem
+    divide into the convergence speedup directly.  ``solver`` and
+    ``mg_levels`` are stamped POST-RESOLUTION like tile/fuse: the level
+    count is what the planner actually scheduled (never the requested
+    cap), and ``plan_key`` carries a ``solver=`` suffix for non-jacobi
+    rows so ``scripts/perf_gate.py`` never judges a multigrid row
+    against a jacobi baseline.
+    """
+    if mesh is None:
+        mesh = make_grid_mesh()
+    from parallel_convolution_tpu.tuning.plans import Workload
+
+    H, W = shape
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((channels, H, W)).astype(np.float32)
+    # Post-resolution stamping, same rule as bench_iterate: resolve
+    # backend="auto"/fuse=None/tile=None through the tuning subsystem
+    # FIRST so the row records the program that actually ran.
+    backend, fuse, tile, overlap, _ = step_lib._resolve_auto(
+        mesh, filt, backend, fuse, tile, storage, False, boundary,
+        (H, W), channels, check_every=int(check_every), overlap=overlap)
+    w = Workload.from_mesh(mesh, filt, (channels, H, W), storage=storage,
+                           quantize=False, boundary=boundary)
+    dev0 = mesh.devices.flat[0]
+    grid = grid_shape(mesh)
+    row = {
+        "workload": f"converge {filt.name} {H}x{W}x{channels} tol={tol}",
+        "backend": backend,
+        "solver": solver,
+        "storage": storage,
+        "boundary": boundary,
+        "platform": dev0.platform,
+        "device_kind": getattr(dev0, "device_kind", "") or "",
+        "mesh": "x".join(str(s) for s in grid),
+        "devices": mesh.size,
+        "tol": float(tol),
+    }
+    t0 = time.perf_counter()
+    if solver == "multigrid":
+        from parallel_convolution_tpu.solvers import multigrid
+
+        out, res = multigrid.mg_converge(
+            x, filt, tol=tol, max_iters=max_iters, mesh=mesh,
+            quantize=False, backend=backend, storage=storage,
+            boundary=boundary, fuse=fuse, tile=tile, overlap=overlap,
+            mg_levels=mg_levels)
+        row.update({
+            "effective_backend": res.backend,
+            "overlap": res.overlap,
+            "converged": res.converged,
+            "residual": float(res.residual),
+            "cycles": res.cycles,
+            # Post-resolution stamps: what the planner actually
+            # scheduled, not the requested cap.
+            "mg_levels": res.levels,
+            "mg_level_shapes": res.level_shapes,
+            "work_units_to_tol": res.work_units,
+            "predicted_s_per_cycle": res.predicted_s_per_cycle,
+            # The solver is part of the history identity: a V-cycle's
+            # work trajectory must never be judged against sweep counts.
+            "plan_key": f"{w.key()}|solver=multigrid",
+        })
+    else:
+        # The host-driven stream (byte-identical final image to
+        # sharded_converge, same chunk math) reads the diff back per
+        # chunk, so convergence is judged on diff < tol itself — the
+        # iters < max_iters proxy misreports a run that reaches tol
+        # exactly on the final permitted chunk.
+        out, iters, diff = x, 0, None
+        for out, iters, diff in step_lib.sharded_converge_stream(
+                x, filt, tol=tol, max_iters=max_iters,
+                check_every=check_every, mesh=mesh, quantize=False,
+                backend=backend, storage=storage, boundary=boundary,
+                fuse=fuse, tile=tile, overlap=overlap):
+            pass
+        row.update({
+            "effective_backend": backend,
+            "converged": diff is not None and diff < tol,
+            "residual": diff,
+            "iters": iters,
+            "mg_levels": None,
+            "work_units_to_tol": float(iters),
+            "plan_key": w.key(),
+        })
+    secs = max(time.perf_counter() - t0, 1e-9)
+    row["wall_s"] = round(secs, 4)
+    # Fine-grid pixel updates per second — the gateable throughput of a
+    # convergence run (work-unit-weighted, so a V-cycle's coarse sweeps
+    # are charged at their pixel ratio; perf_gate's history separates
+    # solvers by key, this number tracks each solver's own trajectory).
+    row["gpixels_per_s"] = round(
+        row["work_units_to_tol"] * H * W * channels / secs / 1e9, 5)
+    row["checksum"] = float(np.abs(np.asarray(out)).max())
+    return row
+
+
 def halo_bench_rounds(mesh, grid, r: int, n: int, exchange: bool):
     """The halo benchmark's chained round runner, at module scope so the
     HLO regression test (`test_bench_halo_rounds_keep_collectives`)

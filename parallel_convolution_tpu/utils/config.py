@@ -24,6 +24,13 @@ AUTO = "auto"
 BACKEND_CHOICES = BACKENDS + (AUTO,)
 STORAGES = ("f32", "bf16", "u8")
 BOUNDARIES = ("zero", "periodic")
+# Convergence solver registry (round 15): how a run-to-convergence job
+# reaches its fixed point.  "jacobi" is the reference's plain sweep loop
+# (sharded_converge); "multigrid" is the geometric V-cycle
+# (solvers.multigrid) — same stopping measure, orders of magnitude fewer
+# fine-grid work units.  Jax-free here so CLI/serving validation and the
+# wire schema share one source.
+SOLVERS = ("jacobi", "multigrid")
 
 # Env escape hatch: run the overlapped RDMA pipeline under interpreted
 # Pallas anyway (CI byte proofs).  Lives here (jax-free) because BOTH
@@ -56,6 +63,10 @@ class RunConfig:
     boundary: str = "zero"
     quantize: bool = True
     converge_tol: float | None = None
+    solver: str = "jacobi"         # convergence strategy (SOLVERS) for
+    #                                converge_tol runs; "multigrid"
+    #                                requires quantize=False + f32
+    mg_levels: int | None = None   # multigrid level-count cap
     check_every: int = 10
     sharded_io: bool = False
     checkpoint_dir: str | None = None
@@ -78,6 +89,20 @@ class RunConfig:
             # u8 carries can only hold the quantized integer states; a float
             # Jacobi iterate would be silently truncated every iteration.
             raise ValueError("storage='u8' requires quantize=True")
+        if self.solver not in SOLVERS:
+            raise ValueError(
+                f"solver must be one of {SOLVERS}, got {self.solver!r}")
+        if self.mg_levels is not None and int(self.mg_levels) < 1:
+            raise ValueError(f"mg_levels must be >= 1, got {self.mg_levels}")
+        if self.solver == "multigrid" and self.converge_tol is not None:
+            # The V-cycle's residual/correction fields are signed floats:
+            # fail the config here, not deep inside a traced program.
+            if self.quantize:
+                raise ValueError(
+                    "solver='multigrid' requires quantize=False")
+            if self.storage != "f32":
+                raise ValueError(
+                    "solver='multigrid' requires storage='f32'")
         if (self.rows <= 0 or self.cols <= 0 or self.iters < 0
                 or (self.fuse is not None and self.fuse < 1)):
             raise ValueError("rows/cols must be positive, iters >= 0, fuse >= 1")

@@ -53,6 +53,17 @@ def _percentile(sorted_vals: list[float], q: float) -> float | None:
     return sorted_vals[i]
 
 
+def _drain_rows(rows) -> dict:
+    """Drain a converge NDJSON stream to its FINAL row (or the typed
+    rejection), folding the row count in as ``rows_streamed`` — the one
+    place the transports' final-row contract lives."""
+    last, n = {"ok": False, "detail": "empty stream"}, 0
+    for r in rows:
+        last, n = r, n + 1
+    last["rows_streamed"] = n
+    return last
+
+
 class _HTTPTransport:
     def __init__(self, url: str, timeout: float):
         self.base = url.rstrip("/")
@@ -69,6 +80,27 @@ class _HTTPTransport:
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read())
+            except Exception:  # noqa: BLE001
+                return e.code, {"ok": False, "detail": f"http {e.code}"}
+
+    def converge(self, body: dict) -> tuple[int, dict]:
+        """One progressive convergence job: POST /v1/converge, drain the
+        NDJSON stream, return the FINAL row (or the typed rejection)
+        with the snapshot count folded in as ``rows_streamed``."""
+        import urllib.error
+        import urllib.request
+
+        data = json.dumps(body).encode()
+        req = urllib.request.Request(
+            f"{self.base}/v1/converge", data=data,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.status, _drain_rows(
+                    json.loads(line) for line in resp if line.strip())
         except urllib.error.HTTPError as e:
             try:
                 return e.code, json.loads(e.read())
@@ -110,6 +142,23 @@ def main() -> int:
     ap.add_argument("--storage", default="f32")
     ap.add_argument("--fuse", type=int, default=1)
     ap.add_argument("--boundary", default="zero")
+    ap.add_argument("--converge", type=float, default=None, metavar="TOL",
+                    help="drive /v1/converge instead of /v1/convolve: "
+                         "each request is one progressive convergence "
+                         "job streamed to its final row (--iters is "
+                         "ignored; see --max-iters/--solver)")
+    ap.add_argument("--max-iters", type=int, default=2000,
+                    help="convergence work budget per job (fine-grid "
+                         "work units; --converge only)")
+    ap.add_argument("--check-every", type=int, default=10,
+                    help="snapshot cadence in iterations (--converge "
+                         "with the jacobi solver; multigrid streams one "
+                         "row per V-cycle)")
+    ap.add_argument("--solver", default="jacobi",
+                    choices=["jacobi", "multigrid"],
+                    help="convergence strategy (--converge only)")
+    ap.add_argument("--mg-levels", type=int, default=None,
+                    help="multigrid level-count cap (--converge only)")
     ap.add_argument("--deadline-ms", type=float, default=None,
                     help="per-request latency budget (missed -> typed shed)")
     ap.add_argument("--tenant", default=None,
@@ -162,6 +211,17 @@ def main() -> int:
         body["deadline_ms"] = args.deadline_ms
     if args.tenant:
         body["tenant"] = args.tenant
+    if args.converge is not None:
+        # Convergence-job wire shape: tol/max_iters/check_every replace
+        # iters/deadline; float carries (quantize=False) are the
+        # converge default and multigrid's requirement.
+        body.pop("iters", None)
+        body.pop("deadline_ms", None)
+        body.update(tol=args.converge, max_iters=args.max_iters,
+                    check_every=args.check_every, quantize=False,
+                    solver=args.solver)
+        if args.mg_levels is not None:
+            body["mg_levels"] = args.mg_levels
 
     targets = args.target or ([args.url] if args.url else None)
     service = None
@@ -184,11 +244,19 @@ def main() -> int:
             mesh, max_batch=args.max_batch,
             max_delay_s=args.max_delay_ms / 1e3, max_queue=args.max_queue)
         client = InProcessClient(service)
-        transports = [lambda b: client.request(b, timeout=args.timeout)]
+        if args.converge is not None:
+            def _converge_inproc(b):
+                status, rows = client.converge(b, timeout=args.timeout)
+                return status, _drain_rows(rows)
+
+            transports = [_converge_inproc]
+        else:
+            transports = [lambda b: client.request(b, timeout=args.timeout)]
         transport_snapshot = service.snapshot
     else:
         https = [_HTTPTransport(url, args.timeout) for url in targets]
-        transports = [h.request for h in https]
+        transports = [(h.converge if args.converge is not None
+                       else h.request) for h in https]
         transport_snapshot = https[0].snapshot
 
     if args.warm and service is not None:
@@ -199,6 +267,9 @@ def main() -> int:
                          "boundary": args.boundary}])
 
     want = None
+    if args.check and args.converge is not None:
+        ap.error("--check byte-compares the fixed-count oracle; it does "
+                 "not apply to --converge jobs")
     if args.check:
         from parallel_convolution_tpu.ops import oracle
         from parallel_convolution_tpu.ops.filters import get_filter
@@ -341,13 +412,20 @@ def main() -> int:
 
     lats = sorted(lat for lat, _ in completed)
     channels = 3 if args.mode == "rgb" else 1
-    px = args.rows * args.cols * channels * args.iters * len(completed)
+    if args.converge is not None:
+        # Convergence jobs: pixels iterated = the solver-comparable
+        # fine-grid work units each final row stamps (iterations for
+        # jacobi, the pixel-weighted per-level sum for multigrid).
+        px = int(args.rows * args.cols * channels
+                 * sum(r.get("work_units", 0.0) for _, r in completed))
+    else:
+        px = args.rows * args.cols * channels * args.iters * len(completed)
     phase_names = ("queue", "compile", "device", "copy_in", "copy_out")
     phases_ms = {
         p: round(1e3 * statistics.mean(
-            [r["phases"].get(p, 0.0) for _, r in completed]), 3)
+            [r.get("phases", {}).get(p, 0.0) for _, r in completed]), 3)
         for p in phase_names
-    } if completed else {}
+    } if completed and args.converge is None else {}
     effective = sorted({r.get("effective_backend", "") for _, r in completed})
     grids = sorted({r.get("effective_grid", "") for _, r in completed})
     batch_sizes = [r.get("batch_size", 1) for _, r in completed]
@@ -366,7 +444,10 @@ def main() -> int:
 
     row = {
         "workload": (f"serve {args.filter_name} {args.rows}x{args.cols}"
-                     f"x{channels} {args.iters} iters"),
+                     f"x{channels} "
+                     + (f"converge tol={args.converge}"
+                        if args.converge is not None
+                        else f"{args.iters} iters")),
         "loop": "open" if args.rate else "closed",
         "n": args.n,
         **({"rate_rps": args.rate} if args.rate
@@ -395,6 +476,31 @@ def main() -> int:
                        if batch_sizes else None),
         "batch_max": max(batch_sizes, default=None),
     }
+    if args.converge is not None:
+        # Solver-shaped convergence accounting (r15), stamped from the
+        # final rows the SERVER streamed (post-resolution — mg_levels is
+        # the planner's actual schedule, work_units the solver's own
+        # bill), never from the request knobs.
+        solvers = sorted({r.get("solver", "") for _, r in completed} - {""})
+        levels = sorted({r.get("mg_levels") for _, r in completed}
+                        - {None})
+        wus = sorted(r.get("work_units", 0.0) for _, r in completed)
+        # Always a scalar string: perf_gate.row_key interpolates this
+        # into the history identity, and a list repr would mint a key no
+        # future run ever matches.  A genuinely mixed run gets a stable
+        # "a+b" key distinct from either solver's own history.
+        row["solver"] = (solvers[0] if len(solvers) == 1
+                         else ("+".join(solvers) if solvers
+                               else args.solver))
+        row["mg_levels"] = (levels[0] if len(levels) == 1
+                            else (levels or None))
+        row["work_units_to_tol"] = _percentile(wus, 0.50)
+        row["tol"] = args.converge
+        row["converged"] = sum(1 for _, r in completed
+                               if r.get("converged"))
+        row["rows_streamed_mean"] = (round(statistics.mean(
+            [r.get("rows_streamed", 0) for _, r in completed]), 1)
+            if completed else None)
     if want is not None:
         row["oracle_mismatches"] = mismatches
     try:

@@ -1,0 +1,218 @@
+#!/usr/bin/env python
+"""Multigrid smoke: the V-cycle's convergence claim + the registry
+migration proof, end-to-end on the CPU mesh.
+
+The ``run_t1.sh --mg-smoke`` leg.  Gates, in order:
+
+1. CONVERGENCE WIN — converge the same seeded Poisson problem (random
+   f32 field, ``jacobi3``, zero boundary) both ways on the 2x4 mesh
+   with the SAME stopping measure (max-abs change of one fine-grid
+   sweep).  Multigrid must reach tol in ≥10× fewer fine-grid work
+   units than plain Jacobi (measured ~44× at 96x64/1e-6).
+2. ORACLE AGREEMENT — the two final states agree to ``--oracle-tol``
+   (1e-3; measured ~2e-4).  Both sit near the true fixed point, so the
+   bound is an honest conditioning-adjusted gate, not a tautology.
+3. REGISTRY MIGRATION — the kernel-form registry's smoother key set is
+   EXACTLY the old ``backend ==`` ladder, and every registered backend
+   still produces byte-identical output vs the serial oracle through
+   the new dispatch (quantized u8 semantics, the round-1 contract).
+4. WARM KEYS COMPILE FLAT — a second identical multigrid solve hits
+   every compiled level program (lru misses flat) and reproduces the
+   bytes exactly.
+5. PERF SENTRY FOLD — the jacobi/multigrid convergence rows
+   (``bench_converge``: solver, mg_levels, work_units_to_tol) seed and
+   re-gate the smoke's OWN history through ``scripts/perf_gate.py`` —
+   whose row key separates solvers, so the multigrid row is never
+   judged against the jacobi baseline.
+
+One summary row lands in ``--out`` (``evidence/mg_smoke.json``, the
+supervisor leg's done_file) with ``"failures": 0`` iff every gate held.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import _path  # noqa: F401  (repo root + JAX_PLATFORMS re-apply)
+
+SCRIPTS = Path(__file__).resolve().parent
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rows", type=int, default=96)
+    ap.add_argument("--cols", type=int, default=64)
+    ap.add_argument("--mesh", default="2x4")
+    ap.add_argument("--tol", type=float, default=1e-6,
+                    help="stopping tolerance for BOTH solvers")
+    ap.add_argument("--oracle-tol", type=float, default=1e-3,
+                    help="max-abs agreement bound between the two "
+                         "converged states")
+    ap.add_argument("--min-ratio", type=float, default=10.0,
+                    help="required jacobi/multigrid work-unit ratio")
+    ap.add_argument("--max-iters", type=int, default=60000)
+    ap.add_argument("--out", default="evidence/mg_smoke.json")
+    ap.add_argument("--history", default="evidence/mg_smoke_history.jsonl",
+                    help="the smoke's OWN perf history, seeded fresh "
+                         "each run; never the committed "
+                         "evidence/perf_history.jsonl")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from parallel_convolution_tpu.ops import filters, oracle
+    from parallel_convolution_tpu.parallel import kernels as kernel_forms
+    from parallel_convolution_tpu.parallel import step as step_lib
+    from parallel_convolution_tpu.parallel.mesh import mesh_from_spec
+    from parallel_convolution_tpu.solvers import multigrid as mg
+    from parallel_convolution_tpu.utils import bench, imageio
+    from parallel_convolution_tpu.utils.config import BACKENDS, BOUNDARIES
+
+    failures: list[str] = []
+    mesh = mesh_from_spec(args.mesh)
+    filt = filters.get_filter("jacobi3")
+    H, W = args.rows, args.cols
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((1, H, W)).astype(np.float32)
+
+    # ---- 1+2: convergence win + oracle agreement (bench_converge rows
+    # carry the solver-comparable accounting the perf fold gates).
+    row_mg = bench.bench_converge(
+        (H, W), filt, tol=args.tol, max_iters=args.max_iters, mesh=mesh,
+        solver="multigrid", seed=0)
+    row_j = bench.bench_converge(
+        (H, W), filt, tol=args.tol, max_iters=args.max_iters, mesh=mesh,
+        solver="jacobi", check_every=200, seed=0)
+    if not row_mg["converged"]:
+        failures.append(f"multigrid did not reach tol={args.tol} within "
+                        f"{args.max_iters} work units")
+    if not row_j["converged"]:
+        failures.append(f"jacobi did not reach tol={args.tol} within "
+                        f"{args.max_iters} iterations")
+    ratio = (row_j["work_units_to_tol"] / row_mg["work_units_to_tol"]
+             if row_mg["work_units_to_tol"] else 0.0)
+    if ratio < args.min_ratio:
+        failures.append(
+            f"work-unit ratio {ratio:.1f}x below the {args.min_ratio}x "
+            f"gate (jacobi {row_j['work_units_to_tol']}, multigrid "
+            f"{row_mg['work_units_to_tol']})")
+
+    out_mg, _ = mg.mg_converge(x, filt, tol=args.tol,
+                               max_iters=args.max_iters, mesh=mesh)
+    out_j, _ = step_lib.sharded_converge(
+        x, filt, tol=args.tol, max_iters=args.max_iters, check_every=200,
+        mesh=mesh, quantize=False)
+    oracle_diff = float(np.abs(np.asarray(out_j, np.float32)
+                               - out_mg).max())
+    if oracle_diff > args.oracle_tol:
+        failures.append(f"final states disagree: max|mg - jacobi| = "
+                        f"{oracle_diff:.3g} > {args.oracle_tol}")
+
+    # ---- 3: registry migration proof.
+    want_keys = frozenset((2, b, bd) for b in BACKENDS for bd in BOUNDARIES)
+    got_keys = kernel_forms.registered_keys("smooth")
+    if got_keys != want_keys:
+        failures.append(
+            f"registry smoother keys drifted from the old ladder: "
+            f"extra={sorted(got_keys - want_keys)} "
+            f"missing={sorted(want_keys - got_keys)}")
+    img = np.random.default_rng(1).integers(
+        0, 256, (48, 64)).astype(np.uint8)
+    want_bytes = oracle.run_serial_u8(img, filters.get_filter("blur3"), 2)
+    planar = imageio.interleaved_to_planar(img).astype(np.float32)
+    backends_ok = []
+    from parallel_convolution_tpu.parallel.mesh import make_grid_mesh
+    from parallel_convolution_tpu.utils import jax_compat
+
+    for b in BACKENDS:
+        # The RDMA protocol's multi-device CPU simulation needs the
+        # DMA-faithful TPU interpreter; without it (jax 0.4.x) tier-1's
+        # own RDMA tests skip to the degenerate 1x1 grid, where extent-1
+        # axes statically elide every RDMA construct but the full fused
+        # compute path still runs.  Mirror that rule here.
+        b_mesh, tag = mesh, b
+        if b == "pallas_rdma" and not jax_compat.HAS_TPU_INTERPRET:
+            import jax as _jax
+
+            b_mesh = make_grid_mesh(_jax.devices()[:1], (1, 1))
+            tag = f"{b}(degenerate-1x1: no faithful interpreter)"
+        try:
+            got = step_lib.sharded_iterate(
+                planar, filters.get_filter("blur3"), 2, mesh=b_mesh,
+                backend=b)
+            got = np.asarray(got).astype(np.uint8)[0]
+            if np.array_equal(got, want_bytes):
+                backends_ok.append(tag)
+            else:
+                failures.append(f"backend {b} bytes drifted through the "
+                                "registry")
+        except Exception as e:  # noqa: BLE001 — per-backend, reported
+            failures.append(f"backend {b} failed through the registry: "
+                            f"{repr(e)[:200]}")
+
+    # ---- 4: warm keys compile flat (and deterministically).
+    misses = (mg._build_fine_smooth.cache_info().misses,
+              mg._build_smooth_rhs.cache_info().misses,
+              mg._build_residual_restrict.cache_info().misses,
+              mg._build_prolong_correct.cache_info().misses)
+    out_mg2, _ = mg.mg_converge(x, filt, tol=args.tol,
+                                max_iters=args.max_iters, mesh=mesh)
+    warm = (mg._build_fine_smooth.cache_info().misses,
+            mg._build_smooth_rhs.cache_info().misses,
+            mg._build_residual_restrict.cache_info().misses,
+            mg._build_prolong_correct.cache_info().misses)
+    warm_delta = sum(w - m for w, m in zip(warm, misses))
+    if warm_delta:
+        failures.append(f"warm multigrid re-run compiled {warm_delta} "
+                        "fresh level programs (expected 0)")
+    if not np.array_equal(out_mg, out_mg2):
+        failures.append("warm multigrid re-run changed bytes")
+
+    row = {
+        "workload": f"mg-smoke jacobi3 {H}x{W} tol={args.tol} "
+                    f"mesh={args.mesh}",
+        "solver_rows": {"jacobi": row_j, "multigrid": row_mg},
+        "work_units_jacobi": row_j["work_units_to_tol"],
+        "work_units_multigrid": row_mg["work_units_to_tol"],
+        "mg_cycles": row_mg.get("cycles"),
+        "mg_levels": row_mg.get("mg_levels"),
+        "work_unit_ratio": round(ratio, 2),
+        "min_ratio_gate": args.min_ratio,
+        "oracle_max_abs_diff": oracle_diff,
+        "oracle_tol": args.oracle_tol,
+        "registry_smooth_keys": len(got_keys),
+        "backends_byte_identical": backends_ok,
+        "warm_compile_delta": warm_delta,
+    }
+
+    # ---- 5: perf sentry fold — the smoke's own history, seed + re-gate.
+    out_path = Path(args.out)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    rows_path = out_path.with_suffix(".rows.json")
+    rows_path.write_text(json.dumps([row_j, row_mg]))
+    hist = Path(args.history)
+    hist.parent.mkdir(parents=True, exist_ok=True)
+    hist.write_text("")   # the smoke's OWN history: truncate per run
+    gate = [sys.executable, str(SCRIPTS / "perf_gate.py"),
+            "--history", str(hist), "--row", str(rows_path), "--quiet"]
+    rc_seed = subprocess.run([*gate, "--update"], check=False).returncode
+    rc_pass = subprocess.run(gate, check=False).returncode
+    if rc_seed != 0:
+        failures.append(f"perf_gate seed run exited {rc_seed}")
+    if rc_pass != 0:
+        failures.append(f"perf_gate re-gate exited {rc_pass}")
+
+    row["failures"] = len(failures)
+    row["failure_detail"] = failures[:8]
+    out_path.write_text(json.dumps(row, indent=2))
+    print(json.dumps({k: v for k, v in row.items()
+                      if k != "solver_rows"}), flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,13 +52,20 @@ import _path  # noqa: F401  (repo root on sys.path)
 
 
 def row_key(row: dict) -> str:
-    """``plan_key|backend|grid`` — the history identity of one row.
+    """``plan_key|backend|grid[|solver=S]`` — the history identity of
+    one row.
 
     ``plan_key`` (stamped by bench_iterate and serving responses since
     r13) is the canonical tuning identity; rows that predate it key on
     their workload string.  Backend prefers the EFFECTIVE backend (a
     degraded tier must never be compared against the requested tier's
-    baseline); grid prefers the mesh/effective_grid stamp.
+    baseline); grid prefers the mesh/effective_grid stamp.  Convergence
+    rows (r15) additionally key on their ``solver`` — every row that
+    carries one gets a ``|solver=S`` suffix — so a multigrid row is
+    never judged against a jacobi baseline (the two differ by orders of
+    magnitude by design), and a jacobi convergence row never shares
+    history with a fixed-count iterate row of the same plan_key.  (A
+    plan_key already carrying the suffix is not double-stamped.)
     """
     plan = row.get("plan_key") or row.get("workload") or ""
     if isinstance(plan, (list, tuple)):
@@ -70,7 +77,11 @@ def row_key(row: dict) -> str:
             or row.get("grid") or "")
     if isinstance(grid, (list, tuple)):
         grid = grid[0] if grid else ""
-    return f"{plan}|{b}|{grid}"
+    key = f"{plan}|{b}|{grid}"
+    solver = row.get("solver")
+    if solver and f"solver={solver}" not in key:
+        key += f"|solver={solver}"
+    return key
 
 
 def row_metric(row: dict) -> float | None:

@@ -5,6 +5,20 @@
 # Run from anywhere: resolves to the repo root first.
 #
 #   scripts/run_t1.sh                  the tier-1 pytest gate
+#   scripts/run_t1.sh --mg-smoke       multigrid V-cycle + kernel-form
+#                                      registry end-to-end on the 2x4 CPU
+#                                      mesh: converge a seeded Poisson
+#                                      problem both ways (same stopping
+#                                      measure), gate the >=10x fine-grid
+#                                      work-unit ratio and the oracle
+#                                      agreement, prove every backend
+#                                      byte-identical through the
+#                                      registry with warm compiles flat,
+#                                      and fold the convergence rows
+#                                      through perf_gate.py against the
+#                                      smoke's own history.  Row lands in
+#                                      evidence/mg_smoke.json (the
+#                                      supervisor leg's done_file).
 #   scripts/run_t1.sh --router-smoke   replica-set router end-to-end on the
 #                                      CPU mesh: 3 in-process replicas
 #                                      (2x2 each) behind the consistent-
@@ -122,6 +136,13 @@ if [ "${1:-}" = "--tuning-smoke" ]; then
       --filter blur3 --iters 2 --mesh 2x4 --dry-run \
       --emit-plans --out evidence/tuning_smoke_plans.json \
       --verify-auto --summary-out evidence/tuning_smoke.json
+fi
+
+if [ "${1:-}" = "--mg-smoke" ]; then
+  exec timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python scripts/mg_smoke.py --rows 96 --cols 64 --mesh 2x4 \
+      --out evidence/mg_smoke.json
 fi
 
 if [ "${1:-}" = "--router-smoke" ]; then
