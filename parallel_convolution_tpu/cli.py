@@ -78,6 +78,17 @@ def _add_perf_args(p: argparse.ArgumentParser) -> None:
                         "auto; 'on' is a request clamped to legality "
                         "(RDMA tier, compiled Pallas) — the RESOLVED "
                         "knob is what rows and summaries report")
+    p.add_argument("--col-mode", default="auto", dest="col_mode",
+                   choices=["auto", "packed", "strided"],
+                   help="RDMA column-slab transport (round 16): "
+                        "'packed' stages the strided slab through a "
+                        "contiguous buffer and moves ONE dense RDMA, "
+                        "'strided' issues the direct strided copy — "
+                        "bit-identical either way; 'auto' lets the "
+                        "cost model pick per (dtype, block, radius).  "
+                        "Inert (normalized to 'packed') off the RDMA "
+                        "tier; rows and responses stamp the RESOLVED "
+                        "value")
     p.add_argument("--interior-split", action="store_true",
                    dest="interior_split",
                    help="unmasked-interior launch split for fused Pallas "
@@ -151,6 +162,9 @@ def _resolve_perf_knobs(args, mesh) -> None:
     # backend='auto'); on/off -> a clamped request (resolve_overlap).
     args.overlap = {"auto": None, "on": True, "off": False}[
         getattr(args, "overlap", "auto")]
+    # --col-mode: 'auto' -> None (cost-model pick; resolve_col_mode).
+    cm = getattr(args, "col_mode", "auto")
+    args.col_mode = None if cm == "auto" else cm
 
 
 def _mesh_from_flag(spec: str | None):
@@ -358,7 +372,7 @@ def main(argv: list[str] | None = None) -> int:
             interior_split=args.interior_split,
             backend=args.backend, storage=args.storage, fuse=args.fuse,
             reps=args.reps, tile=tile, fallback=args.fallback,
-            overlap=args.overlap,
+            overlap=args.overlap, col_mode=args.col_mode,
         )
         if note:
             row["platform_note"] = note
@@ -392,6 +406,7 @@ def main(argv: list[str] | None = None) -> int:
             quantize=not mg, fuse=args.fuse, tile=tile,
             boundary=args.boundary, storage=args.storage,
             interior_split=args.interior_split, overlap=args.overlap,
+            col_mode=args.col_mode,
             solver=args.solver, mg_levels=args.mg_levels,
         )
         img = imageio.read_raw(args.image, args.rows, args.cols, args.mode)
@@ -420,6 +435,7 @@ def main(argv: list[str] | None = None) -> int:
                              tile=tile,
                              interior_split=args.interior_split,
                              overlap=args.overlap,
+                             col_mode=args.col_mode,
                              fallback=args.fallback)
     if args.checkpoint:
         from parallel_convolution_tpu.parallel import step as step_lib
@@ -433,6 +449,7 @@ def main(argv: list[str] | None = None) -> int:
             backend=args.backend, fuse=args.fuse, boundary=args.boundary,
             tile=tile, interior_split=args.interior_split,
             fallback=args.fallback, overlap=args.overlap,
+            col_mode=args.col_mode,
         )
         sharded_io.save_sharded(args.output, out, args.rows, args.cols,
                                 args.mode)
@@ -479,6 +496,8 @@ def main(argv: list[str] | None = None) -> int:
                  else f"{args.backend} degraded to {eff}")
     if getattr(model, "effective_overlap", None):
         label += ", overlapped halo pipeline"
+    if getattr(model, "effective_col_mode", None) == "strided":
+        label += ", strided column RDMA"
     print(f"ran {args.loops} x {args.filter_name} on {r}x{c} mesh "
           f"({label}) -> {args.output}")
     return 0

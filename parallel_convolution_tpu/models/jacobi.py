@@ -44,6 +44,8 @@ class JacobiSolver:
     #                ConvolutionModel; fused chunks only)
     overlap: bool | None = None  # interior-first overlapped halo pipeline
     #                (see ConvolutionModel; resolved in sharded_converge)
+    col_mode: str | None = None  # RDMA column-slab transport (packed |
+    #                strided | auto; see ConvolutionModel)
     solver: str = "jacobi"  # convergence strategy (utils.config.SOLVERS):
     #                "jacobi" = the reference's sweep loop; "multigrid" =
     #                the geometric V-cycle (solvers.multigrid) — same
@@ -93,6 +95,7 @@ class JacobiSolver:
                 backend=self.backend, storage=self.storage,
                 boundary=self.boundary, fuse=self.fuse, tile=self.tile,
                 overlap=self.overlap, mg_levels=self.mg_levels,
+                col_mode=self.col_mode,
             )
             self.last_mg = res
             return np.asarray(out), res.cycles
@@ -103,5 +106,6 @@ class JacobiSolver:
             boundary=self.boundary, storage=self.storage,
             fuse=self.fuse, tile=self.tile,
             interior_split=self.interior_split, overlap=self.overlap,
+            col_mode=self.col_mode,
         )
         return np.asarray(out), iters

@@ -62,6 +62,9 @@ class ConvolutionModel:
     #                (RDMA kernels): None = off for explicit backends /
     #                tuned for backend="auto"; True is a clamped request —
     #                the resolved knob lands in self.effective_overlap
+    col_mode: str | None = None  # RDMA column-slab transport (packed |
+    #                strided | auto; None = auto) — the resolved value
+    #                lands in self.effective_col_mode
     fallback: bool = False  # graceful backend degradation on transient
     #                compile/launch failure (resilience.degrade)
 
@@ -83,6 +86,8 @@ class ConvolutionModel:
         # The overlap knob the last run ACTUALLY compiled with (clamped
         # request / tuned decision / degrade re-clamp); None until a run.
         self.effective_overlap: bool | None = None
+        # The column transport the last run ACTUALLY compiled with.
+        self.effective_col_mode: str | None = None
 
     def set_mesh(self, mesh) -> "ConvolutionModel":
         """Swap the device mesh mid-object (elastic recovery).
@@ -102,10 +107,12 @@ class ConvolutionModel:
         self.effective_backend = None
         self.plan_source = "explicit"
         self.effective_overlap = None
+        self.effective_col_mode = None
         return self
 
-    def _resolved_knobs(self, hw: tuple[int, int],
-                        channels: int = 1) -> tuple[str, int, object, bool]:
+    def _resolved_knobs(
+            self, hw: tuple[int, int],
+            channels: int = 1) -> tuple[str, int, object, bool, str]:
         """Resolve for the REAL (H, W) workload: the probe must compile
         the same kernel family (block geometry + storage dtype) the run
         will, or it could pass while the run crashes.
@@ -117,7 +124,7 @@ class ConvolutionModel:
         otherwise) and is re-clamped if degradation leaves the RDMA tier.
         """
         backend, fuse, tile = self.backend, self.fuse, self.tile
-        overlap = self.overlap
+        overlap, col_mode = self.overlap, self.col_mode
         if backend == "auto":
             from parallel_convolution_tpu import tuning
 
@@ -125,44 +132,52 @@ class ConvolutionModel:
                 self.mesh, self.filt, (channels, *hw),
                 storage=self.storage, quantize=self.quantize,
                 boundary=self.boundary, fuse=fuse,
-                tile=step_lib._norm_tile(tile), overlap=overlap)
+                tile=step_lib._norm_tile(tile), overlap=overlap,
+                col_mode=col_mode)
             backend, fuse, tile = res.backend, res.fuse, res.tile
-            overlap = res.overlap
+            overlap, col_mode = res.overlap, res.col_mode
             self.plan_source = res.source
         else:
             fuse = 1 if fuse is None else fuse
             self.plan_source = "explicit"
         overlap = step_lib.resolve_overlap(overlap, backend, self.mesh)
-        if not self.fallback:
-            self.effective_backend = backend
-            self.effective_overlap = overlap
-            return backend, fuse, tile, overlap
         from parallel_convolution_tpu.parallel.mesh import (
             grid_shape, padded_extent,
         )
 
         R, C = grid_shape(self.mesh)
         block_hw = (padded_extent(hw[0], R) // R, padded_extent(hw[1], C) // C)
+        col_mode = step_lib.resolve_col_mode(
+            col_mode, backend, self.mesh, block_hw, self.filt.radius,
+            int(fuse), self.storage)
+        if not self.fallback:
+            self.effective_backend = backend
+            self.effective_overlap = overlap
+            self.effective_col_mode = col_mode
+            return backend, fuse, tile, overlap, col_mode
         eff = step_lib._resolve_fallback(
             self.mesh, self.filt, backend, self.quantize, fuse,
             self.boundary, step_lib._norm_tile(tile),
             self.interior_split, self.storage, block_hw=block_hw,
-            overlap=overlap)
+            overlap=overlap, col_mode=col_mode)
         overlap = overlap and eff == "pallas_rdma"
+        col_mode = step_lib.clamp_col_mode(col_mode, eff)
         self.effective_backend = eff
         self.effective_overlap = overlap
-        return eff, fuse, tile, overlap
+        self.effective_col_mode = col_mode
+        return eff, fuse, tile, overlap, col_mode
 
     # -- array-level API ----------------------------------------------------
     def run_planar(self, x, iters: int) -> jnp.ndarray:
         """(C, H, W) f32 in → (C, H, W) f32 out after ``iters`` iterations."""
-        backend, fuse, tile, overlap = self._resolved_knobs(
+        backend, fuse, tile, overlap, col_mode = self._resolved_knobs(
             x.shape[-2:], x.shape[0])
         return step_lib.sharded_iterate(
             x, self.filt, iters, mesh=self.mesh,
             quantize=self.quantize, backend=backend,
             storage=self.storage, fuse=fuse, boundary=self.boundary,
             tile=tile, interior_split=self.interior_split, overlap=overlap,
+            col_mode=col_mode,
         )
 
     def run_image(self, img: np.ndarray, iters: int) -> np.ndarray:
@@ -213,12 +228,13 @@ class ConvolutionModel:
             src, rows, cols, mode, self.mesh,
             dtype=np.dtype(STORAGE_DTYPES[self.storage]),
         )
-        backend, fuse, tile, overlap = self._resolved_knobs(
+        backend, fuse, tile, overlap, col_mode = self._resolved_knobs(
             (rows, cols), 3 if mode == "rgb" else 1)
         out = step_lib.iterate_prepared(
             xs, self.filt, iters, self.mesh, (rows, cols),
             quantize=self.quantize, backend=backend,
             fuse=fuse, boundary=self.boundary, tile=tile,
             interior_split=self.interior_split, overlap=overlap,
+            col_mode=col_mode,
         )
         sharded_io.save_sharded(dst, out, rows, cols, mode)

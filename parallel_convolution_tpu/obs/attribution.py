@@ -116,7 +116,8 @@ def predicted_exchange_split(
         storage: str, shape: tuple[int, int, int],
         tile: tuple[int, int] | None = None, quantize: bool = True,
         separable: bool = False, platform: str = "cpu",
-        device_kind: str = "", overlap: bool = False) -> dict:
+        device_kind: str = "", overlap: bool = False,
+        col_mode: str = "packed") -> dict:
     """Exchange-vs-compute attribution of one iteration's roofline time,
     overlap-adjusted.
 
@@ -151,14 +152,18 @@ def predicted_exchange_split(
         backend, tuple(grid), tuple(block_hw), int(radius), T)
     out = {"exchange_fraction": 0.0, "exchange_hidden_fraction": 0.0,
            "exchange_hidden_of_total": 0.0, "overlap": bool(overlap)}
+    persistent = backend in costmodel.PERSISTENT_BACKENDS
     ex = costmodel.exchange_seconds_per_px_iter(
-        tuple(grid), tuple(block_hw), int(radius), T, storage, hw)
+        tuple(grid), tuple(block_hw), int(radius), T, storage, hw,
+        persistent=persistent,
+        col_mode=col_mode if persistent else "packed")
     if ex == 0.0:
         return out
     tile_eff = costmodel.effective_tile(backend, tile)
     rim_tile = tile_eff if tile_eff is not None else tuple(block_hw)
     if backend == "pallas_rdma" and not costmodel.rdma_is_tiled(
-            tuple(shape), tuple(block_hw), int(radius), T, storage):
+            tuple(shape), tuple(block_hw), int(radius), T, storage,
+            col_mode=col_mode, grid=tuple(grid)):
         rim_tile = tuple(block_hw)
     sep = separable and backend in ("separable", "pallas_sep")
     t_hbm = costmodel.hbm_bytes_per_px_iter(
@@ -241,8 +246,16 @@ def record_step(*, backend: str, grid, block_hw, radius: int, fuse: int,
                 wall_s: float | None, shape, quantize: bool = True,
                 tile=None, platform: str = "cpu", device_kind: str = "",
                 source: str = "step", overlap: bool = False,
+                col_mode: str = "packed",
                 mg_level: int | None = None) -> dict | None:
     """Record one compiled-iterate call: wall, halo bytes, exchange split.
+
+    ``col_mode`` (round 16) stamps the resolved column-slab transport
+    into the exchange event; the per-slab wait series
+    ``pctpu_halo_slab_wait_seconds{direction, which}`` attributes the
+    exchange wall across the four slab channels by their byte share,
+    split exposed-vs-hidden — the partitioned-completion analogue of
+    the r12 hidden/exposed split, per slab instead of per phase.
 
     ``mg_level`` (round 15) attributes the call to one multigrid grid
     level: the exchange event carries the level and the sweep counter
@@ -270,7 +283,8 @@ def record_step(*, backend: str, grid, block_hw, radius: int, fuse: int,
     split = predicted_exchange_split(
         grid, block_hw, radius, fuse, backend=backend, storage=storage,
         shape=shape, tile=tile, quantize=quantize, separable=sep,
-        platform=platform, device_kind=device_kind, overlap=overlap)
+        platform=platform, device_kind=device_kind, overlap=overlap,
+        col_mode=col_mode)
     frac = split["exchange_fraction"]
     hidden_of_ex = split["exchange_hidden_fraction"]
     wall, ex_s, comp_s, hid_s, hbytes, rounds, iters_m = _m()
@@ -283,6 +297,27 @@ def record_step(*, backend: str, grid, block_hw, radius: int, fuse: int,
             # informational (it overlaps compute seconds, not additive).
             hid_s.inc(wall_s * split["exchange_hidden_of_total"],
                       backend=backend)
+        if by["total"] > 0:
+            # Per-slab wait attribution (round 16, partitioned
+            # completion): the exposed and hidden exchange walls spread
+            # across the four slab channels by byte share — the series
+            # that says WHICH ghost direction a decomposition waits on.
+            slab = metrics.counter(
+                "pctpu_halo_slab_wait_seconds",
+                "model-attributed exchange wall per halo slab channel, "
+                "exposed vs hidden-under-compute",
+                ("backend", "direction", "which"))
+            exposed_s = wall_s * frac
+            hidden_s = wall_s * split["exchange_hidden_of_total"]
+            for d in DIRECTIONS:
+                share = by[d] / by["total"]
+                if share <= 0.0:
+                    continue
+                slab.inc(exposed_s * share, backend=backend, direction=d,
+                         which="exposed")
+                if hidden_s > 0.0:
+                    slab.inc(hidden_s * share, backend=backend,
+                             direction=d, which="hidden")
     for d in DIRECTIONS:
         hbytes.inc(by[d], backend=backend, direction=d)
     rounds.inc(by["rounds"], backend=backend)
@@ -303,6 +338,7 @@ def record_step(*, backend: str, grid, block_hw, radius: int, fuse: int,
         halo_bytes={d: by[d] for d in DIRECTIONS},
         exchange_fraction=round(frac, 4),
         overlap=bool(split["overlap"]),
+        col_mode=str(col_mode),
         exchange_hidden_fraction=round(hidden_of_ex, 4),
         **({"mg_level": int(mg_level)} if mg_level is not None else {}),
         **({"wall_s": round(wall_s, 6)} if wall_s is not None else {}))

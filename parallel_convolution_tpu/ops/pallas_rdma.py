@@ -86,6 +86,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from parallel_convolution_tpu.ops.collective_ids import collective_id
 from parallel_convolution_tpu.ops.filters import Filter
+from parallel_convolution_tpu.parallel import channels
 from parallel_convolution_tpu.ops.pallas_stencil import (
     DEFAULT_TILE, _from_f32, _iterate_levels, _prefetch_window,
     _round_mode_for, _round_up, _sublane, _to_f32, on_tpu,
@@ -226,9 +227,83 @@ def overlap_regions(h: int, w: int, d: int):
     return keep(interior), keep(row_bands), keep(col_bands)
 
 
-def _rdma_kernel(in_ref, out_ref, pad, send_sem, recv_sem, *,
+def overlap_region_slabs(h: int, w: int, d: int):
+    """The labeled interior-first partition with each region's SLAB WAIT
+    SET: ``[(label, (r0, r1, c0, c1), frozenset(directions))]`` in the
+    partitioned schedule's compute order (interior, top, bottom, left,
+    right; empties dropped).
+
+    A region's wait set is exactly the slab channels whose inbound write
+    rectangle its ``(rows + 2d, cols + 2d)`` pad-coordinate read window
+    overlaps — derived here by interval intersection against the ghost
+    write rects (row slabs write interior columns only; column slabs
+    write the FULL padded height, which is how the two-hop corners
+    ride them).  Shared by the monolithic kernel's per-slab schedule and
+    the soundness property test in tests/test_channels.py: no missed
+    wait (a race), no extra wait (lost overlap).
+    """
+    interior, row_bands, col_bands = overlap_regions(h, w, d)
+    # Inbound write rectangles per slab channel, in pad coordinates.
+    writes = {
+        "up": ((0, d), (d, d + w)),
+        "down": ((h + d, h + 2 * d), (d, d + w)),
+        "left": ((0, h + 2 * d), (0, d)),
+        "right": ((0, h + 2 * d), (w + d, w + 2 * d)),
+    }
+
+    def waits(rect):
+        r0, r1, c0, c1 = rect
+        rr, cc = (r0, r1 + 2 * d), (c0, c1 + 2 * d)
+        return frozenset(
+            name for name, (gr, gc) in writes.items()
+            if rr[0] < gr[1] and gr[0] < rr[1]
+            and cc[0] < gc[1] and gc[0] < cc[1])
+
+    out = [("interior", rect, waits(rect)) for rect in interior]
+    for rect in row_bands:
+        out.append(("top" if rect[0] == 0 else "bottom", rect, waits(rect)))
+    for rect in col_bands:
+        out.append(("left" if rect[2] == 0 else "right", rect, waits(rect)))
+    return out
+
+
+def tiled_window_hazards(wi, wj, *, th, tw, h, w, sub_v, lane=128):
+    """Per-slab hazard geometry of one tiled-kernel window: whether the
+    ``(wi, wj)`` window's ``(th + 2*sub_v, tw + 2*lane)`` read region
+    overlaps each direction's transferred band (the region an in-flight
+    slab DMA writes).  Pure geometry — existence predicates (is there a
+    neighbor?) are applied by the caller.  Works on python ints (the
+    soundness property test) AND traced values (the kernel's deferred-
+    wait guards), so the two can never drift.
+    """
+    ext_h, ext_w = th + 2 * sub_v, tw + 2 * lane
+    return {"up": wi == 0, "down": wi * th + ext_h > h + sub_v,
+            "left": wj == 0, "right": wj * tw + ext_w > w + lane}
+
+
+# Packed column-transport staging slots (both kernels, one convention):
+# 0/1 = my contiguous outbound left/right slab; 2 = inbound payload for
+# my RIGHT ghost (the right neighbor's "left" channel lands here, SPMD
+# symmetry); 3 = inbound payload for my LEFT ghost.
+_PK_SEND = {"left": 0, "right": 1}
+_PK_LAND = {"left": 2, "right": 3}   # where MY channel lands on the receiver
+_PK_GHOST = {"right": 2, "left": 3}  # the slot holding MY side's ghost bytes
+
+
+def _packed_slab_copy(cstage, s, send_sem, recv_sem, nbr):
+    """The packed transport's ONE dense stage→stage RDMA for column slab
+    ``s`` — shared by both kernels so the staging-slot convention and
+    the semaphore pairing can never desynchronize between kernel forms
+    (the strided gather/scatter moves to local pack/unpack copies)."""
+    return pltpu.make_async_remote_copy(
+        cstage.at[_PK_SEND[s.direction]], cstage.at[_PK_LAND[s.direction]],
+        send_sem.at[s.sem], recv_sem.at[s.sem], device_id=nbr(*s.nbr))
+
+
+def _rdma_kernel(in_ref, out_ref, pad, send_sem, recv_sem, *scr, plan,
                  taps, sep, k, r, T, C, h, w, R, Cc, periodic, quantize,
-                 convex, round_mode, valid_hw, overlap=False):
+                 convex, round_mode, valid_hw, overlap=False,
+                 partitioned=True):
     """One device's program: exchange T·r-deep ghosts in-kernel, then run
     T stencil levels (temporal fusion — ONE exchange buys T iterations).
 
@@ -245,24 +320,42 @@ def _rdma_kernel(in_ref, out_ref, pad, send_sem, recv_sem, *,
 
     ``overlap=True`` is the interior-first pipeline (ROADMAP item 1, the
     persistent/partitioned-MPI overlap recipe): compute is split into the
-    :func:`overlap_regions` partition and interleaved with the two
-    exchange phases — interior under the in-flight row DMAs, top/bottom
-    bands under the column DMAs, left/right bands after the last receive
-    semaphore.  Bit-exact vs the serialized order because every output
+    :func:`overlap_regions` partition and interleaved with the exchange.
+    ``partitioned=True`` (the round-16 default) retires each SLAB
+    independently — every region waits on exactly the channels whose
+    inbound write rect its read window overlaps
+    (:func:`overlap_region_slabs`), so a band computes the moment ITS
+    OWN ghosts land; ``partitioned=False`` keeps the r12 phase-granular
+    order (both row slabs, then both column slabs) as the A/B reference.
+    Bit-exact vs the serialized order either way because every output
     pixel's level chain is a pure function of its own level-0 dependency
     cone, which each region's window contains by construction; the only
     reordering is BETWEEN independent pixels.  Safe vs the in-flight
     DMAs because each region reads only pad cells that are either local
     or already received (inbound ghost writes are disjoint from the
-    interior/band reads until their semaphore is waited).
+    region reads until their semaphore is waited — the wait-set
+    derivation IS that disjointness proof, pinned by the soundness test).
+
+    ``plan`` is the bound channel structure (``parallel.channels``):
+    slab rectangles, partners, and semaphore pairing come from the
+    cached per-identity plan instead of inline arithmetic.  A plan with
+    packed columns (``plan.packed_cols``) receives the staging scratch
+    as ``scr[0]`` and moves each column slab as pack → one dense RDMA →
+    unpack; the strided plan issues the direct strided copy.  Byte-
+    identical by construction — the unpack writes exactly the ghost
+    cells the strided copy would.
     """
+    cstage = scr[0] if scr else None
     d = r * T
     # Interior + boundary-ghost initialization.  Inbound RDMA targets are
-    # exactly the ghost regions owned by an existing neighbor, so local
-    # writes below never overlap a remote write (no ordering needed).
+    # exactly the ghost regions owned by an existing neighbor (packed
+    # columns land in the staging scratch first), so local writes below
+    # never overlap a remote write (no ordering needed).
     pad[:, d : d + h, d : d + w] = _to_f32(in_ref[...])
 
     up_in, down_in, left_in, right_in, nbr = _topology(R, Cc, periodic)
+    exists = {"up": up_in, "down": down_in,
+              "left": left_in, "right": right_in}
 
     zero_row = jnp.zeros((C, d, w), jnp.float32)
     zero_col = jnp.zeros((C, h + 2 * d, d), jnp.float32)
@@ -275,7 +368,7 @@ def _rdma_kernel(in_ref, out_ref, pad, send_sem, recv_sem, *,
     def _():
         pad[:, h + d : h + 2 * d, d : d + w] = zero_row
 
-    if periodic and R == 1:
+    if plan.row_wrap:
         # Torus of height 1: my own opposite edge wraps to me (static).
         pad[:, 0:d, d : d + w] = pad[:, h : h + d, d : d + w]
         pad[:, h + d : h + 2 * d, d : d + w] = pad[:, d : 2 * d, d : d + w]
@@ -316,48 +409,51 @@ def _rdma_kernel(in_ref, out_ref, pad, send_sem, recv_sem, *,
                     rows0=rows0, cols0=cols0, valid_hw=valid_hw)
                 out_ref[c, r0:r1, c0:c1] = _from_f32(acc, out_ref.dtype)
 
-    interior, row_bands, col_bands = (
-        overlap_regions(h, w, d) if overlap
-        else ([], [], [(0, h, 0, w)]))  # serialized: one whole-block call
+    # --- Channel descriptors, bound from the PLAN's slab table.  On a
+    # degenerate axis the plan simply has no slab — not even the
+    # descriptor is constructed, so the 1x1 program is the serialized
+    # local program verbatim, independent of col_mode.
+    def _slab_copy(s):
+        if s.direction in ("left", "right") and cstage is not None:
+            return _packed_slab_copy(cstage, s, send_sem, recv_sem, nbr)
+        return pltpu.make_async_remote_copy(
+            pad.at[:, s.src_rows[0] : s.src_rows[1],
+                   s.src_cols[0] : s.src_cols[1]],
+            pad.at[:, s.dst_rows[0] : s.dst_rows[1],
+                   s.dst_cols[0] : s.dst_cols[1]],
+            send_sem.at[s.sem], recv_sem.at[s.sem], device_id=nbr(*s.nbr))
 
-    # --- Phase 1: rows.  My top d interior rows -> upper neighbor's
-    # bottom ghost; my bottom d interior rows -> lower neighbor's top
-    # ghost (d <= h, enforced at the launch).
-    send_up = pltpu.make_async_remote_copy(
-        pad.at[:, d : 2 * d, d : d + w],
-        pad.at[:, h + d : h + 2 * d, d : d + w],
-        send_sem.at[_UP], recv_sem.at[_UP], device_id=nbr(-1, 0),
-    )
-    send_down = pltpu.make_async_remote_copy(
-        pad.at[:, h : h + d, d : d + w],
-        pad.at[:, 0:d, d : d + w],
-        send_sem.at[_DOWN], recv_sem.at[_DOWN], device_id=nbr(+1, 0),
-    )
-    row_dma = not (periodic and R == 1)
-    if row_dma:
-        _when(up_in)(send_up.start)
-        _when(down_in)(send_down.start)
+    copies = {s.direction: _slab_copy(s) for s in plan.slabs()}
 
-    # Interior-first: the middle of the block needs no ghost byte — its
-    # level-0 window reads only the local interior (which the outbound
-    # sends also read, read-vs-read), never a cell an inbound DMA writes.
-    compute(interior)
+    def retire(direction):
+        """Retire ONE slab channel: wait my outbound send plus the
+        inbound ghost write (the OPPOSITE channel's recv semaphore —
+        SPMD symmetry: my top ghost is written by the upper neighbor's
+        "down" channel), and unpack the staged payload for packed
+        columns.  No-op for directions with no channel."""
+        if direction not in copies:
+            return
+        g = exists[direction]
+        _when(g)(copies[direction].wait_send)
+        _when(g)(copies[channels.OPPOSITE[direction]].wait_recv)
+        if cstage is not None and direction == "left":
+            @_when(g)
+            def _():
+                pad[:, :, 0:d] = cstage[_PK_GHOST["left"]]
+        if cstage is not None and direction == "right":
+            @_when(g)
+            def _():
+                pad[:, :, w + d : w + 2 * d] = cstage[_PK_GHOST["right"]]
 
-    if row_dma:
-        _when(up_in)(send_up.wait_send)
-        _when(down_in)(send_down.wait_send)
-        # My bottom ghost is written by my lower neighbor's send_up copy,
-        # which signals MY recv_sem[_UP] (SPMD symmetry), and vice versa.
-        _when(down_in)(send_up.wait_recv)
-        _when(up_in)(send_down.wait_recv)
-
-    # --- Phase 2: columns at FULL padded height (includes the row ghosts
-    # that just arrived -> corners propagate in two hops, halo.py §order).
-    if periodic and Cc == 1:
-        pad[:, :, 0:d] = pad[:, :, w : w + d]
-        pad[:, :, w + d : w + 2 * d] = pad[:, :, d : 2 * d]
-        compute(row_bands)
-    else:
+    def start_cols():
+        # Phase 2: column channels at FULL padded height — they carry
+        # the just-arrived row ghosts, so corners propagate in two hops
+        # exactly as in halo.py.  Callable only after both row slabs
+        # retired (the schedules below guarantee it).
+        if plan.col_wrap:
+            pad[:, :, 0:d] = pad[:, :, w : w + d]
+            pad[:, :, w + d : w + 2 * d] = pad[:, :, d : 2 * d]
+            return
 
         @_unless(left_in)
         def _():
@@ -367,33 +463,94 @@ def _rdma_kernel(in_ref, out_ref, pad, send_sem, recv_sem, *,
         def _():
             pad[:, :, w + d : w + 2 * d] = zero_col
 
-        send_left = pltpu.make_async_remote_copy(
-            pad.at[:, :, d : 2 * d],
-            pad.at[:, :, w + d : w + 2 * d],
-            send_sem.at[_LEFT], recv_sem.at[_LEFT], device_id=nbr(0, -1),
-        )
-        send_right = pltpu.make_async_remote_copy(
-            pad.at[:, :, w : w + d],
-            pad.at[:, :, 0:d],
-            send_sem.at[_RIGHT], recv_sem.at[_RIGHT], device_id=nbr(0, +1),
-        )
-        _when(left_in)(send_left.start)
-        _when(right_in)(send_right.start)
+        if cstage is not None:
+            # Pack: gather each strided column slab into its contiguous
+            # send slot so the RDMA below is one dense descriptor.
+            @_when(left_in)
+            def _():
+                cstage[_PK_SEND["left"]] = pad[:, :, d : 2 * d]
 
-        # Top/bottom bands on interior columns read row ghosts (arrived)
-        # plus local interior — never a column-ghost cell, so they hide
-        # the column phase exactly as the interior hid the row phase.
-        compute(row_bands)
+            @_when(right_in)
+            def _():
+                cstage[_PK_SEND["right"]] = pad[:, :, w : w + d]
 
-        _when(left_in)(send_left.wait_send)
-        _when(right_in)(send_right.wait_send)
-        _when(right_in)(send_left.wait_recv)
-        _when(left_in)(send_right.wait_recv)
+        for s in plan.col_slabs:
+            _when(exists[s.direction])(copies[s.direction].start)
 
-    # --- Rim finish (overlap) / whole-block compute (serialized): the
-    # full-height left/right bands read the column ghosts and the corner
-    # bytes that rode them — everything has landed by now.
-    compute(col_bands)
+    # --- Phase 1: rows.  My top d interior rows -> upper neighbor's
+    # bottom ghost; my bottom d interior rows -> lower neighbor's top
+    # ghost (d <= h, enforced at the launch).
+    for s in plan.row_slabs:
+        _when(exists[s.direction])(copies[s.direction].start)
+
+    # --- Schedule.  Each region computes after exactly its wait set has
+    # retired; the first column-ghost reader starts phase 2 (which
+    # itself requires both row slabs landed — full-height column slabs
+    # read the row ghosts).
+    regions = (overlap_region_slabs(h, w, d) if overlap
+               else [("whole", (0, h, 0, w),
+                      frozenset(("up", "down", "left", "right")))])
+    retired: set = set()
+    cols_started = [False]
+
+    def ensure(waits):
+        for direction in ("up", "down"):
+            if direction in waits and direction not in retired:
+                retire(direction)
+                retired.add(direction)
+        # Start the column phase the MOMENT both row slabs have retired
+        # (full-height column slabs read the row ghosts — the corner
+        # dependency), not only when a column reader appears: the
+        # regions computed between here and the first column reader
+        # (the bottom band, in the partitioned schedule) then run under
+        # the in-flight column DMAs.
+        if not cols_started[0] and {"up", "down"} <= retired:
+            start_cols()
+            cols_started[0] = True
+        if waits & {"left", "right"} and not cols_started[0]:
+            for direction in ("up", "down"):
+                if direction not in retired:
+                    retire(direction)
+                    retired.add(direction)
+            start_cols()
+            cols_started[0] = True
+        for direction in ("left", "right"):
+            if direction in waits and direction not in retired:
+                retire(direction)
+                retired.add(direction)
+
+    if overlap and partitioned:
+        # Per-slab: interior under the in-flight row DMAs (empty wait
+        # set), each band the moment its own ghosts land, the bottom
+        # band under the in-flight column DMAs.
+        for _label, rect, waits in regions:
+            ensure(waits)
+            compute([rect])
+    elif overlap:
+        # r12 phase-granular order (the A/B reference): interior under
+        # the row DMAs, both row slabs retire together, the row bands
+        # hide the column phase, both column slabs retire together.
+        compute([rect for _l, rect, ws in regions if not ws])
+        ensure(frozenset(("up", "down")))  # retires rows AND starts cols
+        compute([rect for _l, rect, ws in regions
+                 if ws and not ws & {"left", "right"}])
+        ensure(frozenset(("left", "right")))
+        compute([rect for _l, rect, ws in regions
+                 if ws & {"left", "right"}])
+    else:
+        # Serialized: the whole exchange completes before the one
+        # whole-block compute — the validated pre-overlap protocol
+        # (ensure() starts the column phase once both row slabs retire).
+        ensure(frozenset(("up", "down")))
+        ensure(frozenset(("left", "right")))
+        compute([rect for _l, rect, _w in regions])
+
+    # Channel hygiene: every live slab's semaphores retire before exit
+    # even when its band was empty (degenerate geometry can drop a band
+    # whose channel still flew); ensure() starts the column phase here
+    # if nothing did earlier.
+    ensure(frozenset(("up", "down")))
+    ensure(frozenset(("left", "right")))
 
 
 # ---------------------------------------------------------------------------
@@ -459,10 +616,10 @@ def _or2(a, b):
     return jnp.logical_or(a, b)
 
 
-def _rdma_tiled_kernel(in_ref, out_ref, pad, win, wsems, xsem, send_sem,
-                       recv_sem, flags, *, taps, sep, k, r, T, C, h, w, R, Cc,
-                       periodic, quantize, convex, th, tw, sub_v, round_mode,
-                       valid_hw, overlap=False):
+def _rdma_tiled_kernel(in_ref, out_ref, pad, *rest, plan, taps, sep, k, r,
+                       T, C, h, w, R, Cc, periodic, quantize, convex, th,
+                       tw, sub_v, round_mode, valid_hw, overlap=False,
+                       partitioned=True):
     """HBM-pad windowed variant; ``overlap=True`` is the interior-first
     pipeline at window granularity.
 
@@ -473,21 +630,39 @@ def _rdma_tiled_kernel(in_ref, out_ref, pad, win, wsems, xsem, send_sem,
     Overlapped: step 0 only STARTS the row-band DMAs; the window
     traversal is rotated by one on both grid axes so the rim windows
     (the only ones whose (ext_h, ext_w) read window reaches a ghost
-    band) are visited last, and a 3-state ledger in SMEM scratch
-    (``flags[0]``: 0 = rows in flight, 1 = rows done + columns in
-    flight, 2 = all landed) defers every semaphore wait to the first
-    window whose read window actually overlaps a still-pending transfer
-    — interior windows stream and compute under the in-flight exchange.
-    Sound because grid programs run sequentially on one core with
-    shared scratch (the same property the step-0-exchange design
-    already relies on), waits recreate the identical copy descriptors,
-    the ledger transitions are monotonic, and the rim windows that
-    trigger each transition provably exist in every grid (window row 0
-    / last row, column 0 / last column).  The column phase still starts
-    only after the row receives (its full-height bands carry the
-    two-hop corner bytes), so the exchange protocol — order, slabs,
-    semaphore pairing — is unchanged; only the waits move later.
+    band) are visited last, and an SMEM ledger defers every semaphore
+    wait to the first window whose read window actually overlaps a
+    still-pending transfer — interior windows stream and compute under
+    the in-flight exchange.  ``partitioned=True`` (round 16) is the
+    PER-SLAB ledger: one flag per slab channel (up/down/left/right —
+    the fused ghost depth rides each band's geometry) plus a
+    column-phase-started flag, so a window waits on exactly the slabs
+    its read region overlaps (:func:`tiled_window_hazards`) and a tile
+    computes the moment ITS OWN ghosts land.  ``partitioned=False``
+    keeps the r12 3-state phase ledger (``flags[0]``: 0 = rows in
+    flight, 1 = rows done + columns in flight, 2 = all landed) as the
+    A/B reference.  Sound either way because grid programs run
+    sequentially on one core with shared scratch (the same property the
+    step-0-exchange design already relies on), waits recreate the
+    identical copy descriptors from the bound channel plan, the ledger
+    transitions are monotonic, and the rim windows that trigger each
+    retirement provably exist in every grid (window row 0 / last row,
+    column 0 / last column).  The column phase still starts only after
+    BOTH row receives (its full-height bands carry the two-hop corner
+    bytes), so the exchange protocol — order, slabs, semaphore pairing
+    — is unchanged; only the waits move later and split finer.
+
+    ``plan`` is the bound channel structure (``parallel.channels``).
+    ``plan.packed_cols`` receives the HBM staging scratch as ``rest[0]``
+    and moves each column band as pack → one dense RDMA → unpack
+    (byte-identical: the unpack writes exactly the band the strided
+    copy would); the strided plan issues the direct strided band copy.
     """
+    if plan.packed_cols:
+        cstage, win, wsems, xsem, send_sem, recv_sem, flags = rest
+    else:
+        win, wsems, xsem, send_sem, recv_sem, flags = rest
+        cstage = None
     LANE = 128
     d = r * T  # ghost depth; <= min(sub_v, LANE) so one band carries it
     ext_h, ext_w = th + 2 * sub_v, tw + 2 * LANE
@@ -496,12 +671,14 @@ def _rdma_tiled_kernel(in_ref, out_ref, pad, win, wsems, xsem, send_sem,
     step = (c * ni + vi) * nj + vj
 
     up_in, down_in, left_in, right_in, nbr = _topology(R, Cc, periodic)
+    exists = {"up": up_in, "down": down_in,
+              "left": left_in, "right": right_in}
 
     row_remote = R > 1   # remote row-band DMAs exist in this program
     col_remote = Cc > 1  # remote column-band DMAs exist
     # Periodic self-wrap columns on a multi-row grid: the local wrap
     # copies read the FULL padded height, so under overlap they must
-    # run after the row receives — i.e. at the 0->1 ledger transition,
+    # run after the row receives — i.e. at the column-phase transition,
     # not at step 0 — and windows reading column ghosts must wait on
     # that transition even though no remote column DMA exists.
     col_wrap_deferred = periodic and Cc == 1 and row_remote
@@ -514,8 +691,14 @@ def _rdma_tiled_kernel(in_ref, out_ref, pad, win, wsems, xsem, send_sem,
     else:
         i, j = vi, vj
 
+    # Ledger slots in the SMEM scratch (shared across the sequential
+    # grid programs of one core): slot 0 is the r12 3-state phase
+    # ledger; slots 1..5 are the per-slab map — each slab's landed flag
+    # plus the column-phase-started flag.
+    F_PHASE, F_UP, F_DOWN, F_COL, F_LEFT, F_RIGHT = 0, 1, 2, 3, 4, 5
+
     # -- exchange pieces, each buildable at any program (descriptors are
-    # pure functions of the topology; a wait only needs the semaphore).
+    # bound from the PLAN's slab table; a wait only needs the semaphore).
     def _local_row_wrap():
         for src, dst, sl in (((sub_v, 2 * sub_v),
                               (h + sub_v, h + 2 * sub_v), _UP),
@@ -538,45 +721,75 @@ def _rdma_tiled_kernel(in_ref, out_ref, pad, win, wsems, xsem, send_sem,
             cp.start()
             cp.wait()
 
+    def _slab_copy(s):
+        if s.direction in ("left", "right") and cstage is not None:
+            return _packed_slab_copy(cstage, s, send_sem, recv_sem, nbr)
+
+        def ref(rows, cols):
+            if rows is None:  # column bands run the full padded height
+                return pad.at[:, :, cols[0] : cols[1]]
+            return pad.at[:, rows[0] : rows[1], cols[0] : cols[1]]
+
+        return pltpu.make_async_remote_copy(
+            ref(s.src_rows, s.src_cols), ref(s.dst_rows, s.dst_cols),
+            send_sem.at[s.sem], recv_sem.at[s.sem], device_id=nbr(*s.nbr))
+
     def _row_copies():
-        su = pltpu.make_async_remote_copy(
-            pad.at[:, sub_v : 2 * sub_v, LANE : LANE + w],
-            pad.at[:, h + sub_v : h + 2 * sub_v, LANE : LANE + w],
-            send_sem.at[_UP], recv_sem.at[_UP], device_id=nbr(-1, 0),
-        )
-        sd = pltpu.make_async_remote_copy(
-            pad.at[:, h : h + sub_v, LANE : LANE + w],
-            pad.at[:, 0:sub_v, LANE : LANE + w],
-            send_sem.at[_DOWN], recv_sem.at[_DOWN], device_id=nbr(+1, 0),
-        )
-        return su, sd
+        return _slab_copy(plan.slab("up")), _slab_copy(plan.slab("down"))
 
     def _col_copies():
-        sl_ = pltpu.make_async_remote_copy(
-            pad.at[:, :, LANE : 2 * LANE],
-            pad.at[:, :, w + LANE : w + 2 * LANE],
-            send_sem.at[_LEFT], recv_sem.at[_LEFT], device_id=nbr(0, -1),
-        )
-        sr = pltpu.make_async_remote_copy(
-            pad.at[:, :, w : w + LANE],
-            pad.at[:, :, 0:LANE],
-            send_sem.at[_RIGHT], recv_sem.at[_RIGHT], device_id=nbr(0, +1),
-        )
-        return sl_, sr
+        return _slab_copy(plan.slab("left")), _slab_copy(plan.slab("right"))
+
+    def _pack_cols():
+        # Gather each strided column band into its contiguous send slot
+        # (aligned local HBM copies) so the RDMA is one dense descriptor.
+        for direction, src in (("left", pad.at[:, :, LANE : 2 * LANE]),
+                               ("right", pad.at[:, :, w : w + LANE])):
+            @_when(exists[direction])
+            def _(direction=direction, src=src):
+                cp = pltpu.make_async_copy(
+                    src, cstage.at[_PK_SEND[direction]], xsem)
+                cp.start()
+                cp.wait()
+
+    def _unpack_col(direction):
+        dst = (pad.at[:, :, 0:LANE] if direction == "left"
+               else pad.at[:, :, w + LANE : w + 2 * LANE])
+        cp = pltpu.make_async_copy(
+            cstage.at[_PK_GHOST[direction]], dst, xsem)
+        cp.start()
+        cp.wait()
 
     def _start_rows():
         su, sd = _row_copies()
         _when(up_in)(su.start)
         _when(down_in)(sd.start)
 
-    def _wait_rows():
+    def _retire_up():
+        # My top ghost is written by my upper neighbor's "down" channel
+        # (it signals MY recv_sem[_DOWN]) — SPMD symmetry; pairing my
+        # outbound up-send's wait here keeps each slab's semaphore
+        # hygiene self-contained.  No row channels (R==1) = statically
+        # nothing to retire: these helpers are traced inside guards
+        # whose predicates can be dynamic (the legacy phase ledger's
+        # need_any), so they must be constructible on ANY grid — the
+        # same rule the monolithic kernel's copies-dict lookup applies.
+        if not plan.row_slabs:
+            return
         su, sd = _row_copies()
         _when(up_in)(su.wait_send)
-        _when(down_in)(sd.wait_send)
-        # My top ghost is written by my upper neighbor's send_down (it
-        # signals MY recv_sem[_DOWN]) and vice versa — SPMD symmetry.
-        _when(down_in)(su.wait_recv)
         _when(up_in)(sd.wait_recv)
+
+    def _retire_down():
+        if not plan.row_slabs:
+            return
+        su, sd = _row_copies()
+        _when(down_in)(sd.wait_send)
+        _when(down_in)(su.wait_recv)
+
+    def _wait_rows():
+        _retire_up()
+        _retire_down()
 
     def _start_cols():
         # Phase 2 initiation: column bands at FULL padded height — the
@@ -586,16 +799,33 @@ def _rdma_tiled_kernel(in_ref, out_ref, pad, win, wsems, xsem, send_sem,
         if periodic and Cc == 1:
             _local_col_wrap()
         elif col_remote:
+            if cstage is not None:
+                _pack_cols()
             sl_, sr = _col_copies()
             _when(left_in)(sl_.start)
             _when(right_in)(sr.start)
 
-    def _wait_cols():
+    def _retire_left():
+        if not plan.col_slabs:
+            return
         sl_, sr = _col_copies()
         _when(left_in)(sl_.wait_send)
+        _when(left_in)(sr.wait_recv)
+        if cstage is not None:
+            _when(left_in)(lambda: _unpack_col("left"))
+
+    def _retire_right():
+        if not plan.col_slabs:
+            return
+        sl_, sr = _col_copies()
         _when(right_in)(sr.wait_send)
         _when(right_in)(sl_.wait_recv)
-        _when(left_in)(sr.wait_recv)
+        if cstage is not None:
+            _when(right_in)(lambda: _unpack_col("right"))
+
+    def _wait_cols():
+        _retire_left()
+        _retire_right()
 
     @pl.when(step == 0)
     def _exchange():
@@ -622,15 +852,31 @@ def _rdma_tiled_kernel(in_ref, out_ref, pad, win, wsems, xsem, send_sem,
             elif col_remote:
                 _start_cols()
                 _wait_cols()
-        else:
+        elif not partitioned:
+            # r12 phase ledger (the A/B reference).
             if row_remote:
                 _start_rows()
-                flags[0] = jnp.int32(0)
+                flags[F_PHASE] = jnp.int32(0)
             else:
                 # Rows already complete (local wrap / no axis): the
                 # column phase can start under the very first windows.
                 _start_cols()
-                flags[0] = jnp.int32(1 if col_remote else 2)
+                flags[F_PHASE] = jnp.int32(1 if col_remote else 2)
+        else:
+            # Per-slab ledger: every slab flag initialized here (SMEM
+            # scratch is uninitialized and shared across programs).
+            if row_remote:
+                _start_rows()
+                flags[F_UP] = jnp.int32(0)
+                flags[F_DOWN] = jnp.int32(0)
+                flags[F_COL] = jnp.int32(0)
+            else:
+                _start_cols()
+                flags[F_UP] = jnp.int32(1)
+                flags[F_DOWN] = jnp.int32(1)
+                flags[F_COL] = jnp.int32(1)
+            flags[F_LEFT] = jnp.int32(0 if col_remote else 1)
+            flags[F_RIGHT] = jnp.int32(0 if col_remote else 1)
 
     # -- deferred-wait guard: runs before a window copy is ISSUED, with
     # the window's indices — waits exactly when that window's read
@@ -639,34 +885,84 @@ def _rdma_tiled_kernel(in_ref, out_ref, pad, win, wsems, xsem, send_sem,
         if not engage:
             return
         # Geometric touch: the (ext_h, ext_w) read window vs the four
-        # ghost bands; hazardous only where an actual transfer writes
-        # (the _in predicates — non-live ghost regions hold garbage the
-        # valid-box mask kills, no ordering needed).
-        top, bot = wi == 0, wi * th + ext_h > h + sub_v
-        lef, rig = wj == 0, wj * tw + ext_w > w + LANE
-        need_row = (_or2(_and2(top, up_in), _and2(bot, down_in))
-                    if row_remote else False)
+        # transferred bands (tiled_window_hazards — shared with the
+        # soundness property test); hazardous only where an actual
+        # transfer writes (the _in predicates — non-live ghost regions
+        # hold garbage the valid-box mask kills, no ordering needed).
+        hz = tiled_window_hazards(wi, wj, th=th, tw=tw, h=h, w=w,
+                                  sub_v=sub_v)
+        top, bot, lef, rig = hz["up"], hz["down"], hz["left"], hz["right"]
         if col_remote:
             need_col = _or2(_and2(lef, left_in), _and2(rig, right_in))
         elif col_wrap_deferred:
             # Self-wrap ghosts are VALID data (periodic valid box), but
-            # written only at the 0->1 transition — any reader waits.
+            # written only at the column transition — any reader waits.
             need_col = _or2(lef, rig)
         else:
             need_col = False
-        need_any = _or2(need_row, need_col)
+        if not partitioned:
+            # r12 3-state phase ledger (kept as the A/B reference).
+            need_row = (_or2(_and2(top, up_in), _and2(bot, down_in))
+                        if row_remote else False)
+            need_any = _or2(need_row, need_col)
 
-        @_when(_and2(need_any, flags[0] == 0))
-        def _():
-            _wait_rows()
-            _start_cols()
-            flags[0] = jnp.int32(1 if col_remote else 2)
-
-        if col_remote and need_col is not False:
-            @_when(_and2(need_col, flags[0] == 1))
+            @_when(_and2(need_any, flags[F_PHASE] == 0))
             def _():
-                _wait_cols()
-                flags[0] = jnp.int32(2)
+                _wait_rows()
+                _start_cols()
+                flags[F_PHASE] = jnp.int32(1 if col_remote else 2)
+
+            if col_remote and need_col is not False:
+                @_when(_and2(need_col, flags[F_PHASE] == 1))
+                def _():
+                    _wait_cols()
+                    flags[F_PHASE] = jnp.int32(2)
+            return
+        # Per-slab retirement: each slab the moment a window first
+        # overlaps its band — the window computes once ITS OWN ghosts
+        # land, not once the whole phase does.
+        if row_remote:
+            @_when(_and2(top, flags[F_UP] == 0))
+            def _():
+                _retire_up()
+                flags[F_UP] = jnp.int32(1)
+
+            @_when(_and2(bot, flags[F_DOWN] == 0))
+            def _():
+                _retire_down()
+                flags[F_DOWN] = jnp.int32(1)
+        if need_col is not False:
+            # Column transition: the full-height column bands read the
+            # row ghosts, so any still-pending row slab retires first.
+            @_when(_and2(need_col, flags[F_COL] == 0))
+            def _():
+                if row_remote:
+                    @_when(flags[F_UP] == 0)
+                    def _():
+                        _retire_up()
+
+                    @_when(flags[F_DOWN] == 0)
+                    def _():
+                        _retire_down()
+
+                    flags[F_UP] = jnp.int32(1)
+                    flags[F_DOWN] = jnp.int32(1)
+                _start_cols()
+                flags[F_COL] = jnp.int32(1)
+        if col_remote:
+            # Per-slab column retirement (guarded on the phase having
+            # started — never wait a DMA that was not issued).
+            @_when(_and2(lef, _and2(flags[F_COL] == 1,
+                                    flags[F_LEFT] == 0)))
+            def _():
+                _retire_left()
+                flags[F_LEFT] = jnp.int32(1)
+
+            @_when(_and2(rig, _and2(flags[F_COL] == 1,
+                                    flags[F_RIGHT] == 0)))
+            def _():
+                _retire_right()
+                flags[F_RIGHT] = jnp.int32(1)
 
     # --- Compute: the _stencil_kernel windowed-DMA grid over the HBM pad.
     def window_copy(cc, ai, aj, s):
@@ -726,7 +1022,7 @@ def _rdma_tiled_kernel(in_ref, out_ref, pad, win, wsems, xsem, send_sem,
     jax.jit,
     static_argnames=("filt", "grid", "boundary", "quantize", "out_dtype",
                      "interpret", "tiled", "tile", "pad_operand", "fuse",
-                     "valid_hw", "overlap"),
+                     "valid_hw", "overlap", "col_mode", "partitioned"),
 )
 def fused_rdma_step(
     block: jnp.ndarray,
@@ -742,6 +1038,8 @@ def fused_rdma_step(
     fuse: int = 1,
     valid_hw: tuple[int, int] | None = None,
     overlap: bool = False,
+    col_mode: str = "strided",
+    partitioned: bool = True,
 ) -> jnp.ndarray:
     """``fuse`` halo-fused stencil iterations, entirely inside one kernel.
 
@@ -786,6 +1084,25 @@ def fused_rdma_step(
     layer (``parallel/step.py``) resolves when this knob is on; callers
     there never pass it blindly.
 
+    ``col_mode`` selects the COLUMN-SLAB transport (round 16, the
+    derived-datatypes A/B): ``"strided"`` (the default — the historical
+    program) issues the direct strided copy; ``"packed"`` gathers each
+    column slab into a contiguous staging buffer (VMEM for the
+    monolithic kernel, HBM for the tiled one), moves it with ONE dense
+    RDMA, and scatters it into the ghost ring on the receiver —
+    byte-identical by construction, a pure descriptor-shape trade the
+    cost model prices (``tuning.costmodel.pick_col_mode``; the dispatch
+    layer resolves ``"auto"`` before calling here).  On a grid with no
+    remote column partner both modes compile the identical statically-
+    elided program (no staging scratch is even allocated).
+
+    ``partitioned`` selects the completion granularity of the
+    overlapped pipeline (round 16): ``True`` (default) retires each
+    ghost slab independently — a region/window computes the moment its
+    own ghosts land (``parallel.channels`` per-slab semaphore map);
+    ``False`` keeps the r12 phase-granular ledger as the A/B reference.
+    Serialized launches (``overlap=False``) ignore it.
+
     ``pad_operand`` (tiled variant only) chooses how the HBM pad buffer
     is provided.  ``False``: as an ``pltpu.MemorySpace.HBM``
     ``scratch_shapes`` entry — the natural form, but the round-5 probe
@@ -810,6 +1127,11 @@ def fused_rdma_step(
     fault_point("halo_exchange")
     if boundary not in BOUNDARIES:
         raise ValueError(f"boundary must be one of {BOUNDARIES}, got {boundary!r}")
+    if col_mode not in channels.COL_MODES:
+        raise ValueError(
+            f"col_mode must be one of {channels.COL_MODES} at the kernel "
+            f"layer ('auto' is resolved by dispatch — "
+            f"parallel.step.resolve_col_mode), got {col_mode!r}")
     if interpret is None:
         interpret = not on_tpu()
     if interpret is True:
@@ -851,6 +1173,11 @@ def fused_rdma_step(
     if tiled is None:
         mono_bytes = (C * (h + 2 * d) * (w + 2 * d) * 4
                       + C * h * w * jnp.dtype(out_dtype).itemsize)
+        if col_mode == "packed" and grid[1] > 1:
+            # The packed transport's 4 f32 staging slots live in VMEM
+            # for the monolithic kernel — they count against the same
+            # budget (mirrored in costmodel.rdma_is_tiled).
+            mono_bytes += 4 * C * (h + 2 * d) * d * 4
         tiled = mono_bytes > _TILED_VMEM_BYTES
         if tiled and (d > min(sub_v, 128) or h < sub_v or w < 128):
             # Silently falling back to the monolithic kernel here would
@@ -869,21 +1196,37 @@ def fused_rdma_step(
     # compiled-probe guard it consults on silicon) entirely.
     round_mode = (_round_mode_for(taps, interpret is not False)
                   if quantize else "rint")
+    # The persistent channel plan: descriptor geometry bound ONCE per
+    # exchange identity (parallel.channels) and fetched from the
+    # process-global cache by every trace that shares it — fused
+    # iteration chunks, converge chunks, multigrid V-cycle levels.
+    ckey = channels.ChannelKey(
+        grid=(int(grid[0]), int(grid[1])), block_hw=(h, w), radius=r,
+        fuse=T, dtype=str(jnp.dtype(block.dtype).name), boundary=boundary,
+        kernel="tiled" if tiled else "monolithic", col_mode=col_mode)
+    plan = channels.plan_for(ckey)
     if not tiled:
         kernel = functools.partial(
-            _rdma_kernel, taps=taps, sep=sep, k=k, r=r, T=T, C=C, h=h, w=w,
-            R=grid[0], Cc=grid[1], periodic=periodic, quantize=quantize,
-            convex=filt.convex, round_mode=round_mode, valid_hw=kern_valid,
-            overlap=bool(overlap),
+            _rdma_kernel, plan=plan, taps=taps, sep=sep, k=k, r=r, T=T,
+            C=C, h=h, w=w, R=grid[0], Cc=grid[1], periodic=periodic,
+            quantize=quantize, convex=filt.convex, round_mode=round_mode,
+            valid_hw=kern_valid, overlap=bool(overlap),
+            partitioned=bool(partitioned),
         )
+        scratch = [
+            pltpu.VMEM((C, h + 2 * d, w + 2 * d), jnp.float32),
+            pltpu.SemaphoreType.DMA((4,)),
+            pltpu.SemaphoreType.DMA((4,)),
+        ]
+        if plan.packed_cols:
+            # Column staging: 2 contiguous outbound + 2 inbound slots
+            # (the dense-RDMA endpoints of the packed transport).
+            scratch.append(
+                pltpu.VMEM((4, C, h + 2 * d, d), jnp.float32))
         return pl.pallas_call(
             kernel,
             out_shape=shape_struct((C, h, w), out_dtype, vma),
-            scratch_shapes=[
-                pltpu.VMEM((C, h + 2 * d, w + 2 * d), jnp.float32),
-                pltpu.SemaphoreType.DMA((4,)),
-                pltpu.SemaphoreType.DMA((4,)),
-            ],
+            scratch_shapes=scratch,
             compiler_params=cparams,
             interpret=interpret,
         )(block)
@@ -918,10 +1261,11 @@ def fused_rdma_step(
     w_pad = max((gw - 1) * tw + ext_w, w + 2 * LANE)
 
     kernel = functools.partial(
-        _rdma_tiled_kernel, taps=taps, sep=sep, k=k, r=r, T=T, C=C, h=h,
-        w=w, R=grid[0], Cc=grid[1], periodic=periodic, quantize=quantize,
-        convex=filt.convex, th=th, tw=tw, sub_v=sub_v,
+        _rdma_tiled_kernel, plan=plan, taps=taps, sep=sep, k=k, r=r, T=T,
+        C=C, h=h, w=w, R=grid[0], Cc=grid[1], periodic=periodic,
+        quantize=quantize, convex=filt.convex, th=th, tw=tw, sub_v=sub_v,
         round_mode=round_mode, valid_hw=kern_valid, overlap=bool(overlap),
+        partitioned=bool(partitioned),
     )
     # Rim-last traversal under the overlapped pipeline: the out index
     # map applies the same +1 rotation the kernel applies to its window
@@ -933,8 +1277,13 @@ def fused_rdma_step(
         pltpu.SemaphoreType.DMA(()),
         pltpu.SemaphoreType.DMA((4,)),
         pltpu.SemaphoreType.DMA((4,)),
-        pltpu.SMEM((1,), jnp.int32),  # deferred-wait ledger (overlap)
+        pltpu.SMEM((8,), jnp.int32),  # deferred-wait ledger: slot 0 =
+        #                               r12 phase state, 1..5 = per-slab
+        #                               map (partitioned, round 16)
     ]
+    # Packed column staging (HBM, full padded height x one lane band
+    # per slot): the dense-RDMA endpoints of the packed transport.
+    stage_shape = (4, C, h_pad, LANE)
     if engage:
         out_idx = lambda c, a, b: (c, (a + 1) % gh, (b + 1) % gw)
     else:
@@ -952,31 +1301,39 @@ def fused_rdma_step(
         # XLA, exactly like the scratch it replaces — no zero-fill tax —
         # and exactly as safe: the kernel overwrites the interior and
         # every ghost band it reads, and masks everything else
-        # (the `ok` window mask).
+        # (the `ok` window mask).  The packed staging buffer rides the
+        # same trick as a third discarded output.
         # (inputs, outputs, scratch) positional order makes the operand
         # form's ref list identical to the scratch form's signature —
         # the same kernel serves both.
-        out, _ = pl.pallas_call(
+        outs = (pl.BlockSpec((1, th, tw), out_idx),
+                pl.BlockSpec(memory_space=pl.ANY))
+        shapes = (shape_struct((C, gh * th, gw * tw), out_dtype, vma),
+                  shape_struct((C, h_pad, w_pad), block.dtype, vma))
+        if plan.packed_cols:
+            outs = outs + (pl.BlockSpec(memory_space=pl.ANY),)
+            shapes = shapes + (shape_struct(stage_shape, block.dtype, vma),)
+        out = pl.pallas_call(
             kernel,
             grid=(C, gh, gw),
             in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-            out_specs=(pl.BlockSpec((1, th, tw), out_idx),
-                       pl.BlockSpec(memory_space=pl.ANY)),
-            out_shape=(shape_struct((C, gh * th, gw * tw), out_dtype, vma),
-                       shape_struct((C, h_pad, w_pad), block.dtype, vma)),
+            out_specs=outs,
+            out_shape=shapes,
             scratch_shapes=vmem_scratch,
             compiler_params=cparams,
             interpret=interpret,
-        )(block)
+        )(block)[0]
         return out[:, :h, :w]
+    hbm = [hbm_scratch((C, h_pad, w_pad), block.dtype)]
+    if plan.packed_cols:
+        hbm.append(hbm_scratch(stage_shape, block.dtype))
     out = pl.pallas_call(
         kernel,
         grid=(C, gh, gw),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec((1, th, tw), out_idx),
         out_shape=shape_struct((C, gh * th, gw * tw), out_dtype, vma),
-        scratch_shapes=[hbm_scratch((C, h_pad, w_pad),
-                                    block.dtype)] + vmem_scratch,
+        scratch_shapes=hbm + vmem_scratch,
         compiler_params=cparams,
         interpret=interpret,
     )(block)

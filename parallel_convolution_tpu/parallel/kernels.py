@@ -47,8 +47,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Callable
 
-__all__ = ["KernelForm", "clamp_overlap", "overlap_capable", "register",
-           "registered_keys", "resolve"]
+__all__ = ["KernelForm", "clamp_overlap", "overlap_capable",
+           "persistent_capable", "register", "registered_keys", "resolve"]
 
 # The stencil-form vocabulary (closed: dispatch code switches on it).
 STENCIL_FORMS = ("smooth", "restrict", "prolong")
@@ -72,6 +72,12 @@ class KernelForm:
     stencil_form: str = "smooth"
     boundaries: tuple[str, ...] = ("zero", "periodic")
     overlap_capable: bool = False
+    # Persistent halo channels (parallel.channels): the form binds its
+    # exchange descriptors once per identity and reuses them across
+    # fused iterations / converge chunks / V-cycle levels.  The one
+    # place that knowledge lives (round 16) — the cost model's zeroed
+    # setup term and the col_mode resolution both key off it.
+    persistent_capable: bool = False
     build: Callable | None = None
 
     def __post_init__(self) -> None:
@@ -103,6 +109,7 @@ def register(form: KernelForm) -> KernelForm:
         if old is not None and (
                 old.stencil_form != form.stencil_form
                 or old.overlap_capable != form.overlap_capable
+                or old.persistent_capable != form.persistent_capable
                 or old.boundaries != form.boundaries
                 or _build_id(old.build) != _build_id(form.build)):
             raise ValueError(
@@ -172,6 +179,17 @@ def overlap_capable(name: str, rank: int = 2) -> bool:
         form = _FORMS.get((int(rank), str(name), bd))
         if form is not None:
             return form.overlap_capable
+    return False
+
+
+def persistent_capable(name: str, rank: int = 2) -> bool:
+    """Whether ``name`` binds persistent halo channels — the per-form
+    capability bit (round 16).  Unknown names are not capable."""
+    _ensure_default_forms()
+    for bd in ("zero", "periodic"):
+        form = _FORMS.get((int(rank), str(name), bd))
+        if form is not None:
+            return form.persistent_capable
     return False
 
 

@@ -52,7 +52,8 @@ from parallel_convolution_tpu.utils.config import (  # canonical registries
 from parallel_convolution_tpu.utils.jax_compat import shard_map
 
 __all__ = ["BACKENDS", "STORAGE_DTYPES", "sharded_iterate", "sharded_converge",
-           "sharded_converge_stream", "iterate_prepared", "reshard_prepared"]
+           "sharded_converge_stream", "iterate_prepared", "reshard_prepared",
+           "resolve_overlap", "resolve_col_mode", "clamp_col_mode"]
 
 
 def _note_compile(builder: str, backend: str, grid, iters: int, fuse: int,
@@ -84,7 +85,8 @@ def _record_step_obs(backend: str, mesh: Mesh, block_hw, radius: int,
                      fuse: int, iters: int, channels: int, storage: str,
                      boundary: str, wall_s: float | None, shape,
                      quantize: bool, tile, source: str,
-                     overlap: bool = False) -> None:
+                     overlap: bool = False,
+                     col_mode: str = "packed") -> None:
     from parallel_convolution_tpu.obs import attribution
 
     grid = grid_shape(mesh)
@@ -95,7 +97,7 @@ def _record_step_obs(backend: str, mesh: Mesh, block_hw, radius: int,
         boundary=boundary, wall_s=wall_s, shape=shape, quantize=quantize,
         tile=tile, platform=dev0.platform,
         device_kind=getattr(dev0, "device_kind", "") or "", source=source,
-        overlap=overlap)
+        overlap=overlap, col_mode=col_mode)
 
 
 def _valid_mask(valid_hw, block_hw, margin: int = 0):
@@ -183,6 +185,56 @@ def resolve_overlap(overlap: bool | None, backend: str, mesh: Mesh) -> bool:
     return True
 
 
+def resolve_col_mode(col_mode, backend: str, mesh: Mesh, block_hw,
+                     radius: int, fuse: int, storage: str) -> str:
+    """The column-slab transport a launch will ACTUALLY compile with.
+
+    ``None``/``"auto"`` resolve through the cost model
+    (``costmodel.pick_col_mode`` — the derived-datatypes decision:
+    strided descriptor overhead vs packed staging bytes) for
+    persistent-capable forms; every other form has no in-kernel column
+    RDMA transport, so the knob is inert there and normalizes to the
+    canonical ``"packed"`` label (one value → one EngineKey / bench
+    identity, matching the legacy-plan-record default).  An explicit
+    packed/strided request on a capable form is honored verbatim — the
+    two transports are byte-identical by construction, so no clamp
+    warning is needed.  Every bench row / serving response stamps the
+    RESOLVED value.
+    """
+    from parallel_convolution_tpu.parallel import channels
+
+    if col_mode is not None and col_mode not in channels.COL_MODE_CHOICES:
+        raise ValueError(
+            f"col_mode must be one of {channels.COL_MODE_CHOICES}, got "
+            f"{col_mode!r}")
+    if not kernel_forms.persistent_capable(backend):
+        return "packed"
+    if grid_shape(mesh)[1] <= 1:
+        # No remote column axis: both transports compile the identical
+        # statically-elided program, so even an explicit request
+        # normalizes to the canonical label — one program, one
+        # EngineKey / bench identity (the same rule the tuner's
+        # _legal_col_modes applies).
+        return "packed"
+    if col_mode in (None, "auto"):
+        from parallel_convolution_tpu.tuning import costmodel
+
+        dev0 = mesh.devices.flat[0]
+        hw = costmodel.hardware_for(
+            dev0.platform, getattr(dev0, "device_kind", "") or "")
+        return costmodel.pick_col_mode(
+            grid_shape(mesh), tuple(int(b) for b in block_hw), int(radius),
+            max(1, int(fuse)), storage, hw)
+    return col_mode
+
+
+def clamp_col_mode(col_mode: str, backend: str) -> str:
+    """Re-clamp a resolved col_mode after a degrade walk: a backend with
+    no persistent channels normalizes to the canonical 'packed'."""
+    return (col_mode if kernel_forms.persistent_capable(backend)
+            else "packed")
+
+
 def _axis_class_index(a, n: int):
     """Dynamic index of device ``a``'s offset class along an ``n``-device
     axis, matching ``pallas_stencil.axis_offset_classes`` order."""
@@ -213,14 +265,18 @@ def _build_rdma_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
                      tile: tuple[int, int] | None = None,
                      interpret: bool | None = None,
                      interior_split: bool = False,
-                     overlap: bool = False):
+                     overlap: bool = False,
+                     col_mode: str = "strided"):
     """The ``pallas_rdma`` kernel form: exchange + stencil fused in ONE
     kernel (remote DMA over ICI instead of collective-permute +
     concatenate + re-read).  fuse=T>1 widens the in-kernel exchange to
     T*r-deep ghosts and runs T levels before returning — the kernel
     re-zeroes out-of-image positions per level against valid_hw, so the
     outer mask is only needed on the single-level path.  The only form
-    registered ``overlap_capable`` (the interior-first pipeline)."""
+    registered ``overlap_capable`` (the interior-first pipeline) and
+    ``persistent_capable`` (bound halo channels + the packed/strided
+    ``col_mode`` column-transport A/B — resolved by the caller, never
+    'auto' here)."""
     periodic, needs_mask = _boundary_geometry(grid, valid_hw, block_hw,
                                               boundary)
 
@@ -231,7 +287,7 @@ def _build_rdma_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
             v, filt, grid, boundary, quantize=quantize,
             out_dtype=v.dtype, tile=tile, interpret=interpret,
             fuse=fuse, valid_hw=None if periodic else tuple(valid_hw),
-            overlap=overlap,
+            overlap=overlap, col_mode=col_mode,
         )
         if needs_mask and fuse == 1:
             p = p * _valid_mask(valid_hw, block_hw).astype(p.dtype)
@@ -245,7 +301,8 @@ def _build_halo_step(backend: str, filt: Filter, grid, valid_hw, block_hw,
                      tile: tuple[int, int] | None = None,
                      interpret: bool | None = None,
                      interior_split: bool = False,
-                     overlap: bool = False):
+                     overlap: bool = False,
+                     col_mode: str = "strided"):
     """The halo-exchange kernel forms (every backend but ``pallas_rdma``):
     ``fuse`` iterations on a local block per collective halo exchange.
 
@@ -340,7 +397,8 @@ def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
                      tile: tuple[int, int] | None = None,
                      interpret: bool | None = None,
                      interior_split: bool = False,
-                     overlap: bool = False):
+                     overlap: bool = False,
+                     col_mode: str = "strided"):
     """One smoothing-step builder, dispatched through the kernel-form
     registry (``parallel.kernels``): ``(rank=2, backend, boundary)``
     resolves to the registered form, whose ``build`` returns the
@@ -354,7 +412,8 @@ def _make_block_step(filt: Filter, grid, valid_hw, block_hw, quantize: bool,
             "not a smoother; transfer operators are driven by "
             "solvers.multigrid, not the iterate path")
     return form.build(filt, grid, valid_hw, block_hw, quantize, fuse,
-                      boundary, tile, interpret, interior_split, overlap)
+                      boundary, tile, interpret, interior_split, overlap,
+                      col_mode)
 
 
 def _mesh_interpret(mesh: Mesh) -> bool:
@@ -384,12 +443,14 @@ def _build_iterate(mesh: Mesh, filt: Filter, iters: int, quantize: bool,
                    boundary: str = "zero",
                    tile: tuple[int, int] | None = None,
                    interior_split: bool = False,
-                   overlap: bool = False):
+                   overlap: bool = False,
+                   col_mode: str = "strided"):
     """Compile the fixed-count iteration runner for one (mesh, config).
 
-    ``overlap`` must already be RESOLVED (``resolve_overlap``) — this
-    layer compiles exactly what it is told, so the stamped knob and the
-    executable can never disagree.
+    ``overlap`` and ``col_mode`` must already be RESOLVED
+    (``resolve_overlap`` / ``resolve_col_mode``) — this layer compiles
+    exactly what it is told, so the stamped knobs and the executable can
+    never disagree.
     """
     # Consulted only on lru_cache misses — i.e. exactly when a fresh
     # trace/compile happens, the event the 'backend_compile' site models.
@@ -405,11 +466,11 @@ def _build_iterate(mesh: Mesh, filt: Filter, iters: int, quantize: bool,
     interp = _mesh_interpret(mesh)
     chunk = _make_block_step(filt, grid, valid_hw, block_hw, quantize,
                              backend, fuse, boundary, tile, interp,
-                             interior_split, overlap)
+                             interior_split, overlap, col_mode)
     n_chunks, rem = divmod(iters, fuse)
     tail = (_make_block_step(filt, grid, valid_hw, block_hw, quantize,
                              backend, rem, boundary, tile, interp,
-                             interior_split, overlap)
+                             interior_split, overlap, col_mode)
             if rem else None)
 
     def body(block):
@@ -431,7 +492,8 @@ def _build_converge(mesh: Mesh, filt: Filter, tol: float, max_iters: int,
                     backend: str, boundary: str = "zero", fuse: int = 1,
                     tile: tuple[int, int] | None = None,
                     interior_split: bool = False,
-                    overlap: bool = False):
+                    overlap: bool = False,
+                    col_mode: str = "strided"):
     """Compile the run-to-convergence runner (C6: every-N diff + allreduce).
 
     ``fuse``/``tile`` are the flagship iteration knobs (temporal fusion,
@@ -464,10 +526,10 @@ def _build_converge(mesh: Mesh, filt: Filter, tol: float, max_iters: int,
     interp = _mesh_interpret(mesh)
     step = _make_block_step(filt, grid, valid_hw, block_hw, quantize, backend,
                             boundary=boundary, tile=tile, interpret=interp,
-                            overlap=overlap)
+                            overlap=overlap, col_mode=col_mode)
     fused = (_make_block_step(filt, grid, valid_hw, block_hw, quantize,
                               backend, fuse, boundary, tile, interp,
-                              interior_split, overlap)
+                              interior_split, overlap, col_mode)
              if fuse > 1 else None)
 
     def body(block):
@@ -515,7 +577,8 @@ def _build_converge_chunk(mesh: Mesh, filt: Filter, n: int, quantize: bool,
                           boundary: str = "zero", fuse: int = 1,
                           tile: tuple[int, int] | None = None,
                           interior_split: bool = False,
-                          overlap: bool = False):
+                          overlap: bool = False,
+                          col_mode: str = "strided"):
     """Compile ONE convergence chunk: ``n`` iterations + the (prev, cur)
     max-abs diff, returned to the host.
 
@@ -545,10 +608,10 @@ def _build_converge_chunk(mesh: Mesh, filt: Filter, n: int, quantize: bool,
     interp = _mesh_interpret(mesh)
     step = _make_block_step(filt, grid, valid_hw, block_hw, quantize, backend,
                             boundary=boundary, tile=tile, interpret=interp,
-                            overlap=overlap)
+                            overlap=overlap, col_mode=col_mode)
     fused = (_make_block_step(filt, grid, valid_hw, block_hw, quantize,
                               backend, fuse, boundary, tile, interp,
-                              interior_split, overlap)
+                              interior_split, overlap, col_mode)
              if fuse > 1 and n > 1 else None)
 
     def body(block):
@@ -626,6 +689,7 @@ def _register_smoother_forms() -> None:
             name=name, rank=2, stencil_form="smooth",
             boundaries=tuple(BOUNDARIES),
             overlap_capable=(name == "pallas_rdma"),
+            persistent_capable=(name == "pallas_rdma"),
             build=(_build_rdma_step if name == "pallas_rdma"
                    else partial(_build_halo_step, name))))
 
@@ -736,9 +800,9 @@ def _storage_name(dtype) -> str:
 
 def _resolve_auto(mesh, filt, backend, fuse, tile, storage, quantize,
                   boundary, valid_hw, channels, check_every=None,
-                  overlap=None):
+                  overlap=None, col_mode=None):
     """``backend='auto'`` -> concrete
-    ``(backend, fuse, tile, overlap, source)``.
+    ``(backend, fuse, tile, overlap, col_mode, source)``.
 
     Resolution goes through the tuning subsystem (plan cache if a
     ``PCTPU_PLAN_FILE`` is armed, else the cost model) and happens
@@ -755,19 +819,23 @@ def _resolve_auto(mesh, filt, backend, fuse, tile, storage, quantize,
     convergence run resolves its own plan rather than a fixed-count one.
     """
     if backend != AUTO:
-        return backend, (1 if fuse is None else int(fuse)), tile, overlap, None
+        return (backend, (1 if fuse is None else int(fuse)), tile, overlap,
+                col_mode, None)
     from parallel_convolution_tpu import tuning
 
     res = tuning.resolve(
         mesh, filt, (channels, valid_hw[0], valid_hw[1]), storage=storage,
         quantize=quantize, boundary=boundary, fuse=fuse,
-        tile=_norm_tile(tile), overlap=overlap, check_every=check_every)
-    return res.backend, res.fuse, res.tile, res.overlap, res.source
+        tile=_norm_tile(tile), overlap=overlap, col_mode=col_mode,
+        check_every=check_every)
+    return (res.backend, res.fuse, res.tile, res.overlap, res.col_mode,
+            res.source)
 
 
 def _resolve_fallback(mesh, filt, backend, quantize, fuse, boundary, tile,
                       interior_split, storage="f32",
-                      block_hw=None, overlap: bool = False) -> str:
+                      block_hw=None, overlap: bool = False,
+                      col_mode: str = "packed") -> str:
     """Walk the degradation chain (resilience.degrade) for this config.
 
     ``block_hw``/``storage`` must describe the REAL run: kernel selection
@@ -784,7 +852,7 @@ def _resolve_fallback(mesh, filt, backend, quantize, fuse, boundary, tile,
     return degrade.resolve_backend(
         mesh, filt, backend, quantize=quantize, fuse=fuse, boundary=boundary,
         tile=tile, interior_split=interior_split, storage=storage,
-        block_hw=block_hw, overlap=overlap)
+        block_hw=block_hw, overlap=overlap, col_mode=col_mode)
 
 
 def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
@@ -795,7 +863,8 @@ def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
                      interior_split: bool = False,
                      check_contract: bool = True,
                      fallback: bool = False,
-                     overlap: bool | None = None):
+                     overlap: bool | None = None,
+                     col_mode: str | None = None):
     """Iterate an already-sharded padded (C, Hp, Wp) array in place(-ish).
 
     The zero-copy entry for huge images loaded via utils.sharded_io: input
@@ -826,6 +895,11 @@ def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
     ``backend="auto"``); the resolved bool — clamped by
     :func:`resolve_overlap` and re-clamped to False if the degrade walk
     leaves the RDMA tier — is what actually compiles.
+
+    ``col_mode`` selects the RDMA column-slab transport
+    (packed | strided | auto; None = auto) — resolved by
+    :func:`resolve_col_mode` (cost-model pick for the RDMA tier, inert
+    'packed' elsewhere), re-clamped if the degrade walk leaves the tier.
     """
     if jnp.dtype(xs.dtype) == jnp.uint8 and not quantize:
         _check_storage("u8", quantize)  # public entry: same guard as above
@@ -833,20 +907,25 @@ def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
         _check_quantize_contract(xs, filt, quantize)
     R, Cc = grid_shape(mesh)
     block_hw = (xs.shape[1] // R, xs.shape[2] // Cc)
-    backend, fuse, tile, overlap, _ = _resolve_auto(
+    backend, fuse, tile, overlap, col_mode, _ = _resolve_auto(
         mesh, filt, backend, fuse, tile, _storage_name(xs.dtype), quantize,
-        boundary, tuple(valid_hw), xs.shape[0], overlap=overlap)
+        boundary, tuple(valid_hw), xs.shape[0], overlap=overlap,
+        col_mode=col_mode)
     overlap = resolve_overlap(overlap, backend, mesh)
+    col_mode = resolve_col_mode(col_mode, backend, mesh, block_hw,
+                                filt.radius, fuse, _storage_name(xs.dtype))
     if fallback:
         backend = _resolve_fallback(mesh, filt, backend, quantize, fuse,
                                     boundary, _norm_tile(tile),
                                     interior_split,
                                     storage=_storage_name(xs.dtype),
-                                    block_hw=block_hw, overlap=overlap)
+                                    block_hw=block_hw, overlap=overlap,
+                                    col_mode=col_mode)
         overlap = kernel_forms.clamp_overlap(overlap, backend)
+        col_mode = clamp_col_mode(col_mode, backend)
     fn = _build_iterate(mesh, filt, iters, quantize, tuple(valid_hw),
                         block_hw, backend, fuse, boundary, _norm_tile(tile),
-                        interior_split, overlap)
+                        interior_split, overlap, col_mode)
     if not obs_metrics.enabled():
         return fn(xs)
     # Observed mode: attribute halo bytes/rounds and emit the exchange
@@ -861,7 +940,8 @@ def iterate_prepared(xs, filt: Filter, iters: int, mesh: Mesh,
                      max(1, min(fuse, iters or 1)), iters, channels,
                      _storage_name(out.dtype), boundary, None, shape,
                      quantize, _norm_tile(tile),
-                     source="iterate_prepared", overlap=overlap)
+                     source="iterate_prepared", overlap=overlap,
+                     col_mode=col_mode)
     return out
 
 
@@ -872,7 +952,8 @@ def sharded_iterate(x, filt: Filter, iters: int, mesh: Mesh | None = None,
                     tile: tuple[int, int] | None = None,
                     interior_split: bool = False,
                     fallback: bool = False,
-                    overlap: bool | None = None):
+                    overlap: bool | None = None,
+                    col_mode: str | None = None):
     """Run ``iters`` stencil iterations of a global (C, H, W) f32 image
     sharded over the 2D mesh.  Returns the global (C, H, W) f32 result
     (bit-identical to the serial oracle for any mesh shape).
@@ -899,7 +980,7 @@ def sharded_iterate(x, filt: Filter, iters: int, mesh: Mesh | None = None,
                            quantize=quantize, backend=backend, fuse=fuse,
                            boundary=boundary, tile=tile,
                            interior_split=interior_split, fallback=fallback,
-                           overlap=overlap)
+                           overlap=overlap, col_mode=col_mode)
     return out[:, : valid_hw[0], : valid_hw[1]].astype(jnp.float32)
 
 
@@ -911,7 +992,8 @@ def sharded_converge(x, filt: Filter, tol: float, max_iters: int,
                      tile: tuple[int, int] | None = None,
                      interior_split: bool = False, fallback: bool = False,
                      overlap: bool | None = None, solver: str = "jacobi",
-                     mg_levels: int | None = None):
+                     mg_levels: int | None = None,
+                     col_mode: str | None = None):
     """Run-to-convergence (BASELINE config 5).  Returns (result, iters_run).
 
     ``fuse``/``tile`` mirror :func:`sharded_iterate`: fused chunks run
@@ -933,7 +1015,7 @@ def sharded_converge(x, filt: Filter, tol: float, max_iters: int,
             x, filt, tol=tol, max_iters=max_iters, mesh=mesh,
             quantize=quantize, backend=backend, storage=storage,
             boundary=boundary, fuse=fuse, tile=tile, fallback=fallback,
-            overlap=overlap, mg_levels=mg_levels)
+            overlap=overlap, mg_levels=mg_levels, col_mode=col_mode)
         return out, res.cycles
     if solver != "jacobi":
         from parallel_convolution_tpu.utils.config import SOLVERS
@@ -943,22 +1025,26 @@ def sharded_converge(x, filt: Filter, tol: float, max_iters: int,
         mesh = make_grid_mesh()
     _check_storage(storage, quantize)
     xs, valid_hw, block_hw = _prepare(x, mesh, filt.radius, storage)
-    backend, fuse, tile, overlap, _ = _resolve_auto(
+    backend, fuse, tile, overlap, col_mode, _ = _resolve_auto(
         mesh, filt, backend, fuse, tile, storage, quantize, boundary,
         tuple(valid_hw), xs.shape[0], check_every=int(check_every),
-        overlap=overlap)
+        overlap=overlap, col_mode=col_mode)
     overlap = resolve_overlap(overlap, backend, mesh)
+    col_mode = resolve_col_mode(col_mode, backend, mesh, block_hw,
+                                filt.radius, int(fuse), storage)
     if fallback:
         backend = _resolve_fallback(mesh, filt, backend, quantize, fuse,
                                     boundary, _norm_tile(tile),
                                     interior_split, storage,
-                                    block_hw=block_hw, overlap=overlap)
+                                    block_hw=block_hw, overlap=overlap,
+                                    col_mode=col_mode)
         overlap = kernel_forms.clamp_overlap(overlap, backend)
+        col_mode = clamp_col_mode(col_mode, backend)
     _check_quantize_contract(xs, filt, quantize)
     fn = _build_converge(mesh, filt, float(tol), int(max_iters),
                          int(check_every), quantize, valid_hw, block_hw,
                          backend, boundary, int(fuse), _norm_tile(tile),
-                         interior_split, overlap)
+                         interior_split, overlap, col_mode)
     channels, shape = xs.shape[0], tuple(xs.shape)
     t0 = time.perf_counter()
     # The convergence run is fenced (the count readback), so it gets a
@@ -980,7 +1066,7 @@ def sharded_converge(x, filt: Filter, tol: float, max_iters: int,
                              done, channels, storage, boundary,
                              time.perf_counter() - t0, shape, quantize,
                              _norm_tile(tile), source="sharded_converge",
-                             overlap=overlap)
+                             overlap=overlap, col_mode=col_mode)
     return out[:, : valid_hw[0], : valid_hw[1]].astype(jnp.float32), done
 
 
@@ -994,7 +1080,8 @@ def sharded_converge_stream(x, filt: Filter, tol: float, max_iters: int,
                             fallback: bool = False,
                             overlap: bool | None = None,
                             solver: str = "jacobi",
-                            mg_levels: int | None = None):
+                            mg_levels: int | None = None,
+                            col_mode: str | None = None):
     """Progressive run-to-convergence: a generator over snapshot chunks.
 
     Yields ``(image, iters_done, diff)`` after every ``check_every``-sized
@@ -1022,7 +1109,7 @@ def sharded_converge_stream(x, filt: Filter, tol: float, max_iters: int,
                 x, filt, tol=tol, max_iters=max_iters, mesh=mesh,
                 quantize=quantize, backend=backend, storage=storage,
                 boundary=boundary, fuse=fuse, tile=tile, fallback=fallback,
-                overlap=overlap, mg_levels=mg_levels):
+                overlap=overlap, mg_levels=mg_levels, col_mode=col_mode):
             yield (out, cycles, residual)
         return
     if solver != "jacobi":
@@ -1033,17 +1120,21 @@ def sharded_converge_stream(x, filt: Filter, tol: float, max_iters: int,
         mesh = make_grid_mesh()
     _check_storage(storage, quantize)
     xs, valid_hw, block_hw = _prepare(x, mesh, filt.radius, storage)
-    backend, fuse, tile, overlap, _ = _resolve_auto(
+    backend, fuse, tile, overlap, col_mode, _ = _resolve_auto(
         mesh, filt, backend, fuse, tile, storage, quantize, boundary,
         tuple(valid_hw), xs.shape[0], check_every=int(check_every),
-        overlap=overlap)
+        overlap=overlap, col_mode=col_mode)
     overlap = resolve_overlap(overlap, backend, mesh)
+    col_mode = resolve_col_mode(col_mode, backend, mesh, block_hw,
+                                filt.radius, int(fuse), storage)
     if fallback:
         backend = _resolve_fallback(mesh, filt, backend, quantize, fuse,
                                     boundary, _norm_tile(tile),
                                     interior_split, storage,
-                                    block_hw=block_hw, overlap=overlap)
+                                    block_hw=block_hw, overlap=overlap,
+                                    col_mode=col_mode)
         overlap = kernel_forms.clamp_overlap(overlap, backend)
+        col_mode = clamp_col_mode(col_mode, backend)
     _check_quantize_contract(xs, filt, quantize)
     check_every, max_iters = int(check_every), int(max_iters)
     done, diff = 0, float("inf")
@@ -1051,7 +1142,8 @@ def sharded_converge_stream(x, filt: Filter, tol: float, max_iters: int,
         n = min(check_every, max_iters - done)
         fn = _build_converge_chunk(mesh, filt, n, quantize, tuple(valid_hw),
                                    block_hw, backend, boundary, int(fuse),
-                                   _norm_tile(tile), interior_split, overlap)
+                                   _norm_tile(tile), interior_split, overlap,
+                                   col_mode)
         xs, d = fn(xs)
         diff = float(d)   # the readback fences the chunk
         done += n

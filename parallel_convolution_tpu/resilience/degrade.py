@@ -77,10 +77,10 @@ def clear_probe_cache() -> None:
 
 def _probe_key(mesh, filt: Filter, backend: str, quantize, fuse, boundary,
                tile, interior_split, storage, block_hw,
-               overlap=False) -> tuple:
+               overlap=False, col_mode="packed") -> tuple:
     return (mesh, filt.name, filt.radius, backend, bool(quantize), int(fuse),
             boundary, tile, bool(interior_split), storage, block_hw,
-            bool(overlap))
+            bool(overlap), str(col_mode))
 
 
 def probe_backend(mesh, filt: Filter, backend: str, *, quantize: bool = True,
@@ -89,7 +89,8 @@ def probe_backend(mesh, filt: Filter, backend: str, *, quantize: bool = True,
                   interior_split: bool = False,
                   storage: str = "f32",
                   block_hw: tuple[int, int] | None = None,
-                  overlap: bool = False) -> None:
+                  overlap: bool = False,
+                  col_mode: str = "packed") -> None:
     """Compile + run one ``fuse``-iteration sharded chunk of ``backend``.
 
     Raises whatever the compile/launch raised (replayed from cache on
@@ -106,7 +107,7 @@ def probe_backend(mesh, filt: Filter, backend: str, *, quantize: bool = True,
     backend, config) per process.
     """
     key = _probe_key(mesh, filt, backend, quantize, fuse, boundary, tile,
-                     interior_split, storage, block_hw, overlap)
+                     interior_split, storage, block_hw, overlap, col_mode)
     if key in _PROBE_CACHE:
         err = _PROBE_CACHE[key]
         if err is not None:
@@ -114,7 +115,7 @@ def probe_backend(mesh, filt: Filter, backend: str, *, quantize: bool = True,
         return
     try:
         _run_probe(mesh, filt, backend, quantize, fuse, boundary, tile,
-                   interior_split, storage, block_hw, overlap)
+                   interior_split, storage, block_hw, overlap, col_mode)
     except Exception as e:  # noqa: BLE001 — the verdict IS the product
         _PROBE_CACHE[key] = e
         raise
@@ -122,7 +123,8 @@ def probe_backend(mesh, filt: Filter, backend: str, *, quantize: bool = True,
 
 
 def _run_probe(mesh, filt, backend, quantize, fuse, boundary, tile,
-               interior_split, storage, block_hw, overlap=False) -> None:
+               interior_split, storage, block_hw, overlap=False,
+               col_mode="packed") -> None:
     import jax
     import numpy as np
 
@@ -139,7 +141,8 @@ def _run_probe(mesh, filt, backend, quantize, fuse, boundary, tile,
     xs, valid_hw, block_hw = step_lib._prepare(x, mesh, filt.radius, storage)
     fn = step_lib._build_iterate(mesh, filt, fuse, quantize, valid_hw,
                                  block_hw, backend, fuse, boundary, tile,
-                                 interior_split, overlap)
+                                 interior_split, overlap,
+                                 step_lib.clamp_col_mode(col_mode, backend))
     jax.block_until_ready(fn(xs))
 
 
@@ -161,6 +164,7 @@ def resolve_backend(mesh, filt: Filter, backend: str, *, quantize: bool = True,
                     interior_split: bool = False, storage: str = "f32",
                     block_hw: tuple[int, int] | None = None,
                     overlap: bool = False,
+                    col_mode: str = "packed",
                     warn: bool = True) -> str:
     """Return the first backend in ``degradation_chain(backend)`` whose
     probe passes; raise immediately on a terminal probe failure.
@@ -184,7 +188,8 @@ def resolve_backend(mesh, filt: Filter, backend: str, *, quantize: bool = True,
                           boundary=boundary, tile=tile,
                           interior_split=interior_split, storage=storage,
                           block_hw=block_hw,
-                          overlap=bool(overlap) and b == "pallas_rdma")
+                          overlap=bool(overlap) and b == "pallas_rdma",
+                          col_mode=col_mode)
         except Exception as e:  # noqa: BLE001
             if classify(e) == TERMINAL:
                 raise

@@ -85,6 +85,12 @@ class EngineKey:
     #                                  halo pipeline knob (resolve_key
     #                                  settles None/auto before keying, so
     #                                  equal executables share one key)
+    col_mode: str = "packed"         # RESOLVED column-slab transport
+    #                                  (packed | strided) — same pre-keying
+    #                                  rule as overlap/backend: auto and
+    #                                  explicit requests that compile the
+    #                                  same program share one warm
+    #                                  executable
     solver: str = "jacobi"           # convergence strategy (SOLVERS):
     #                                  "multigrid" keys the V-cycle's
     #                                  compiled level programs (converge
@@ -119,6 +125,12 @@ class EngineKey:
                 len(self.tile) != 2 or min(self.tile) < 1):
             raise ValueError(f"tile must be two positive ints, "
                              f"got {self.tile}")
+        from parallel_convolution_tpu.parallel import channels
+
+        if self.col_mode not in channels.COL_MODES:
+            raise ValueError(
+                f"unknown col_mode {self.col_mode!r} (auto is resolved "
+                "in key_for, never stored in a key)")
         if self.solver not in SOLVERS:
             raise ValueError(f"unknown solver {self.solver!r}")
         if self.mg_levels is not None and int(self.mg_levels) < 1:
@@ -139,7 +151,8 @@ class _Entry:
 
     __slots__ = ("key", "effective_backend", "fns", "lock", "plan_source",
                  "predicted_gpx", "plan_key", "effective_overlap",
-                 "splits", "compile_ref", "converge_fns", "mg_levels")
+                 "effective_col_mode", "splits", "compile_ref",
+                 "converge_fns", "mg_levels")
 
     def __init__(self, key: EngineKey, effective_backend: str,
                  plan_source: str = "explicit",
@@ -152,6 +165,13 @@ class _Entry:
         # walk left the RDMA tier (only that tier has an overlapped form).
         self.effective_overlap = bool(
             key.overlap) and effective_backend == "pallas_rdma"
+        # Same rule for the column transport: re-clamped to the
+        # canonical 'packed' when the degrade walk left the persistent
+        # tier (no column RDMA transport exists elsewhere).
+        from parallel_convolution_tpu.parallel import step as _step_lib
+
+        self.effective_col_mode = _step_lib.clamp_col_mode(
+            key.col_mode, effective_backend)
         self.plan_source = plan_source       # explicit|measured|
         #                                      interpolated|predicted
         self.predicted_gpx = predicted_gpx   # cost-model Gpx/s/chip
@@ -317,10 +337,12 @@ class WarmEngine:
                 boundary=kw.get("boundary", "zero"),
                 fuse=kw.get("fuse"), tile=kw.get("tile"),
                 overlap=kw.get("overlap"),
+                col_mode=kw.get("col_mode"),
                 plans=self.plans)
             kw["backend"] = res.backend
             kw["fuse"], kw["tile"] = res.fuse, res.tile
             kw["overlap"] = res.overlap
+            kw["col_mode"] = res.col_mode
             plan_source = res.source
         # Settle the overlap knob BEFORE keying (None -> False for
         # explicit backends; requests clamped to the RDMA tier and the
@@ -328,6 +350,23 @@ class WarmEngine:
         # must share one key, and the key must state the compiled form.
         kw["overlap"] = step_lib.resolve_overlap(
             kw.get("overlap"), kw.get("backend", "shifted"), self.mesh)
+        # Same pre-keying rule for the column transport: None/'auto'
+        # resolve through the cost model for the persistent tier and
+        # normalize to the canonical 'packed' everywhere else, so an
+        # auto and an explicit request that compile the same program
+        # share one warm executable.
+        from parallel_convolution_tpu.parallel.mesh import (
+            grid_shape as _grid_shape, padded_extent as _padded_extent,
+        )
+
+        (_, _H, _W) = tuple(int(s) for s in shape)
+        _R, _C = _grid_shape(self.mesh)
+        _filt = get_filter(kw.get("filter_name", "blur3"))
+        kw["col_mode"] = step_lib.resolve_col_mode(
+            kw.get("col_mode"), kw.get("backend", "shifted"), self.mesh,
+            (_padded_extent(_H, _R) // _R, _padded_extent(_W, _C) // _C),
+            _filt.radius, max(1, int(kw.get("fuse") or 1)),
+            kw.get("storage", "f32"))
         if kw.get("fuse") is None and "fuse" in kw:
             # Same contract as RunConfig/ConvolutionModel: fuse=None
             # means 'tune it', which needs backend='auto' — silently
@@ -438,7 +477,8 @@ class WarmEngine:
                 self.mesh, get_filter(key.filter_name), key.backend,
                 quantize=key.quantize, fuse=key.fuse, boundary=key.boundary,
                 tile=key.tile, storage=key.storage,
-                block_hw=self._block_hw(key), overlap=key.overlap)
+                block_hw=self._block_hw(key), overlap=key.overlap,
+                col_mode=key.col_mode)
         # Cost-model figure for the config actually compiled: every
         # response carries predicted-vs-measured visibility, so a silent
         # mistune (or a degraded tier) shows in per-request artifacts.
@@ -452,7 +492,8 @@ class WarmEngine:
         predicted = costmodel.predict_gpx_per_chip(search.predict(
             w, search.Candidate(
                 effective, key.fuse, key.tile,
-                bool(key.overlap) and effective == "pallas_rdma")))
+                bool(key.overlap) and effective == "pallas_rdma",
+                key.col_mode)))
         with self._lock:
             source = self._plan_sources.get(key, "explicit")
         entry = _Entry(key, effective, plan_source=source,
@@ -484,7 +525,8 @@ class WarmEngine:
             fn = step_lib._build_iterate(
                 self.mesh, filt, key.iters, key.quantize, valid_hw,
                 block_hw, entry.effective_backend, key.fuse, key.boundary,
-                key.tile, False, entry.effective_overlap)
+                key.tile, False, entry.effective_overlap,
+                entry.effective_col_mode)
             # Trace + XLA-compile NOW (jit compiles on first call): warm
             # means the request path never sees compilation.
             import jax
@@ -601,7 +643,8 @@ class WarmEngine:
                                                       "pallas_sep"),
                 platform=dev0.platform,
                 device_kind=getattr(dev0, "device_kind", "") or "",
-                overlap=entry.effective_overlap)
+                overlap=entry.effective_overlap,
+                col_mode=entry.effective_col_mode)
             entry.splits[B] = split
         info = {
             "effective_backend": entry.effective_backend,
@@ -611,6 +654,7 @@ class WarmEngine:
             "predicted_gpx_per_chip": entry.predicted_gpx,
             "batch_size": B,
             "overlap": entry.effective_overlap,
+            "col_mode": entry.effective_col_mode,
             "exchange_fraction": round(split["exchange_fraction"], 4),
             "exchange_hidden_fraction": round(
                 split["exchange_hidden_fraction"], 4),
@@ -638,7 +682,8 @@ class WarmEngine:
             wall_s=dev_s, shape=(B * C, H, W), quantize=key.quantize,
             tile=key.tile, platform=dev0.platform,
             device_kind=getattr(dev0, "device_kind", "") or "",
-            source="serving", overlap=entry.effective_overlap)
+            source="serving", overlap=entry.effective_overlap,
+            col_mode=entry.effective_col_mode)
         if dev_s > 0:
             attribution.record_drift(
                 entry.plan_key, entry.effective_backend,
@@ -668,7 +713,7 @@ class WarmEngine:
             fn = step_lib._build_converge_chunk(
                 self.mesh, filt, n, key.quantize, valid_hw, block_hw,
                 entry.effective_backend, key.boundary, key.fuse, key.tile,
-                False, entry.effective_overlap)
+                False, entry.effective_overlap, entry.effective_col_mode)
             jax.block_until_ready(fn(xs)[1])  # compile NOW: the stream's
             #                                   first chunk must not pay it
             entry.converge_fns[n] = fn
@@ -728,7 +773,8 @@ class WarmEngine:
                 quantize=key.quantize, backend=entry.effective_backend,
                 storage=key.storage, boundary=key.boundary,
                 tile=key.tile, overlap=entry.effective_overlap,
-                mg_levels=key.mg_levels)
+                mg_levels=key.mg_levels,
+                col_mode=entry.effective_col_mode)
             for out, cycles, residual, wu in stream:
                 if key.grid != self.grid():
                     raise ValueError(
@@ -768,9 +814,16 @@ class WarmEngine:
 
     def snapshot(self) -> dict:
         """Stats + resident keys, for /stats and the loadgen row."""
+        from parallel_convolution_tpu.parallel import channels
+
         with self._lock:
             return {
                 "stats": dict(self.stats),
+                # Persistent-channel reuse evidence: descriptor-plan
+                # builds vs cache hits, process-global (the
+                # --channels-smoke leg asserts builds stay flat across
+                # a warm key's request stream).
+                "channels": channels.stats(),
                 "capacity": self.capacity,
                 "grid": "x".join(str(v) for v in self.grid()),
                 "resident": [
@@ -780,6 +833,7 @@ class WarmEngine:
                      "fuse": k.fuse,
                      "tile": list(k.tile) if k.tile else None,
                      "overlap": e.effective_overlap,
+                     "col_mode": e.effective_col_mode,
                      "plan_source": e.plan_source,
                      "predicted_gpx_per_chip": e.predicted_gpx,
                      "batch_sizes": sorted(e.fns)}

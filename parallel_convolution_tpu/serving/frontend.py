@@ -138,6 +138,11 @@ def decode_request(body: dict) -> Request:
             # for backend="auto"; true/false = clamped request.
             overlap=(None if body.get("overlap") is None
                      else bool(body.get("overlap"))),
+            # col_mode: null/absent = auto (cost-model pick on the RDMA
+            # tier, canonical 'packed' elsewhere); packed/strided =
+            # honored where the transport exists.
+            col_mode=(None if body.get("col_mode") is None
+                      else str(body.get("col_mode"))),
             deadline_s=(float(deadline_ms) / 1e3
                         if deadline_ms is not None else None),
             request_id=body.get("request_id"),
@@ -176,6 +181,7 @@ def encode_response(result) -> tuple[int, dict]:
         "plan_key": result.plan_key,
         "predicted_gpx_per_chip": result.predicted_gpx_per_chip,
         "overlap": result.overlap,
+        "col_mode": result.col_mode,
         "exchange_fraction": result.exchange_fraction,
         "exchange_hidden_fraction": result.exchange_hidden_fraction,
         "request_id": result.request_id,
@@ -226,6 +232,7 @@ def encode_stream_row(row) -> dict:
         "solver": row.solver,
         "work_units": round(float(row.work_units), 3),
         "mg_levels": row.mg_levels,
+        "col_mode": row.col_mode,
         "image_b64": base64.b64encode(
             np.ascontiguousarray(row.image).tobytes()).decode("ascii"),
         "request_id": row.request_id,

@@ -79,6 +79,10 @@ class Request:
     #                                  backends / tuned for "auto"; the
     #                                  RESOLVED value rides the key and
     #                                  every response stamps it
+    col_mode: str | None = None      # RDMA column-slab transport
+    #                                  (packed | strided | auto; None =
+    #                                  auto) — resolved and stamped
+    #                                  under the same rule as overlap
     deadline_s: float | None = None
     request_id: str | None = None    # client-stamped idempotency id: a
     #                                  hedged/retried submission with the
@@ -114,6 +118,10 @@ class Response:
     overlap: bool = False            # the compiled program's RESOLVED
     #                                  overlap knob (False when clamped
     #                                  or degraded off the RDMA tier)
+    col_mode: str = "packed"         # the compiled program's RESOLVED
+    #                                  column-slab transport ('packed'
+    #                                  is the canonical label off the
+    #                                  RDMA tier)
     exchange_fraction: float = 0.0   # model-attributed EXPOSED exchange
     #                                  share of one iteration's wall
     exchange_hidden_fraction: float = 0.0  # share of exchange time the
@@ -192,6 +200,9 @@ class Snapshot:
     mg_levels: int | None = None     # multigrid only: the level count the
     #                                  planner actually scheduled
     #                                  (post-resolution, never the cap)
+    col_mode: str = "packed"         # the compiled program's RESOLVED
+    #                                  column-slab transport (same
+    #                                  stamping rule as batch responses)
 
     ok = True
 
@@ -353,7 +364,7 @@ class ConvolutionService:
             fuse=None if req.fuse is None else int(req.fuse),
             boundary=req.boundary,
             quantize=bool(req.quantize), backend=req.backend,
-            overlap=req.overlap, solver=req.solver,
+            overlap=req.overlap, col_mode=req.col_mode, solver=req.solver,
             mg_levels=(None if req.mg_levels is None
                        else int(req.mg_levels)))
         key.validate()
@@ -592,6 +603,7 @@ class ConvolutionService:
                         "predicted_gpx_per_chip"),
                     effective_grid=info.get("effective_grid", ""),
                     overlap=bool(info.get("overlap", False)),
+                    col_mode=str(info.get("col_mode", "packed")),
                     exchange_fraction=info.get("exchange_fraction", 0.0),
                     exchange_hidden_fraction=info.get(
                         "exchange_hidden_fraction", 0.0),
@@ -742,7 +754,8 @@ class ConvolutionService:
                             effective_grid=grid, plan_key=entry.plan_key,
                             trace_id=tid, solver=key.solver,
                             work_units=round(float(wu), 3),
-                            mg_levels=entry.mg_levels)
+                            mg_levels=entry.mg_levels,
+                            col_mode=entry.effective_col_mode)
                 except Exception as e:  # noqa: BLE001 — typed stream end
                     reason = ("resharding"
                               if ("resharded" in str(e) or self._reshaping)
@@ -763,7 +776,8 @@ class ConvolutionService:
                     effective_grid=grid, plan_key=entry.plan_key,
                     trace_id=tid, solver=key.solver,
                     work_units=round(float(last[2]), 3) if last else 0.0,
-                    mg_levels=entry.mg_levels)
+                    mg_levels=entry.mg_levels,
+                    col_mode=entry.effective_col_mode)
                 self._bump("completed")
         finally:
             release()

@@ -75,7 +75,8 @@ from parallel_convolution_tpu.solvers.transfer import coarse_extent
 from parallel_convolution_tpu.utils.jax_compat import shard_map
 
 __all__ = ["MG_BLOCK_FLOOR", "MGResult", "Level", "cycle_work_units",
-           "mg_converge", "mg_converge_stream", "plan_levels"]
+           "mg_converge", "mg_converge_stream", "plan_levels",
+           "level_channel_keys", "warm_level_channels"]
 
 # The tile floor: a level whose per-device block would dip below this on
 # the inherited mesh collapses onto a smaller grid instead (sub-tile
@@ -139,6 +140,8 @@ class MGResult:
     overlap: bool
     wall_s: float
     predicted_s_per_cycle: float | None = None
+    col_mode: str = "strided"   # resolved column-slab transport of the
+    #                            smoother programs (round 16)
 
 
 def _level_block(valid_hw, grid, mult: int) -> tuple[int, int]:
@@ -268,6 +271,47 @@ def _level_sweeps(levels, nu_pre, nu_post, nu_coarse) -> list[int]:
             for i in range(len(levels))]
 
 
+def level_channel_keys(levels, radius: int, boundary: str,
+                       col_mode: str, channels: int = 1,
+                       storage: str = "f32"):
+    """The per-level persistent-channel identities of one V-cycle
+    schedule (round 16): each level's exchange identity
+    ``(grid, block, radius, fuse=1, dtype, boundary, kernel_form,
+    col_mode)``, computed ONCE on the schedule and warmed into the
+    channel-plan cache — every cycle's smoother kernels then BIND the
+    same cached plans, so ``channels.stats()['builds']`` equals the
+    number of distinct level identities however many cycles run
+    (asserted in tests/test_channels.py)."""
+    from parallel_convolution_tpu.parallel import channels as chan
+    from parallel_convolution_tpu.tuning import costmodel
+
+    dtype = {"f32": "float32", "bf16": "bfloat16", "u8": "uint8"}[storage]
+    keys = []
+    for lv in levels:
+        tiled = costmodel.rdma_is_tiled(
+            (channels, *lv.padded_hw), lv.block_hw, int(radius), 1,
+            storage, col_mode=col_mode, grid=lv.grid)
+        keys.append(chan.ChannelKey(
+            grid=lv.grid, block_hw=lv.block_hw, radius=int(radius),
+            fuse=1, dtype=dtype, boundary=boundary,
+            kernel="tiled" if tiled else "monolithic",
+            col_mode=col_mode))
+    return tuple(keys)
+
+
+def warm_level_channels(levels, radius: int, boundary: str, col_mode: str,
+                        channels: int = 1, storage: str = "f32"):
+    """Bind every level's channel plan up front (idempotent — repeat
+    calls hit the cache); returns the identity tuple."""
+    from parallel_convolution_tpu.parallel import channels as chan
+
+    keys = level_channel_keys(levels, radius, boundary, col_mode,
+                              channels, storage)
+    for k in keys:
+        chan.plan_for(k)
+    return keys
+
+
 # -- compiled level programs (lru-cached like step's builders) -------------
 
 _SPEC = P(None, *AXES)
@@ -276,7 +320,8 @@ _SPEC = P(None, *AXES)
 @lru_cache(maxsize=128)
 def _build_smooth_rhs(mesh: Mesh, filt: Filter, n: int, valid_hw, block_hw,
                       backend: str, boundary: str,
-                      tile: tuple[int, int] | None):
+                      tile: tuple[int, int] | None,
+                      col_mode: str = "strided"):
     """``n`` damped error-equation sweeps:
     ``e ← (1−ω)·e + ω·(mask(S e) + r)``.
 
@@ -293,7 +338,7 @@ def _build_smooth_rhs(mesh: Mesh, filt: Filter, n: int, valid_hw, block_hw,
                            block_hw)
     step = step_lib._make_block_step(
         filt, grid, valid_hw, block_hw, False, backend, 1, boundary, tile,
-        step_lib._mesh_interpret(mesh), False, False)
+        step_lib._mesh_interpret(mesh), False, False, col_mode)
 
     def body(e, r):
         def sweep(_, v):
@@ -311,7 +356,7 @@ def _build_smooth_rhs(mesh: Mesh, filt: Filter, n: int, valid_hw, block_hw,
 def _build_fine_smooth(mesh: Mesh, filt: Filter, n: int, valid_hw, block_hw,
                        backend: str, boundary: str,
                        tile: tuple[int, int] | None, overlap: bool,
-                       with_diff: bool):
+                       with_diff: bool, col_mode: str = "strided"):
     """``n`` damped fine-grid sweeps of the homogeneous equation:
     ``u ← (1−ω)·u + ω·mask(S u)``.
 
@@ -335,7 +380,7 @@ def _build_fine_smooth(mesh: Mesh, filt: Filter, n: int, valid_hw, block_hw,
                            block_hw)
     step = step_lib._make_block_step(
         filt, grid, valid_hw, block_hw, False, backend, 1, boundary, tile,
-        step_lib._mesh_interpret(mesh), False, overlap)
+        step_lib._mesh_interpret(mesh), False, overlap, col_mode)
 
     def damped(v, s):
         return ((1.0 - OMEGA) * v + OMEGA * s).astype(v.dtype)
@@ -363,7 +408,8 @@ def _build_fine_smooth(mesh: Mesh, filt: Filter, n: int, valid_hw, block_hw,
 @lru_cache(maxsize=128)
 def _build_residual_restrict(mesh: Mesh, filt: Filter, valid_hw, block_hw,
                              backend: str, boundary: str,
-                             tile: tuple[int, int] | None, fine: bool):
+                             tile: tuple[int, int] | None, fine: bool,
+                             col_mode: str = "strided"):
     """Residual + full-weighting restriction in ONE compiled program.
 
     ``fine=True``  : ``u → 4·restrict(S u − u)``  (the homogeneous fine
@@ -390,7 +436,7 @@ def _build_residual_restrict(mesh: Mesh, filt: Filter, valid_hw, block_hw,
                            block_hw)
     step = step_lib._make_block_step(
         filt, grid, valid_hw, block_hw, False, backend, 1, boundary, tile,
-        step_lib._mesh_interpret(mesh), False, False)
+        step_lib._mesh_interpret(mesh), False, False, col_mode)
     restrict = kernel_forms.resolve(2, "restrict_fw", boundary).build(
         grid, valid_hw, block_hw, boundary)
 
@@ -509,6 +555,7 @@ def mg_converge_stream(x, filt: Filter, tol: float, max_iters: int,
                        fallback: bool = False,
                        overlap: bool | None = None,
                        mg_levels: int | None = None,
+                       col_mode: str | None = None,
                        nu_pre: int = NU_PRE, nu_post: int = NU_POST,
                        nu_coarse: int = NU_COARSE):
     """Progressive multigrid solve: a generator over V-cycle snapshots.
@@ -542,13 +589,15 @@ def mg_converge_stream(x, filt: Filter, tol: float, max_iters: int,
     x = np.asarray(x, np.float32)
     channels, H, W = x.shape
     valid_hw = (int(H), int(W))
-    backend, _, tile, overlap, _ = step_lib._resolve_auto(
+    backend, _, tile, overlap, col_mode, _ = step_lib._resolve_auto(
         mesh, filt, backend, fuse, tile, storage, quantize, boundary,
-        valid_hw, channels, overlap=overlap)
+        valid_hw, channels, overlap=overlap, col_mode=col_mode)
     overlap = step_lib.resolve_overlap(overlap, backend, mesh)
     tile = step_lib._norm_tile(tile)
     levels = plan_levels(mesh, valid_hw, filt.radius, boundary, mg_levels)
     fine = levels[0]
+    col_mode = step_lib.resolve_col_mode(
+        col_mode, backend, mesh, fine.block_hw, filt.radius, 1, storage)
     if fallback:
         # Probe on the REAL fine-level block (plan_levels pads even only
         # when a coarser level follows) — kernel-family selection keys on
@@ -556,8 +605,15 @@ def mg_converge_stream(x, filt: Filter, tol: float, max_iters: int,
         # launch then fails.
         backend = step_lib._resolve_fallback(
             mesh, filt, backend, quantize, 1, boundary, tile, False,
-            storage=storage, block_hw=fine.block_hw, overlap=overlap)
+            storage=storage, block_hw=fine.block_hw, overlap=overlap,
+            col_mode=col_mode)
         overlap = kernel_forms.clamp_overlap(overlap, backend)
+        col_mode = step_lib.clamp_col_mode(col_mode, backend)
+    if kernel_forms.persistent_capable(backend):
+        # Cache each level's exchange identity on the schedule up front:
+        # every cycle's smoother kernels bind these SAME plans.
+        warm_level_channels(levels, filt.radius, boundary, col_mode,
+                            channels, storage)
     sweeps = _level_sweeps(levels, nu_pre, nu_post, nu_coarse)
     wu_cycle = cycle_work_units(levels, nu_pre, nu_post, nu_coarse)
     u = _fit_to(x, valid_hw, fine.mesh, fine.block_hw, src_mesh=None)
@@ -569,12 +625,13 @@ def mg_converge_stream(x, filt: Filter, tol: float, max_iters: int,
         if i == len(levels) - 1:
             return _build_smooth_rhs(
                 lv.mesh, filt, nu_coarse, lv.valid_hw, lv.block_hw,
-                backend, boundary, tile)(e, r)
+                backend, boundary, tile, col_mode)(e, r)
         e = _build_smooth_rhs(lv.mesh, filt, nu_pre, lv.valid_hw,
-                              lv.block_hw, backend, boundary, tile)(e, r)
+                              lv.block_hw, backend, boundary, tile,
+                              col_mode)(e, r)
         rc = _build_residual_restrict(
             lv.mesh, filt, lv.valid_hw, lv.block_hw, backend, boundary,
-            tile, False)(e, r)
+            tile, False, col_mode)(e, r)
         nxt = levels[i + 1]
         rc = _fit_to(rc, nxt.valid_hw, nxt.mesh, nxt.block_hw,
                      src_mesh=lv.mesh)
@@ -585,7 +642,8 @@ def mg_converge_stream(x, filt: Filter, tol: float, max_iters: int,
         e = _build_prolong_correct(lv.mesh, lv.valid_hw, lv.block_hw,
                                    boundary)(e, ec)
         return _build_smooth_rhs(lv.mesh, filt, nu_post, lv.valid_hw,
-                                 lv.block_hw, backend, boundary, tile)(e, r)
+                                 lv.block_hw, backend, boundary, tile,
+                                 col_mode)(e, r)
 
     cycles, wu, diff = 0, 0.0, float("inf")
     max_wu = float(max_iters)
@@ -597,14 +655,15 @@ def mg_converge_stream(x, filt: Filter, tol: float, max_iters: int,
             # periodic misalignment).
             u, d = _build_fine_smooth(
                 fine.mesh, filt, nu_pre + nu_post, fine.valid_hw,
-                fine.block_hw, backend, boundary, tile, overlap, True)(u)
+                fine.block_hw, backend, boundary, tile, overlap, True,
+                col_mode)(u)
         else:
             u = _build_fine_smooth(
                 fine.mesh, filt, nu_pre, fine.valid_hw, fine.block_hw,
-                backend, boundary, tile, overlap, False)(u)
+                backend, boundary, tile, overlap, False, col_mode)(u)
             rc = _build_residual_restrict(
                 fine.mesh, filt, fine.valid_hw, fine.block_hw, backend,
-                boundary, tile, True)(u)
+                boundary, tile, True, col_mode)(u)
             nxt = levels[1]
             rc = _fit_to(rc, nxt.valid_hw, nxt.mesh, nxt.block_hw,
                          src_mesh=fine.mesh)
@@ -620,7 +679,7 @@ def mg_converge_stream(x, filt: Filter, tol: float, max_iters: int,
             # reads (the same measure sharded_converge stops on).
             u, d = _build_fine_smooth(
                 fine.mesh, filt, nu_post, fine.valid_hw, fine.block_hw,
-                backend, boundary, tile, overlap, True)(u)
+                backend, boundary, tile, overlap, True, col_mode)(u)
         diff = float(d)   # the readback fences the cycle
         cycles += 1
         wu += wu_cycle
@@ -637,6 +696,7 @@ def mg_converge(x, filt: Filter, tol: float, max_iters: int,
                 tile: tuple[int, int] | None = None,
                 fallback: bool = False, overlap: bool | None = None,
                 mg_levels: int | None = None,
+                col_mode: str | None = None,
                 nu_pre: int = NU_PRE, nu_post: int = NU_POST,
                 nu_coarse: int = NU_COARSE) -> tuple[np.ndarray, MGResult]:
     """Run the V-cycle to convergence; returns ``(field_f32, MGResult)``.
@@ -658,28 +718,33 @@ def mg_converge(x, filt: Filter, tol: float, max_iters: int,
         x, filt, tol, max_iters, mesh=mesh, quantize=quantize,
         backend=backend, storage=storage, boundary=boundary, fuse=fuse,
         tile=tile, fallback=fallback, overlap=overlap, mg_levels=mg_levels,
-        nu_pre=nu_pre, nu_post=nu_post, nu_coarse=nu_coarse)
+        col_mode=col_mode, nu_pre=nu_pre, nu_post=nu_post,
+        nu_coarse=nu_coarse)
     for out, cycles, diff, wu in stream:
         pass
     # Post-resolution stamps: re-derive what the stream compiled with
     # (same resolution path, idempotent) so the result row can never
     # disagree with the program that produced it.
-    b, _, tl, ov, _ = step_lib._resolve_auto(
+    b, _, tl, ov, cm, _ = step_lib._resolve_auto(
         mesh, filt, backend, fuse, tile, storage, quantize, boundary,
-        tuple(int(v) for v in x.shape[1:]), channels, overlap=overlap)
+        tuple(int(v) for v in x.shape[1:]), channels, overlap=overlap,
+        col_mode=col_mode)
     ov = step_lib.resolve_overlap(ov, b, mesh)
+    cm = step_lib.resolve_col_mode(cm, b, mesh, levels[0].block_hw,
+                                   filt.radius, 1, storage)
     if fallback:
         from parallel_convolution_tpu.resilience import degrade
 
         b = degrade.effective_for(b) or b
         ov = kernel_forms.clamp_overlap(ov, b)
+        cm = step_lib.clamp_col_mode(cm, b)
     eff_backend, eff_overlap = b, ov
     res = MGResult(
         cycles=cycles, work_units=round(wu, 3), residual=diff,
         converged=diff < tol, levels=len(levels),
         level_grids=[f"{lv.grid[0]}x{lv.grid[1]}" for lv in levels],
         level_shapes=[f"{lv.valid_hw[0]}x{lv.valid_hw[1]}" for lv in levels],
-        backend=eff_backend, overlap=eff_overlap,
+        backend=eff_backend, overlap=eff_overlap, col_mode=cm,
         wall_s=round(time.perf_counter() - t0, 4),
         predicted_s_per_cycle=_predict_cycle_seconds(
             levels, sweeps, filt, eff_backend, channels, False,

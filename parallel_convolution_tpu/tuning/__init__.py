@@ -65,6 +65,10 @@ class Resolution:
     # Interior-first overlapped halo pipeline: the tuned (or clamped-
     # request) decision for the resolved backend; always a concrete bool.
     overlap: bool = False
+    # Column-slab transport (packed | strided): the tuned (or clamped-
+    # request) decision; always concrete — "packed" is the canonical
+    # inert label for tiers with no column RDMA transport.
+    col_mode: str = "packed"
 
 
 # The most recent resolution per process, for entry points that label
@@ -100,6 +104,7 @@ def resolve(mesh, filt, shape, *, storage: str = "f32",
             quantize: bool = True, boundary: str = "zero",
             fuse: int | None = None, tile: tuple[int, int] | None = None,
             overlap: bool | None = None,
+            col_mode: str | None = None,
             plans: PlanCache | None = None,
             check_every: int | None = None) -> Resolution:
     """Resolve ``backend="auto"`` (and unset fuse/tile) for one workload.
@@ -157,8 +162,17 @@ def resolve(mesh, filt, shape, *, storage: str = "f32",
         # An explicit overlap request overrides the plan's verdict;
         # either way the decision is clamped to legality at the knobs
         # actually resolved (a pinned fuse can change the legal
-        # interior, so the stored clamp is not enough).
+        # interior, so the stored clamp is not enough).  Same rule for
+        # col_mode: explicit request wins, the stored verdict otherwise,
+        # normalized to the canonical "packed" off the persistent tiers
+        # (where no column RDMA transport exists).
         want_ov = plan.overlap if overlap is None else overlap
+        want_cm = (plan.col_mode if col_mode in (None, "auto")
+                   else col_mode)
+        if (plan.backend not in costmodel.PERSISTENT_BACKENDS
+                or w.grid[1] <= 1
+                or want_cm not in costmodel.COL_MODES):
+            want_cm = "packed"  # no transport / inert: canonical label
         res = Resolution(
             backend=plan.backend,
             fuse=r_fuse,
@@ -168,13 +182,14 @@ def resolve(mesh, filt, shape, *, storage: str = "f32",
             key=w.key(),
             overlap=bool(want_ov) and costmodel.overlap_legal(
                 plan.backend, w.grid, w.block_hw, w.radius, r_fuse),
+            col_mode=want_cm,
         )
     else:
         result = search.tune(
             w, mesh=None, dry_run=True,
             fuses=[int(fuse)] if fuse is not None else None,
             tiles=[tuple(tile)] if tile is not None else None,
-            overlap=overlap)
+            overlap=overlap, col_mode=col_mode)
         p = result.plan
         res = Resolution(
             backend=p.backend,
@@ -184,6 +199,7 @@ def resolve(mesh, filt, shape, *, storage: str = "f32",
             predicted_gpx=p.predicted_gpx,
             key=w.key(),
             overlap=p.overlap,
+            col_mode=p.col_mode,
         )
     _LAST.append(res)
     del _LAST[:-4]  # bounded history; only the last is ever read

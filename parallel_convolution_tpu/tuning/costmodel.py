@@ -51,6 +51,35 @@ SCOPED_VMEM_BYTES = 16 * 2**20
 
 PALLAS_BACKENDS = ("pallas", "pallas_sep", "pallas_rdma")
 
+# Kernel forms with PERSISTENT halo channels (parallel.channels): their
+# exchange identity is bound once and reused across every fused
+# iteration / converge chunk / V-cycle level, so the per-phase
+# descriptor-setup term below is zeroed for them.  Mirrors the
+# kernel-form registry's ``persistent_capable`` bit (drift-guarded in
+# tests/test_channels.py) — hardcoded here because this module is
+# jax-free and must not import the (provider-importing) registry.
+PERSISTENT_BACKENDS = ("pallas_rdma",)
+
+# Per-phase descriptor/channel setup charged to NON-persistent exchange
+# forms: the cost of re-deriving buffers/counts/partners every round
+# that persistent channels pay once at bind time (the persistent-MPI
+# paper's motivating delta).  Order-of-magnitude from the same
+# scaling-model family as exchange_lat_s; pinned by a drift-guard test.
+EXCHANGE_SETUP_S = 1.5e-6
+
+# Per-row descriptor issue cost of a DIRECT STRIDED column-slab copy:
+# a strided RDMA walks one descriptor per contiguous run (one per padded
+# row), so its overhead scales with slab height while the packed
+# transport's extra cost scales with slab bytes — the derived-datatypes
+# trade (PAPERS.md) the ``col_mode`` A/B prices.  Pinned by the same
+# drift-guard test.
+STRIDED_ROW_DESC_S = 15e-9
+
+# The column transports the RDMA kernels implement (mirrors
+# parallel.channels.COL_MODES without importing it — jax-free either
+# way, but this module must stay import-cycle-free under tuning/).
+COL_MODES = ("packed", "strided")
+
 # Pallas kernels off-TPU run under the interpreter — hundreds to
 # thousands of times slower than compiled XLA.  The exact factor is
 # irrelevant; it only needs to dominate every legitimate difference so
@@ -130,18 +159,26 @@ def effective_tile(backend: str, tile: tuple[int, int] | None,
 
 
 def rdma_is_tiled(shape: tuple[int, int, int], block_hw: tuple[int, int],
-                  radius: int, fuse: int, storage: str) -> bool:
+                  radius: int, fuse: int, storage: str,
+                  col_mode: str = "strided",
+                  grid: tuple[int, int] | None = None) -> bool:
     """Whether ``pallas_rdma`` auto-selects its tiled (HBM-pad) kernel.
 
     Mirrors ``ops.pallas_rdma.fused_rdma_step``'s ``tiled=None``
-    auto-select: monolithic f32 padded buffer + storage-dtype output
-    over ``RDMA_TILED_VMEM_BYTES`` switches to the windowed variant.
+    auto-select: monolithic f32 padded buffer + storage-dtype output —
+    plus, for the packed column transport on a grid with a remote
+    column axis, the 4 f32 VMEM staging slots — over
+    ``RDMA_TILED_VMEM_BYTES`` switches to the windowed variant.
+    Callers that do not know the resolved ``col_mode``/``grid`` get the
+    staging-free (strided-equivalent) legacy accounting.
     """
     C = shape[0]
     h, w = block_hw
     d = radius * max(1, fuse)
     mono = (C * (h + 2 * d) * (w + 2 * d) * 4
             + C * h * w * STORAGE_BYTES[storage])
+    if col_mode == "packed" and grid is not None and grid[1] > 1:
+        mono += 4 * C * (h + 2 * d) * d * 4
     return mono > RDMA_TILED_VMEM_BYTES
 
 
@@ -236,15 +273,66 @@ def flops_per_px_iter(k: int, separable: bool, quantize: bool,
     return slots * (1.0 + rim_overhead(fuse, rim_tile, radius))
 
 
+def col_transport_seconds_per_round(block_hw: tuple[int, int], radius: int,
+                                    fuse: int, storage: str,
+                                    hw: HardwareModel,
+                                    col_mode: str = "packed") -> float:
+    """Extra per-round cost of moving the two STRIDED column slabs.
+
+    The slabs are cut at row-padded height (``bh + 2d`` — the corner
+    bytes ride the column phase).  ``strided`` pays one descriptor per
+    contiguous run (per padded row, both directions);  ``packed`` pays
+    the pack + unpack staging copies — the slab streamed through memory
+    twice more, read + write each, both directions.  The crossover (thin
+    slabs → packed, deep slabs → strided) is the derived-datatypes
+    decision ``pick_col_mode`` automates.
+    """
+    if col_mode not in COL_MODES:
+        raise ValueError(f"col_mode must be one of {COL_MODES}, "
+                         f"got {col_mode!r}")
+    d = radius * max(1, int(fuse))
+    rows = block_hw[0] + 2 * d
+    if col_mode == "strided":
+        return 2.0 * rows * STRIDED_ROW_DESC_S
+    slab_bytes = rows * d * STORAGE_BYTES[storage]
+    return 2.0 * 4.0 * slab_bytes / (hw.hbm_gbps * 1e9)
+
+
+def pick_col_mode(grid: tuple[int, int], block_hw: tuple[int, int],
+                  radius: int, fuse: int, storage: str,
+                  hw: HardwareModel) -> str:
+    """The cheaper column transport for this decomposition ("auto"'s
+    verdict).  No remote column partner (a 1-extent column axis) means
+    no column transport at all: the canonical label is then "packed"
+    (both modes compile the identical statically-elided program)."""
+    if grid[1] <= 1:
+        return "packed"
+    packed = col_transport_seconds_per_round(block_hw, radius, fuse,
+                                             storage, hw, "packed")
+    strided = col_transport_seconds_per_round(block_hw, radius, fuse,
+                                              storage, hw, "strided")
+    return "packed" if packed <= strided else "strided"
+
+
 def exchange_seconds_per_px_iter(grid: tuple[int, int],
                                  block_hw: tuple[int, int], radius: int,
                                  fuse: int, storage: str,
-                                 hw: HardwareModel) -> float:
+                                 hw: HardwareModel,
+                                 persistent: bool = False,
+                                 col_mode: str = "packed") -> float:
     """Per-pixel-iteration cost of the halo exchange, amortized over T.
 
-    Two phases (rows then columns) of launch latency plus the four
-    ghost slabs (depth r*T) over the neighbor links; a 1x1 grid has no
-    collective and costs zero (the statically-elided exchange).
+    Two terms per round, split since round 16 (persistent channels):
+
+    * SETUP — per-phase descriptor/schedule derivation, charged only to
+      non-persistent forms (``persistent=True`` zeroes it: channels are
+      bound once per exchange identity and reused);
+    * TRANSFER — two phases of launch latency, the four ghost slabs
+      (depth r*T) over the neighbor links, plus the column-transport
+      overhead of ``col_mode`` (strided descriptors vs staging copies).
+
+    A 1x1 grid has no collective and costs zero (the statically-elided
+    exchange, both terms).
     """
     if grid[0] * grid[1] == 1:
         return 0.0
@@ -253,7 +341,12 @@ def exchange_seconds_per_px_iter(grid: tuple[int, int],
     bh, bw = block_hw
     d = radius * T
     slab_bytes = 2.0 * (bh + bw) * d * B
-    per_round = 2.0 * hw.exchange_lat_s + slab_bytes / (hw.ici_gbps * 1e9)
+    setup = 0.0 if persistent else 2.0 * EXCHANGE_SETUP_S
+    col = (col_transport_seconds_per_round(block_hw, radius, T, storage,
+                                           hw, col_mode)
+           if grid[1] > 1 else 0.0)
+    per_round = (2.0 * hw.exchange_lat_s + setup + col
+                 + slab_bytes / (hw.ici_gbps * 1e9))
     return per_round / (T * bh * bw)
 
 
@@ -264,7 +357,8 @@ def predict_seconds_per_px_iter(backend: str, storage: str, fuse: int,
                                 grid: tuple[int, int], k: int,
                                 separable: bool, quantize: bool,
                                 hw: HardwareModel,
-                                overlap: bool = False) -> float:
+                                overlap: bool = False,
+                                col_mode: str = "packed") -> float:
     """Roofline time: max(bandwidth, compute) + exchange, per px-iter.
 
     ``overlap=True`` (legal only per :func:`overlap_legal`) models the
@@ -275,13 +369,21 @@ def predict_seconds_per_px_iter(backend: str, storage: str, fuse: int,
     claim (PAPERS.md) as a roofline term.  An illegal overlap request
     silently prices the serialized form (same clamp the dispatch layer
     applies), so the model and the executable can never disagree.
+
+    ``col_mode`` prices the column transport for tiers that HAVE the
+    A/B (``PERSISTENT_BACKENDS``); every other tier is charged the
+    packed-equivalent term (XLA's pad materialization IS a staging
+    copy), so the knob can never skew a cross-tier ranking.  The
+    persistent tiers also zero the per-phase setup term — the honest
+    ranking delta of bound-once channels.
     """
     radius = k // 2
     T = max(1, int(fuse))
     tile_eff = effective_tile(backend, tile)
     rim_tile = tile_eff if tile_eff is not None else block_hw
     if backend == "pallas_rdma" and not rdma_is_tiled(
-            shape, block_hw, radius, T, storage):
+            shape, block_hw, radius, T, storage,
+            col_mode=col_mode, grid=grid):
         rim_tile = block_hw  # monolithic: levels run on the whole block
     sep = separable and backend in ("separable", "pallas_sep")
     t_hbm = hbm_bytes_per_px_iter(
@@ -290,8 +392,10 @@ def predict_seconds_per_px_iter(backend: str, storage: str, fuse: int,
     t_flop = flops_per_px_iter(
         k, sep, quantize, T, rim_tile, radius) / (hw.flop_gops * 1e9)
     t_roof = max(t_hbm, t_flop)
+    persistent = backend in PERSISTENT_BACKENDS
     t_ex = exchange_seconds_per_px_iter(
-        grid, block_hw, radius, T, storage, hw)
+        grid, block_hw, radius, T, storage, hw, persistent=persistent,
+        col_mode=col_mode if persistent else "packed")
     if overlap and overlap_legal(backend, grid, block_hw, radius, T):
         t = max(t_roof, t_ex)
     else:

@@ -172,6 +172,10 @@ class Plan:
     # records from pre-overlap plan files lack the key and default to
     # False — the exact pre-overlap behavior, so no schema bump.
     overlap: bool = False
+    # Column-slab transport (round 16, the packed-vs-strided A/B).
+    # Legacy records lack the key and default to the canonical "packed"
+    # — byte-identical to every other mode, so no schema bump.
+    col_mode: str = "packed"
 
     def to_record(self, workload: Workload | None = None) -> dict:
         rec = {
@@ -182,6 +186,7 @@ class Plan:
             "predicted_gpx": self.predicted_gpx,
             "measured_gpx": self.measured_gpx,
             "overlap": bool(self.overlap),
+            "col_mode": str(self.col_mode),
         }
         if workload is not None:
             rec["key_fields"] = workload.key_fields()
@@ -198,6 +203,7 @@ class Plan:
             predicted_gpx=rec.get("predicted_gpx"),
             measured_gpx=rec.get("measured_gpx"),
             overlap=bool(rec.get("overlap", False)),
+            col_mode=str(rec.get("col_mode", "packed")),
         )
 
 

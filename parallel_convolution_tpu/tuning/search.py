@@ -54,13 +54,17 @@ _PREFERENCE = ("shifted", "xla_conv", "separable", "pallas_sep", "pallas",
 
 @dataclasses.dataclass(frozen=True)
 class Candidate:
-    """One point of the knob space: (backend, fuse, tile, overlap)."""
+    """One point of the knob space:
+    (backend, fuse, tile, overlap, col_mode)."""
 
     backend: str
     fuse: int = 1
     tile: tuple[int, int] | None = None
     overlap: bool = False  # interior-first overlapped halo pipeline
     #                        (RDMA tier only; costmodel.overlap_legal)
+    col_mode: str = "packed"  # column-slab transport (persistent tiers
+    #                           only; both modes byte-identical, the
+    #                           model prices the descriptor trade)
 
 
 def _sep_byte_safe(w: Workload) -> bool:
@@ -193,8 +197,29 @@ def _legal_overlaps(w: Workload, backend: str, fuse: int,
     return [bool(overlap) and legal]
 
 
+def _legal_col_modes(w: Workload, backend: str,
+                     col_mode: str | None) -> list[str]:
+    """Column-transport options for one backend.
+
+    Only persistent-capable tiers with a REAL remote column axis have
+    the A/B (both transports compile the identical statically-elided
+    program otherwise — enumerating twins would burn the measurement
+    budget on duplicates); everywhere else the knob is inert and
+    normalizes to the canonical "packed".  Like overlap, an explicit
+    request is clamped rather than dying: both modes are byte-identical,
+    and legality depends on the backend the tuner is still choosing.
+    """
+    if (backend not in costmodel.PERSISTENT_BACKENDS
+            or w.grid[1] <= 1):
+        return ["packed"]
+    if col_mode in (None, "auto"):
+        return ["packed", "strided"]
+    return [col_mode if col_mode in costmodel.COL_MODES else "packed"]
+
+
 def enumerate_candidates(w: Workload, backends=None, fuses=None,
                          tiles=None, overlap: bool | None = None,
+                         col_mode: str | None = None,
                          ) -> list[Candidate]:
     """The deterministic legal candidate list for one workload.
 
@@ -202,7 +227,9 @@ def enumerate_candidates(w: Workload, backends=None, fuses=None,
     passed knob is honored verbatim; legality still filters fuse depth
     so an impossible pin dies here with an empty-space error rather
     than deep inside a kernel launch).  ``overlap`` (None = enumerate
-    both where legal) is a clamped request — see :func:`_legal_overlaps`.
+    both where legal) is a clamped request — see :func:`_legal_overlaps`
+    — and ``col_mode`` likewise (None/'auto' = enumerate both where the
+    transport exists; see :func:`_legal_col_modes`).
     """
     out = []
     for b in (backends if backends is not None else _legal_backends(w)):
@@ -212,7 +239,8 @@ def enumerate_candidates(w: Workload, backends=None, fuses=None,
                                   else TILE_MENU, strict=tiles is not None,
                                   fuse=T):
                 for ov in _legal_overlaps(w, b, T, overlap):
-                    out.append(Candidate(b, T, t, ov))
+                    for cm in _legal_col_modes(w, b, col_mode):
+                        out.append(Candidate(b, T, t, ov, cm))
     if not out:
         raise ValueError(
             f"no legal candidates for {w.filter_name} {w.shape} on grid "
@@ -226,7 +254,8 @@ def predict(w: Workload, c: Candidate,
     hw = hw or costmodel.hardware_for(w.platform, w.device_kind)
     return costmodel.predict_seconds_per_px_iter(
         c.backend, w.storage, c.fuse, c.tile, w.shape, w.block_hw, w.grid,
-        w.taps_k, w.separable, w.quantize, hw, overlap=c.overlap)
+        w.taps_k, w.separable, w.quantize, hw, overlap=c.overlap,
+        col_mode=c.col_mode)
 
 
 def rank(w: Workload, candidates,
@@ -242,7 +271,8 @@ def rank(w: Workload, candidates,
                 if c.backend in _PREFERENCE else len(_PREFERENCE))
         # overlap last: on a model tie (exchange fully hidden OR zero)
         # the serialized form wins — never pipeline for a predicted 0.
-        return (t, pref, c.fuse, c.tile or (0, 0), c.overlap)
+        # col_mode last of all: packed (the canonical label) wins ties.
+        return (t, pref, c.fuse, c.tile or (0, 0), c.overlap, c.col_mode)
 
     return sorted(((predict(w, c, hw), c) for c in candidates),
                   key=sort_key)
@@ -274,7 +304,8 @@ def measure(w: Workload, c: Candidate, mesh, *, iters: int = 8,
         mesh=mesh, channels=w.shape[0], backend=c.backend,
         quantize=w.quantize, storage=w.storage, fuse=c.fuse,
         boundary=w.boundary, reps=reps, tile=c.tile,
-        interior_split=interior_split, overlap=c.overlap)
+        interior_split=interior_split, overlap=c.overlap,
+        col_mode=c.col_mode)
     row["predicted_gpx_per_chip"] = round(
         costmodel.predict_gpx_per_chip(predict(w, c)), 3)
     return row
@@ -282,6 +313,7 @@ def measure(w: Workload, c: Candidate, mesh, *, iters: int = 8,
 
 def tune(w: Workload, mesh=None, *, dry_run: bool = False,
          backends=None, fuses=None, tiles=None, overlap: bool | None = None,
+         col_mode: str | None = None,
          iters: int = 8,
          reps: int = 2, max_measure: int = 8, prune_factor: float = 4.0,
          interior_split: bool = False) -> TuneResult:
@@ -297,7 +329,8 @@ def tune(w: Workload, mesh=None, *, dry_run: bool = False,
     the tuner prices what works.
     """
     ranked = rank(w, enumerate_candidates(w, backends, fuses, tiles,
-                                          overlap=overlap))
+                                          overlap=overlap,
+                                          col_mode=col_mode))
     best_t, best_c = ranked[0]
     predicted_gpx = costmodel.predict_gpx_per_chip(best_t)
     if dry_run or mesh is None:
@@ -305,7 +338,7 @@ def tune(w: Workload, mesh=None, *, dry_run: bool = False,
             Plan(best_c.backend, best_c.fuse, best_c.tile,
                  source="predicted",
                  predicted_gpx=round(predicted_gpx, 3),
-                 overlap=best_c.overlap),
+                 overlap=best_c.overlap, col_mode=best_c.col_mode),
             w, rows=[])
     rows: list[dict] = []
     measured: list[tuple[float, Candidate, float]] = []
@@ -319,7 +352,7 @@ def tune(w: Workload, mesh=None, *, dry_run: bool = False,
             rows.append({"backend": c.backend, "fuse": c.fuse,
                          "tile": (f"{c.tile[0]}x{c.tile[1]}" if c.tile
                                   else None),
-                         "overlap": c.overlap,
+                         "overlap": c.overlap, "col_mode": c.col_mode,
                          "error": repr(e)[:200]})
             continue
         rows.append(row)
@@ -336,5 +369,5 @@ def tune(w: Workload, mesh=None, *, dry_run: bool = False,
     return TuneResult(
         Plan(c.backend, c.fuse, c.tile, source="measured",
              predicted_gpx=round(pred, 3), measured_gpx=round(gpx, 3),
-             overlap=c.overlap),
+             overlap=c.overlap, col_mode=c.col_mode),
         w, rows=rows)

@@ -124,6 +124,7 @@ def bench_iterate(
     interior_split: bool = False,
     fallback: bool = False,
     overlap: bool | None = None,
+    col_mode: str | None = None,
 ) -> dict:
     """Gpixels/sec/chip for the standard fixed-iteration workload.
 
@@ -164,14 +165,19 @@ def bench_iterate(
     # dtype and sharding are invariant, exactly the double-buffer reuse the
     # real pipeline gets.
     xs, valid_hw, block_hw = step_lib._prepare(x, mesh, filt.radius, storage)
-    effective, fuse, tile, overlap, plan_source = step_lib._resolve_auto(
-        mesh, filt, backend, fuse, tile, storage, quantize, boundary,
-        valid_hw, channels, overlap=overlap)
+    effective, fuse, tile, overlap, col_mode, plan_source = (
+        step_lib._resolve_auto(
+            mesh, filt, backend, fuse, tile, storage, quantize, boundary,
+            valid_hw, channels, overlap=overlap, col_mode=col_mode))
     plan_source = plan_source or "explicit"
-    # The overlap knob the executable will ACTUALLY be compiled with —
-    # stamped below exactly like tile/fuse (post-auto-resolution, post-
-    # clamp), so a row can never disagree with the compiled program.
+    # The overlap/col_mode knobs the executable will ACTUALLY be
+    # compiled with — stamped below exactly like tile/fuse (post-auto-
+    # resolution, post-clamp), so a row can never disagree with the
+    # compiled program.
     overlap = step_lib.resolve_overlap(overlap, effective, mesh)
+    col_mode = step_lib.resolve_col_mode(col_mode, effective, mesh,
+                                         block_hw, filt.radius, fuse,
+                                         storage)
     if fallback:
         from parallel_convolution_tpu.resilience import degrade
 
@@ -180,11 +186,13 @@ def bench_iterate(
         effective = degrade.resolve_backend(
             mesh, filt, effective, quantize=quantize, fuse=fuse,
             boundary=boundary, tile=tile, interior_split=interior_split,
-            storage=storage, block_hw=block_hw, overlap=overlap)
+            storage=storage, block_hw=block_hw, overlap=overlap,
+            col_mode=col_mode)
         overlap = overlap and effective == "pallas_rdma"
+        col_mode = step_lib.clamp_col_mode(col_mode, effective)
     fn = step_lib._build_iterate(mesh, filt, iters, quantize, valid_hw,
                                  block_hw, effective, fuse, boundary,
-                                 tile, interior_split, overlap)
+                                 tile, interior_split, overlap, col_mode)
     out = fence(fn(xs))  # compile + warmup
 
     # The fence itself can cost a large constant on tunnel platforms
@@ -249,13 +257,14 @@ def bench_iterate(
     compiled_fuse = max(1, min(fuse, iters or 1))
     compiled_tile = costmodel.effective_tile(effective, tile)
     if effective == "pallas_rdma" and not costmodel.rdma_is_tiled(
-            (channels, H, W), block_hw, filt.radius, compiled_fuse, storage):
+            (channels, H, W), block_hw, filt.radius, compiled_fuse, storage,
+            col_mode=col_mode, grid=grid_shape(mesh)):
         compiled_tile = None  # monolithic kernel: no output tile exists
     w = Workload.from_mesh(mesh, filt, (channels, H, W), storage=storage,
                            quantize=quantize, boundary=boundary)
     predicted = costmodel.predict_gpx_per_chip(search.predict(
         w, search.Candidate(effective, compiled_fuse, compiled_tile,
-                            overlap)))
+                            overlap, col_mode)))
     # Exchange/overlap attribution (obs.attribution): the analytic
     # per-direction ghost-band bytes of this decomposition and the
     # roofline model's exchange share — the per-phase instrumentation
@@ -273,7 +282,7 @@ def bench_iterate(
         wall_s=secs, shape=(channels, H, W), quantize=quantize,
         tile=compiled_tile, platform=dev0.platform,
         device_kind=getattr(dev0, "device_kind", "") or "",
-        source="bench", overlap=overlap)
+        source="bench", overlap=overlap, col_mode=col_mode)
     if att is None:
         split = attribution.predicted_exchange_split(
             grid, block_hw, filt.radius, compiled_fuse,
@@ -313,6 +322,9 @@ def bench_iterate(
         # post-degrade) — the program this row timed either was or was
         # not the interior-first pipeline; the row says which.
         "overlap": bool(overlap),
+        # The RESOLVED column-slab transport, same stamping rule
+        # ("packed" is the canonical inert label off the RDMA tier).
+        "col_mode": col_mode,
         "plan_source": plan_source,
         # The canonical tuning identity of the timed config — the
         # drift-series label and perf_gate.py's history key.
@@ -356,6 +368,7 @@ def bench_converge(
     solver: str = "jacobi",
     mg_levels: int | None = None,
     overlap: bool | None = None,
+    col_mode: str | None = None,
     seed: int = 0,
 ) -> dict:
     """One run-to-convergence row, solver-comparable by construction.
@@ -380,9 +393,10 @@ def bench_converge(
     # Post-resolution stamping, same rule as bench_iterate: resolve
     # backend="auto"/fuse=None/tile=None through the tuning subsystem
     # FIRST so the row records the program that actually ran.
-    backend, fuse, tile, overlap, _ = step_lib._resolve_auto(
+    backend, fuse, tile, overlap, col_mode, _ = step_lib._resolve_auto(
         mesh, filt, backend, fuse, tile, storage, False, boundary,
-        (H, W), channels, check_every=int(check_every), overlap=overlap)
+        (H, W), channels, check_every=int(check_every), overlap=overlap,
+        col_mode=col_mode)
     w = Workload.from_mesh(mesh, filt, (channels, H, W), storage=storage,
                            quantize=False, boundary=boundary)
     dev0 = mesh.devices.flat[0]
@@ -407,10 +421,11 @@ def bench_converge(
             x, filt, tol=tol, max_iters=max_iters, mesh=mesh,
             quantize=False, backend=backend, storage=storage,
             boundary=boundary, fuse=fuse, tile=tile, overlap=overlap,
-            mg_levels=mg_levels)
+            mg_levels=mg_levels, col_mode=col_mode)
         row.update({
             "effective_backend": res.backend,
             "overlap": res.overlap,
+            "col_mode": res.col_mode,
             "converged": res.converged,
             "residual": float(res.residual),
             "cycles": res.cycles,
@@ -435,10 +450,15 @@ def bench_converge(
                 x, filt, tol=tol, max_iters=max_iters,
                 check_every=check_every, mesh=mesh, quantize=False,
                 backend=backend, storage=storage, boundary=boundary,
-                fuse=fuse, tile=tile, overlap=overlap):
+                fuse=fuse, tile=tile, overlap=overlap,
+                col_mode=col_mode):
             pass
         row.update({
             "effective_backend": backend,
+            "col_mode": step_lib.resolve_col_mode(
+                col_mode, backend, mesh,
+                (-(-H // grid[0]), -(-W // grid[1])), filt.radius,
+                int(fuse), storage),
             "converged": diff is not None and diff < tol,
             "residual": diff,
             "iters": iters,

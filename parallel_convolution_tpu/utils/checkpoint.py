@@ -462,6 +462,7 @@ def run_checkpointed(
     interior_split: bool = False,
     fallback: bool = False,
     overlap: bool | None = None,
+    col_mode: str | None = None,
 ) -> jax.Array:
     """Iterate with a snapshot every ``every`` iterations; auto-resume.
 
@@ -549,7 +550,7 @@ def run_checkpointed(
             xs, filt, chunk, mesh, valid_hw, interior_split=interior_split,
             quantize=quantize, backend=backend, fuse=min(fuse, chunk),
             boundary=boundary, tile=tile, check_contract=False,
-            fallback=fallback, overlap=overlap,
+            fallback=fallback, overlap=overlap, col_mode=col_mode,
         )
         done += chunk
         if done < total_iters:  # final state is the caller's to persist

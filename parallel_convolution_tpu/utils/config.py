@@ -32,6 +32,15 @@ BOUNDARIES = ("zero", "periodic")
 # wire schema share one source.
 SOLVERS = ("jacobi", "multigrid")
 
+# Column-slab transports of the RDMA kernels (round 16, the
+# derived-datatypes A/B): "packed" stages the strided slab through a
+# contiguous buffer and moves ONE dense RDMA; "strided" issues the
+# direct strided copy; "auto" lets the cost model pick.  Jax-free here
+# so CLI/serving validation, the plan schema, and the channel layer
+# share one source.
+COL_MODES = ("packed", "strided")
+COL_MODE_CHOICES = COL_MODES + ("auto",)
+
 # Env escape hatch: run the overlapped RDMA pipeline under interpreted
 # Pallas anyway (CI byte proofs).  Lives here (jax-free) because BOTH
 # the dispatch clamp (parallel/step.resolve_overlap) and the tuner's
@@ -60,6 +69,10 @@ class RunConfig:
     #                                pipeline (RDMA kernels): None = off
     #                                for explicit backends, tuned for
     #                                "auto"; True/False = clamped request
+    col_mode: str | None = None    # RDMA column-slab transport: None or
+    #                                "auto" = cost-model pick; packed/
+    #                                strided honored on the RDMA tier
+    #                                (byte-identical either way)
     boundary: str = "zero"
     quantize: bool = True
     converge_tol: float | None = None
@@ -111,6 +124,11 @@ class RunConfig:
                 "fuse=None means 'tune it' and needs backend='auto'")
         if self.overlap is not None:
             self.overlap = bool(self.overlap)
+        if (self.col_mode is not None
+                and self.col_mode not in COL_MODE_CHOICES):
+            raise ValueError(
+                f"col_mode must be one of {COL_MODE_CHOICES}, got "
+                f"{self.col_mode!r}")
         if self.mesh_shape is not None:
             self.mesh_shape = tuple(self.mesh_shape)
         if self.tile is not None:
@@ -141,5 +159,5 @@ class RunConfig:
             filt=self.filter_name, mesh=mesh, backend=self.backend,
             quantize=self.quantize, storage=self.storage, fuse=self.fuse,
             boundary=self.boundary, tile=self.tile, overlap=self.overlap,
-            fallback=self.fallback,
+            col_mode=self.col_mode, fallback=self.fallback,
         )

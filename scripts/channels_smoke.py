@@ -1,0 +1,219 @@
+#!/usr/bin/env python
+"""Persistent/partitioned halo-channel smoke: the round-16 gates,
+end-to-end on the CPU mesh.
+
+The ``run_t1.sh --channels-smoke`` leg.  Gates, in order:
+
+1. BYTE IDENTITY — every CPU-reachable cell of
+   {serialized, r12 overlap, persistent+partitioned} x {packed, strided}
+   is byte-identical to the oracle AND pairwise identical, both kernels,
+   both boundaries (``scripts/rdma_fuse_ab.channels_proofs``: on a jax
+   without the DMA-faithful interpreter the multi-device cells are typed
+   capability skips and the degenerate 1x1 proofs — where the channel
+   machinery must statically elide — carry the byte burden).
+2. CHANNEL REUSE IS REAL — across a fused multi-chunk converge run the
+   channel-plan build counter equals the number of DISTINCT exchange
+   identities (one per (fuse-depth, kernel-form) the runner compiles)
+   and stays FLAT across additional chunks and a second converge; the
+   multigrid V-cycle level schedule likewise binds one plan per level,
+   flat across repeat warms.
+3. DISPATCH RESOLUTION — ``col_mode='auto'`` resolves deterministically
+   through the cost model, the resolved value lands in bench rows, and
+   both explicit modes produce oracle bytes through the full dispatch
+   stack (``sharded_iterate``).
+4. PERF SENTRY FOLD — the cell's bench row seeds and re-gates the
+   smoke's OWN history through ``scripts/perf_gate.py``.
+
+One summary row lands in ``--out`` (``evidence/channels_smoke.json``,
+the supervisor leg's done_file) with ``"failures": 0`` iff every gate
+held.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import _path  # noqa: F401  (repo root + JAX_PLATFORMS re-apply)
+
+SCRIPTS = Path(__file__).resolve().parent
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rows", type=int, default=48)
+    ap.add_argument("--cols", type=int, default=64)
+    ap.add_argument("--mesh", default="2x4")
+    ap.add_argument("--out", default="evidence/channels_smoke.json")
+    ap.add_argument("--history",
+                    default="evidence/channels_smoke_history.jsonl",
+                    help="the smoke's OWN perf history, seeded fresh "
+                         "each run; never the committed "
+                         "evidence/perf_history.jsonl")
+    args = ap.parse_args()
+
+    # The byte proofs drive the overlapped program under interpreted
+    # Pallas — the documented CI hatch.
+    os.environ.setdefault("PCTPU_OVERLAP_INTERPRET", "1")
+
+    import numpy as np
+
+    import rdma_fuse_ab
+    from parallel_convolution_tpu.ops import filters, oracle
+    from parallel_convolution_tpu.parallel import channels, step as step_lib
+    from parallel_convolution_tpu.parallel.mesh import (
+        make_grid_mesh, mesh_from_spec,
+    )
+    from parallel_convolution_tpu.solvers import multigrid as mg
+    from parallel_convolution_tpu.utils import bench, imageio, jax_compat
+
+    import jax
+
+    failures: list[str] = []
+    mesh = mesh_from_spec(args.mesh)
+    mesh_shape = tuple(int(v) for v in mesh.devices.shape)
+    filt = filters.get_filter("blur3")
+
+    # ---- 1: byte identity across tiers x transports (both kernels).
+    rows = rdma_fuse_ab.channels_proofs(
+        filt, [1, 2, 4], mesh_shape,
+        rdma_capable=jax_compat.HAS_TPU_INTERPRET or mesh.size == 1)
+    cells = [r for r in rows if "skipped" not in r]
+    skips = [r for r in rows if "skipped" in r]
+    for r in cells:
+        if "error" in r:
+            failures.append(f"channel cell errored: {r}")
+        elif not (r.get("oracle_bytes_ok") and r.get("matches_serialized")):
+            failures.append(f"channel cell bytes drifted: {r}")
+    if not cells:
+        failures.append("no channel byte-proof cell ran at all")
+
+    # ---- 2: channel reuse — builds == distinct identities, flat across
+    # chunks / repeat solves.  The degenerate 1x1 grid is the
+    # CPU-reachable RDMA host; its (empty) plans still bind and count.
+    one = make_grid_mesh(jax.devices()[:1], (1, 1))
+    img = imageio.generate_test_image(args.rows, args.cols, "grey", seed=3)
+    x = imageio.interleaved_to_planar(img).astype(np.float32)
+    channels.reset()
+    out1, it1 = step_lib.sharded_converge(
+        x, filt, tol=0.0, max_iters=12, check_every=4, mesh=one,
+        quantize=True, backend="pallas_rdma", fuse=2)
+    after_first = channels.stats()
+    out2, it2 = step_lib.sharded_converge(
+        x, filt, tol=0.0, max_iters=24, check_every=4, mesh=one,
+        quantize=True, backend="pallas_rdma", fuse=2)
+    after_second = channels.stats()
+    # One converge build compiles two step forms (fuse=1 pair step +
+    # fuse=2 fused chunk) = two distinct exchange identities; every
+    # later chunk and the longer re-run must never rebuild.
+    if after_first["builds"] != 2:
+        failures.append(
+            f"converge run built {after_first['builds']} channel plans "
+            "(expected 2: the fused chunk + the single-step identity)")
+    if after_second["builds"] != after_first["builds"]:
+        failures.append(
+            f"channel builds grew across converge runs "
+            f"({after_first['builds']} -> {after_second['builds']}): "
+            "descriptor plans are being rebuilt, not reused")
+    # A program VARIANT sharing the exchange identity (the overlapped
+    # pipeline of the same fused chunk) must HIT the bound plan, not
+    # rebuild it — the cross-trace reuse the channel layer exists for.
+    _ = step_lib.sharded_iterate(x, filt, 4, mesh=one, quantize=True,
+                                 backend="pallas_rdma", fuse=2,
+                                 overlap=True)
+    after_variant = channels.stats()
+    if after_variant["builds"] != after_first["builds"]:
+        failures.append(
+            "the overlapped variant of an already-bound exchange "
+            f"identity REBUILT its plan ({after_first['builds']} -> "
+            f"{after_variant['builds']} builds)")
+    if after_variant["hits"] <= after_second["hits"]:
+        failures.append("the overlapped variant recorded no channel-plan "
+                        "reuse")
+    want = oracle.run_serial_u8(img, filt, 12)
+    got = imageio.planar_to_interleaved(
+        np.clip(np.rint(np.asarray(out1)), 0, 255).astype(np.uint8))
+    if not np.array_equal(got, want):
+        failures.append("converge-through-channels bytes drifted from "
+                        "the oracle")
+    # Multigrid: one plan per V-cycle level, bound on the schedule, flat
+    # across repeat warms.
+    levels = mg.plan_levels(one, (96, 64), filt.radius, "zero")
+    channels.reset()
+    keys = mg.warm_level_channels(levels, filt.radius, "zero", "packed")
+    s1 = channels.stats()
+    mg.warm_level_channels(levels, filt.radius, "zero", "packed")
+    s2 = channels.stats()
+    if s1["builds"] != len(set(keys)):
+        failures.append(
+            f"V-cycle schedule built {s1['builds']} plans for "
+            f"{len(set(keys))} distinct level identities")
+    if s2["builds"] != s1["builds"] or s2["hits"] < s1["hits"] + len(keys):
+        failures.append("re-warming the V-cycle level schedule rebuilt "
+                        "channel plans instead of hitting the cache")
+
+    # ---- 3: dispatch resolution + bench-row stamping.
+    dev0 = one.devices.flat[0]
+    from parallel_convolution_tpu.tuning import costmodel
+
+    hw = costmodel.hardware_for(dev0.platform,
+                                getattr(dev0, "device_kind", "") or "")
+    auto_pick = costmodel.pick_col_mode(mesh_shape, (args.rows, args.cols),
+                                        filt.radius, 2, "f32", hw)
+    if auto_pick not in ("packed", "strided"):
+        failures.append(f"pick_col_mode returned {auto_pick!r}")
+    for cm in ("packed", "strided", None):
+        out = step_lib.sharded_iterate(
+            x, filt, 4, mesh=one, quantize=True, backend="pallas_rdma",
+            fuse=2, col_mode=cm)
+        got = imageio.planar_to_interleaved(
+            np.asarray(out).astype(np.uint8))
+        if not np.array_equal(got, oracle.run_serial_u8(img, filt, 4)):
+            failures.append(f"dispatch col_mode={cm!r} bytes drifted")
+    row = bench.bench_iterate((args.rows, args.cols), filt, 2, mesh=one,
+                              backend="pallas_rdma", reps=1, fuse=2)
+    if row.get("col_mode") not in ("packed", "strided"):
+        failures.append(f"bench row stamps col_mode={row.get('col_mode')!r}")
+
+    # ---- 4: perf sentry fold — the smoke's own history, seed + re-gate.
+    out_path = Path(args.out)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    rows_path = out_path.with_suffix(".rows.json")
+    rows_path.write_text(json.dumps([row]))
+    hist = Path(args.history)
+    hist.parent.mkdir(parents=True, exist_ok=True)
+    hist.write_text("")   # the smoke's OWN history: truncate per run
+    gate = [sys.executable, str(SCRIPTS / "perf_gate.py"),
+            "--history", str(hist), "--row", str(rows_path), "--quiet"]
+    rc_seed = subprocess.run([*gate, "--update"], check=False).returncode
+    rc_pass = subprocess.run(gate, check=False).returncode
+    if rc_seed != 0:
+        failures.append(f"perf_gate seed run exited {rc_seed}")
+    if rc_pass != 0:
+        failures.append(f"perf_gate re-gate exited {rc_pass}")
+
+    summary = {
+        "probe": "channels_smoke",
+        "workload": f"blur3 {args.rows}x{args.cols} mesh={args.mesh}",
+        "cells": len(cells),
+        "skipped_capability": len(skips),
+        "channel_builds_converge": after_first["builds"],
+        "channel_hits_total": after_variant["hits"],
+        "mg_level_identities": len(keys),
+        "auto_col_mode": auto_pick,
+        "bench_col_mode": row.get("col_mode"),
+        "converge_iters": [int(it1), int(it2)],
+        "failures": len(failures),
+        "failure_detail": failures[:8],
+    }
+    out_path.write_text(json.dumps(summary, indent=2))
+    print(json.dumps(summary), flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

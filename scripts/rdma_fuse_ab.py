@@ -30,9 +30,17 @@ Rows are JSONL for the evidence ledger:
   overlap/serialized), ``failures`` (byte mismatches + unexpected
   errors), and ``bytes_ok_all``.
 
+Round 16 adds the ``--channels`` column: persistent+partitioned per-slab
+completion vs the r12 phase-granular overlap vs serialized, crossed with
+the {packed, strided} column transport — oracle byte-check every cell,
+both kernels, both boundaries (multi-device cells are typed capability
+skips on a jax without the faithful interpreter; the degenerate 1x1
+proofs always run).
+
 Usage:
   python scripts/rdma_fuse_ab.py                       # CPU mesh (8 virt.)
   python scripts/rdma_fuse_ab.py --overlap --out evidence/overlap_smoke.json
+  python scripts/rdma_fuse_ab.py --channels            # round-16 A/B
   python scripts/rdma_fuse_ab.py --size 1024 --iters 64  # silicon regime
 """
 
@@ -131,6 +139,127 @@ def _degenerate_overlap_proofs(filt, fuses):
     return rows
 
 
+def _kernel_tiers(filt, fuse, mesh_shape, boundary, dims, *, col_mode,
+                  tiled=None, tile=None, seed=71):
+    """Run the three channel tiers — serialized, r12 phase-granular
+    overlap, persistent+partitioned — for one (fuse, col_mode) cell,
+    driving ``fused_rdma_step`` directly (the ``partitioned`` knob is a
+    kernel-layer A/B reference, deliberately not a dispatch knob).
+    Returns ``(oracle_u8, {tier: bytes})``."""
+    import jax
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from parallel_convolution_tpu.ops import oracle, pallas_rdma
+    from parallel_convolution_tpu.parallel.mesh import AXES, make_grid_mesh
+    from parallel_convolution_tpu.utils import imageio, jax_compat
+
+    mesh = make_grid_mesh(
+        jax.devices()[: mesh_shape[0] * mesh_shape[1]], mesh_shape)
+    img = imageio.generate_test_image(*dims, "grey", seed=seed)
+    iters = 2 * fuse
+    want = oracle.run_serial_u8(img, filt, iters, boundary=boundary)
+    x = imageio.interleaved_to_planar(img).astype(np.float32)
+    valid_hw = None if boundary == "periodic" else dims
+    got = {}
+    for tier, (ov, part) in (("serialized", (False, True)),
+                             ("overlap", (True, False)),
+                             ("partitioned", (True, True))):
+        def body(v, ov=ov, part=part):
+            import jax.lax as lax
+
+            def one(_, cur):
+                return pallas_rdma.fused_rdma_step(
+                    cur, filt, mesh_shape, boundary, quantize=True,
+                    tiled=tiled, tile=tile, fuse=fuse, valid_hw=valid_hw,
+                    overlap=ov, col_mode=col_mode, partitioned=part)
+            return lax.fori_loop(0, 2, one, v)
+        out = jax.jit(jax_compat.shard_map(
+            body, mesh=mesh, in_specs=P(None, *AXES),
+            out_specs=P(None, *AXES), check_vma=False))(x)
+        got[tier] = imageio.planar_to_interleaved(
+            np.asarray(out).astype(np.uint8))
+    return want, got
+
+
+def channels_proofs(filt, fuses, mesh_shape, rdma_capable):
+    """The --channels column: byte-identity of
+    {serialized, r12 overlap, persistent+partitioned} x {packed, strided}
+    per fuse, both boundaries, both kernels — oracle byte-check every
+    cell.  Multi-device cells ride the faithful interpreter (typed
+    capability skips without it); the degenerate 1x1 cells ALWAYS run —
+    there the channel machinery must statically elide to the serialized
+    program verbatim, which the test suite additionally pins at the
+    lowered-program level."""
+    import numpy as np
+
+    rows = []
+    grids = [(1, 1)]
+    if rdma_capable and mesh_shape != (1, 1):
+        grids.append(mesh_shape)
+    elif mesh_shape != (1, 1):
+        rows.append({"ab": "channels", "grid": "x".join(
+            str(g) for g in mesh_shape), "skipped": "capability",
+            "detail": "no DMA-faithful TPU interpreter in this jax; "
+                      "multi-device channel cells need current jax or "
+                      "silicon — degenerate 1x1 proofs below still run"})
+    for grid in grids:
+        dims_of = {"zero": (grid[0] * 16 + 5, grid[1] * 16 + 3),
+                   "periodic": (grid[0] * 16, grid[1] * 16)}
+        for boundary in ("zero", "periodic"):
+            for fuse in fuses:
+                for cm in ("packed", "strided"):
+                    try:
+                        want, got = _kernel_tiers(
+                            filt, fuse, grid, boundary, dims_of[boundary],
+                            col_mode=cm)
+                        row = {
+                            "ab": "channels", "kernel": "monolithic",
+                            "grid": f"{grid[0]}x{grid[1]}",
+                            "boundary": boundary, "fuse": fuse,
+                            "col_mode": cm,
+                            "oracle_bytes_ok": bool(np.array_equal(
+                                got["partitioned"], want)),
+                            "matches_serialized": bool(
+                                np.array_equal(got["partitioned"],
+                                               got["serialized"])
+                                and np.array_equal(got["overlap"],
+                                                   got["serialized"])),
+                        }
+                    except Exception as e:  # noqa: BLE001 — cell is data
+                        row = {"ab": "channels", "kernel": "monolithic",
+                               "grid": f"{grid[0]}x{grid[1]}",
+                               "boundary": boundary, "fuse": fuse,
+                               "col_mode": cm, "error": repr(e)[:200]}
+                    rows.append(row)
+        # Tiled kernel: one forced cell per col_mode (multi-window grid;
+        # dims SCALE with the grid so every per-device block clears the
+        # tiled kernel's (sublane, 128) minimum).
+        for cm in ("packed", "strided"):
+            try:
+                want, got = _kernel_tiers(
+                    filt, 2, grid, "zero", (grid[0] * 96, grid[1] * 384),
+                    col_mode=cm, tiled=True, tile=(32, 128))
+                row = {
+                    "ab": "channels", "kernel": "tiled",
+                    "grid": f"{grid[0]}x{grid[1]}", "boundary": "zero",
+                    "fuse": 2, "col_mode": cm,
+                    "oracle_bytes_ok": bool(np.array_equal(
+                        got["partitioned"], want)),
+                    "matches_serialized": bool(
+                        np.array_equal(got["partitioned"],
+                                       got["serialized"])
+                        and np.array_equal(got["overlap"],
+                                           got["serialized"])),
+                }
+            except Exception as e:  # noqa: BLE001
+                row = {"ab": "channels", "kernel": "tiled",
+                       "grid": f"{grid[0]}x{grid[1]}", "boundary": "zero",
+                       "fuse": 2, "col_mode": cm, "error": repr(e)[:200]}
+            rows.append(row)
+    return rows
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--size", type=int, default=256,
@@ -147,15 +276,26 @@ def main() -> int:
                     help="add the overlap on/off A/B column (per fuse: "
                          "serialized RDMA vs interior-first overlapped "
                          "RDMA, byte-checked cell by cell)")
+    ap.add_argument("--channels", action="store_true",
+                    help="add the channels column (round 16): "
+                         "persistent+partitioned per-slab completion vs "
+                         "the r12 phase-granular overlap vs serialized, "
+                         "x {packed, strided} column transport — oracle "
+                         "byte-check every cell, both kernels, both "
+                         "boundaries; multi-device cells are typed "
+                         "capability skips without the faithful "
+                         "interpreter, the degenerate 1x1 proofs always "
+                         "run")
     ap.add_argument("--out", default=None,
                     help="also write the summary row to this JSON file "
                          "(the --overlap-smoke leg's done_file)")
     args = ap.parse_args()
 
-    if args.overlap:
-        # The overlap column must compile the overlapped PROGRAM even on
-        # a CPU mesh (where dispatch force-serializes by default): this
-        # harness exists to prove bytes, the env is the documented hatch.
+    if args.overlap or args.channels:
+        # The overlap/channels columns must compile the overlapped
+        # PROGRAM even on a CPU mesh (where dispatch force-serializes by
+        # default): this harness exists to prove bytes, the env is the
+        # documented hatch.
         os.environ.setdefault("PCTPU_OVERLAP_INTERPRET", "1")
 
     from parallel_convolution_tpu.utils.platform import (
@@ -245,6 +385,12 @@ def main() -> int:
         for p in proofs:
             rows.append(p)
             print(json.dumps(p), flush=True)
+    if args.channels:
+        mesh_shape = tuple(int(v) for v in mesh.devices.shape)
+        for p in channels_proofs(filt, [f for f in fuses if f <= 4] or [1],
+                                 mesh_shape, rdma_capable):
+            rows.append(p)
+            print(json.dumps(p), flush=True)
 
     by_fuse = {}
     for r_ in rows:
@@ -262,6 +408,7 @@ def main() -> int:
     overlap_proofs = [r_ for r_ in completed
                       if r_.get("ab") == "overlap_degenerate"
                       or r_.get("path") == "rdma+overlap"]
+    channel_proofs = [r_ for r_ in completed if r_.get("ab") == "channels"]
     summary = {
         "probe": "rdma_fuse_ab",
         "workload": f"blur3 {args.size}x{args.size} {args.iters} iters, "
@@ -279,6 +426,8 @@ def main() -> int:
         # feature gap; the degenerate proofs above still ran).
         "failures": len(mismatches) + len(errors),
         "overlap_proofs": len(overlap_proofs),
+        "channels_ab": bool(args.channels),
+        "channel_proofs": len(channel_proofs),
     }
     for fuse, d in sorted(by_fuse.items()):
         if "rdma" in d and "ppermute" in d and d["rdma"].get("wall_s"):
@@ -302,6 +451,8 @@ def main() -> int:
     ok = summary["bytes_ok_all"] and summary["failures"] == 0
     if args.overlap:
         ok = ok and summary["overlap_proofs"] > 0
+    if args.channels:
+        ok = ok and summary["channel_proofs"] > 0
     return 0 if ok else 1
 
 

@@ -89,6 +89,25 @@
 #                                      byte-equality gate) lands in
 #                                      evidence/overlap_smoke.json (the
 #                                      supervisor leg's done_file).
+#   scripts/run_t1.sh --channels-smoke persistent/partitioned halo channels
+#                                      (round 16) on the 2x4 CPU mesh:
+#                                      byte-identity across {serialized,
+#                                      r12 overlap, persistent+partitioned}
+#                                      x {packed, strided} (degenerate 1x1
+#                                      proofs always; multi-device cells
+#                                      typed capability skips without the
+#                                      faithful interpreter), channel-plan
+#                                      build counter flat across a fused
+#                                      converge run and a V-cycle level
+#                                      schedule (descriptors bound once
+#                                      per exchange identity), col_mode
+#                                      auto-resolution + bench-row
+#                                      stamping, and the summary row
+#                                      folded through perf_gate.py against
+#                                      the smoke's own history.  Row
+#                                      (failures: 0) lands in
+#                                      evidence/channels_smoke.json (the
+#                                      supervisor leg's done_file).
 #   scripts/run_t1.sh --elastic-smoke  reshape round-trip on the CPU mesh:
 #                                      crash a checkpointed run on 2x4,
 #                                      resume the snapshot on 1x2 / 2x2 /
@@ -120,6 +139,13 @@ if [ "${1:-}" = "--overlap-smoke" ]; then
     XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python scripts/rdma_fuse_ab.py --overlap --size 64 --iters 4 \
       --reps 1 --fuse 1,2,4 --mesh 2x4 --out evidence/overlap_smoke.json
+fi
+
+if [ "${1:-}" = "--channels-smoke" ]; then
+  exec timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python scripts/channels_smoke.py --rows 48 --cols 64 --mesh 2x4 \
+      --out evidence/channels_smoke.json
 fi
 
 if [ "${1:-}" = "--elastic-smoke" ]; then

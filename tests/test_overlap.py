@@ -366,8 +366,12 @@ def test_predict_overlap_is_max_not_sum():
                                      radius, shape) / (hw.hbm_gbps * 1e9)
     t_flop = cm.flops_per_px_iter(k, False, True, fuse, block,
                                   radius) / (hw.flop_gops * 1e9)
+    # The RDMA tier binds persistent channels (round 16): its exchange
+    # term zeroes the per-phase setup and prices the packed column
+    # transport — recompute the SAME term predict uses.
     t_ex = cm.exchange_seconds_per_px_iter(grid, block, radius, fuse,
-                                           storage, hw)
+                                           storage, hw, persistent=True,
+                                           col_mode="packed")
     assert t_ex > 0
     serial = cm.predict_seconds_per_px_iter(
         backend, storage, fuse, tile, shape, block, grid, k, False, True,
