@@ -69,7 +69,11 @@ KINDS = frozenset({
     "serve",               # service lifecycle (boot, close)
     "router",              # replica-set router: failover, spill, replica
     #                        ready-state flip, tenant-quota shed,
-    #                        kill/revive (round 14)
+    #                        kill/revive (round 14); replica add/remove/
+    #                        ring-join (round 17 pool mutation)
+    "autoscale",           # fleet control loop: scale decision + the
+    #                        signals that drove it, pre-warm report,
+    #                        drain report (round 17)
     "span",                # one closed trace span (obs.trace): trace_id/
     #                        span_id/parent_id + start_ts/dur_s/links
 })

@@ -29,14 +29,30 @@ stack, none of which duplicate compute code:
                  health, bounded-load spill, idempotent failover with
                  request_id dedup, per-tenant token-bucket admission,
                  and progressive-result streaming for convergence jobs.
+                 Round 17 adds pool MUTATION (add/join/remove with
+                 drain) and the key-config observatory that feeds warm
+                 placement.
+``pricing.py``   cost-priced admission (round 17): one wire request's
+                 predicted device-seconds from the tuning cost model —
+                 the work units tenant buckets are charged, so a huge
+                 multigrid job pays its real price and thumbnail blurs
+                 keep their latency floor.
+``autoscaler.py``the fleet control loop (round 17): scale the replica
+                 count from queue-depth/latency/health signals with
+                 hysteresis + cooldown, pre-warming a joining replica's
+                 ring shard before its vnodes take traffic and draining
+                 leavers through the ring-remove path.
 
 CLI surfaces: ``scripts/serve.py`` (boot one replica's HTTP server),
-``scripts/router.py`` (boot the router over N replicas), and
-``scripts/loadgen.py`` (closed/open-loop load generator emitting
-p50/p95/p99 + phase-breakdown rows in the bench-row schema).
+``scripts/router.py`` (boot the router over N replicas, optionally
+autoscaled), and ``scripts/loadgen.py`` (closed/open-loop load
+generator emitting p50/p95/p99 + phase-breakdown rows in the bench-row
+schema; ``--rps``/``--duration-s`` is the sustained-load harness).
 """
 
+from parallel_convolution_tpu.serving.autoscaler import AutoScaler
 from parallel_convolution_tpu.serving.engine import EngineKey, WarmEngine
+from parallel_convolution_tpu.serving.pricing import WorkPricer
 from parallel_convolution_tpu.serving.router import (
     HTTPReplica, InProcessReplica, ReplicaRouter, TenantQuotas,
 )
@@ -45,7 +61,7 @@ from parallel_convolution_tpu.serving.service import (
 )
 
 __all__ = [
-    "ConvolutionService", "EngineKey", "HTTPReplica", "InProcessReplica",
-    "Rejected", "ReplicaRouter", "Request", "Response", "Snapshot",
-    "TenantQuotas", "WarmEngine",
+    "AutoScaler", "ConvolutionService", "EngineKey", "HTTPReplica",
+    "InProcessReplica", "Rejected", "ReplicaRouter", "Request", "Response",
+    "Snapshot", "TenantQuotas", "WarmEngine", "WorkPricer",
 ]

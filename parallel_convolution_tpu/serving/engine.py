@@ -152,7 +152,7 @@ class _Entry:
     __slots__ = ("key", "effective_backend", "fns", "lock", "plan_source",
                  "predicted_gpx", "plan_key", "effective_overlap",
                  "effective_col_mode", "splits", "compile_ref",
-                 "converge_fns", "mg_levels")
+                 "converge_fns", "mg_levels", "compiles")
 
     def __init__(self, key: EngineKey, effective_backend: str,
                  plan_source: str = "explicit",
@@ -187,6 +187,12 @@ class _Entry:
         #                                    first converge stream; the
         #                                    post-resolution stamp rows
         #                                    carry — never the cap)
+        self.compiles = 0   # executables built FOR THIS KEY (batch sizes
+        #                     + converge chunks) — the per-shard compile
+        #                     ledger the warm-placement gate reads: a
+        #                     pre-warmed joining replica's shard keys
+        #                     must hold this flat through the remapped
+        #                     traffic that follows ring join.
         self.fns: dict[int, object] = {}   # batch size -> jitted runner
         self.converge_fns: dict[int, object] = {}  # chunk length n ->
         #                                    jitted convergence chunk
@@ -533,6 +539,7 @@ class WarmEngine:
 
             jax.block_until_ready(fn(xs))
             entry.fns[batch] = fn
+            entry.compiles += 1
             with self._lock:
                 self.stats["compiles"] += 1
             return fn
@@ -717,6 +724,7 @@ class WarmEngine:
             jax.block_until_ready(fn(xs)[1])  # compile NOW: the stream's
             #                                   first chunk must not pay it
             entry.converge_fns[n] = fn
+            entry.compiles += 1
             with self._lock:
                 self.stats["compiles"] += 1
             return fn
@@ -801,6 +809,12 @@ class WarmEngine:
                               .astype(jnp.float32)), done, diff, float(done))
 
     # -- introspection ------------------------------------------------------
+    def warm_key_count(self) -> int:
+        """Resident warm keys (the ``/readyz`` payload's ``warm_keys``
+        — one of the autoscaler's placement signals)."""
+        with self._lock:
+            return len(self._entries)
+
     def degraded(self) -> list[dict]:
         """Distinct requested→effective backend downgrades among resident
         entries — the 'current degrade tier' surface ``/readyz`` reports
@@ -836,7 +850,11 @@ class WarmEngine:
                      "col_mode": e.effective_col_mode,
                      "plan_source": e.plan_source,
                      "predicted_gpx_per_chip": e.predicted_gpx,
-                     "batch_sizes": sorted(e.fns)}
+                     "batch_sizes": sorted(e.fns),
+                     # Per-key compile ledger (r17): the warm-placement
+                     # gate asserts a pre-warmed shard holds this flat.
+                     "compiles": e.compiles,
+                     "iters": k.iters}
                     for k, e in self._entries.items()
                 ],
             }

@@ -31,7 +31,10 @@ returns the READINESS verdict — 200 only when the service can usefully
 take a new request (503 while a mesh reshape is in progress or the
 admission queue is at its bound; the current degrade tier rides in the
 payload) — the probe surface the ROADMAP-item-2 replica router keys on.
-``GET /stats`` returns the snapshot alone; ``GET /metrics`` serves the
+``GET /stats`` returns the snapshot alone; ``POST /v1/warm``
+(``{"configs": [...]}``) pre-compiles declared configs — the
+warm-placement surface a joining replica is driven through before its
+ring vnodes take traffic (round 17); ``GET /metrics`` serves the
 process-global obs registry in Prometheus text exposition format 0.0.4
 (round 11 — the pull endpoint the stack never had; with ``PCTPU_OBS=0``
 it serves a comment noting obs is disabled, still a valid exposition).
@@ -385,6 +388,19 @@ class InProcessClient:
             sp.set(status=200)
         return 200, (encode_stream_row(row) for row in result)
 
+    def warm(self, configs) -> tuple[int, dict]:
+        """Pre-compile declared configs (the warm-placement surface: a
+        JOINING replica inherits its ring shard's executables BEFORE
+        taking traffic).  ``configs`` are the ``service.warmup`` dicts;
+        a bad config is a typed 400, never a half-warmed crash."""
+        try:
+            effective = self.service.warmup(list(configs or ()))
+        except Exception as e:  # noqa: BLE001 — typed contract errors
+            return 400, {"ok": False, "rejected": "invalid",
+                         "detail": f"warmup failed: {e}"[:300]}
+        return 200, {"ok": True, "warmed": len(effective),
+                     "effective_backends": effective}
+
     def healthz(self) -> tuple[int, dict]:
         return 200, {"ok": True, **self.service.snapshot()}
 
@@ -447,7 +463,8 @@ def make_http_server(service: ConvolutionService, host: str = "127.0.0.1",
                 self._send(404, {"ok": False, "detail": "unknown path"})
 
         def do_POST(self):  # noqa: N802 — http.server API
-            if self.path not in ("/v1/convolve", "/v1/converge"):
+            if self.path not in ("/v1/convolve", "/v1/converge",
+                                 "/v1/warm"):
                 # Drain the body first: under HTTP/1.1 keep-alive an
                 # unread body would be parsed as the NEXT request line.
                 drain_body(self)
@@ -461,6 +478,9 @@ def make_http_server(service: ConvolutionService, host: str = "127.0.0.1",
             except (ValueError, json.JSONDecodeError) as e:
                 self._send(400, {"ok": False, "rejected": "invalid",
                                  "detail": f"bad JSON body: {e}"})
+                return
+            if self.path == "/v1/warm":
+                self._send(*client.warm(body.get("configs") or []))
                 return
             # Tenant identity: the transport header wins over the body
             # field (the router's QoS key rides either).

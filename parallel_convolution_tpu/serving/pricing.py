@@ -1,0 +1,142 @@
+"""Cost-priced admission: what one wire request COSTS, before it runs.
+
+Round 14's tenant QoS charged every request ONE token — so an 8192²
+multigrid converge job and a 48×64 thumbnail blur drew the same quota,
+and one greedy tenant submitting big jobs could consume a thousand small
+requests' worth of device time while staying inside a request-count
+budget.  This module prices admission in the cost model's own currency:
+**predicted device-seconds** (``tuning.costmodel`` — the same roofline
+that ranks backends), so a tenant bucket's refill rate becomes a share
+of MACHINE TIME (``rate=2.0`` = "this tenant may consume two
+device-seconds per wall second"), not a request count.
+
+* Batch requests price as ``predict_seconds_per_px_iter × pixels ×
+  iters / devices`` — linear in the work the device will actually do.
+* Convergence jobs price their ``max_iters`` WORK BUDGET (the bound the
+  stream enforces): jacobi as ``max_iters`` fine-grid sweeps; multigrid
+  through :func:`costmodel.predict_mg_cycle_seconds` — the budget in
+  fine-grid work units divided by one cycle's work units, times one
+  cycle's seconds — so a converge job pays for the V-cycle schedule it
+  will drive, not a flat fee.
+* Accuracy contract is the cost model's own: it RANKS (a big job costs
+  proportionally more than a small one); absolute error is absorbed by
+  the bucket rate knob.  Every price is floored (``min_units``) so
+  free-looking requests still meter, and clamped (``max_units``) so one
+  absurd request cannot poison a bucket beyond recovery.
+
+stdlib + numpy-free + jax-free: prices are pure arithmetic on wire
+fields, cached by the router's ``route_key`` (bounded LRU — the price
+of a config is as stable as its compile identity).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from parallel_convolution_tpu.tuning import costmodel
+
+__all__ = ["WorkPricer"]
+
+
+class WorkPricer:
+    """Predicted device-seconds for one wire-format request.
+
+    ``grid``/``platform``/``device_kind`` describe the replicas the
+    router fronts (the pricer lives router-side, which has no mesh);
+    they shape the exchange/roofline terms only — pricing is RELATIVE,
+    so a router fronting heterogeneous replicas still meters fairly as
+    long as one model prices every request.
+    """
+
+    def __init__(self, grid: tuple[int, int] = (1, 1),
+                 platform: str = "cpu", device_kind: str = "", *,
+                 min_units: float = 1e-4, max_units: float = 600.0,
+                 cache_size: int = 512):
+        self.grid = (max(1, int(grid[0])), max(1, int(grid[1])))
+        self.hw = costmodel.hardware_for(platform, device_kind)
+        self.min_units = float(min_units)
+        self.max_units = float(max_units)
+        self._cache: OrderedDict[tuple, float] = OrderedDict()
+        self._cache_size = max(16, int(cache_size))
+        self._lock = threading.Lock()
+
+    # -- the public surface ---------------------------------------------------
+    def price(self, body: dict, converge: bool = False) -> float:
+        """Work units (predicted device-seconds) one request will cost.
+
+        Never raises: a malformed body prices at the floor — admission
+        pricing must not pre-empt the typed ``invalid`` rejection the
+        replica owns (charging garbage the minimum keeps the quota path
+        orthogonal to validation).
+        """
+        try:
+            ck = self._cache_key(body, converge)
+            with self._lock:
+                units = self._cache.get(ck)
+                if units is not None:
+                    self._cache.move_to_end(ck)
+                    return units
+            units = self._clamp(self._price_uncached(body, converge))
+            with self._lock:
+                self._cache[ck] = units
+                while len(self._cache) > self._cache_size:
+                    self._cache.popitem(last=False)
+            return units
+        except Exception:  # noqa: BLE001 — never pre-empt typed invalid
+            return self.min_units
+
+    # -- internals ------------------------------------------------------------
+    def _clamp(self, units: float) -> float:
+        return max(self.min_units, min(self.max_units, float(units)))
+
+    @staticmethod
+    def _cache_key(body: dict, converge: bool) -> tuple:
+        fields = ("rows", "cols", "mode", "filter", "iters", "backend",
+                  "storage", "fuse", "boundary", "quantize", "solver",
+                  "max_iters", "mg_levels")
+        return (converge,) + tuple(repr(body.get(k)) for k in fields)
+
+    def _price_uncached(self, body: dict, converge: bool) -> float:
+        from parallel_convolution_tpu.ops.filters import get_filter
+
+        rows = max(1, int(body.get("rows", 1)))
+        cols = max(1, int(body.get("cols", 1)))
+        channels = 3 if body.get("mode") == "rgb" else 1
+        filt = get_filter(str(body.get("filter") or "blur3"))
+        storage = str(body.get("storage") or "f32")
+        if storage not in costmodel.STORAGE_BYTES:
+            storage = "f32"
+        quantize = bool(body.get("quantize", not converge))
+        backend = str(body.get("backend") or "shifted")
+        if backend == "auto":
+            # Pricing needs no plan resolution: the normative compiled
+            # tier is a fair stand-in, and relative cost is what meters.
+            backend = "shifted"
+        try:
+            fuse = max(1, int(body.get("fuse") or 1))
+        except (TypeError, ValueError):
+            fuse = 1
+        R, Q = self.grid
+        shape = (channels, rows, cols)
+        block_hw = (max(1, -(-rows // R)), max(1, -(-cols // Q)))
+        n_dev = R * Q
+        px = channels * rows * cols
+
+        if converge and str(body.get("solver") or "jacobi") == "multigrid":
+            max_iters = max(1, int(body.get("max_iters", 500)))
+            levels = body.get("mg_levels")
+            cycle_s, wu_per_cycle = costmodel.predict_mg_cycle_seconds(
+                shape, self.grid, filt.size, "f32", False, self.hw,
+                levels=(None if levels is None else int(levels)),
+                backend=backend)
+            # max_iters bounds FINE-GRID WORK UNITS (the stream's own
+            # budget semantics) — the job runs at most this many cycles.
+            cycles = max(1.0, max_iters / max(wu_per_cycle, 1e-9))
+            return cycles * cycle_s / n_dev
+        iters = (max(1, int(body.get("max_iters", 500))) if converge
+                 else max(1, int(body.get("iters", 1))))
+        spp = costmodel.predict_seconds_per_px_iter(
+            backend, storage, fuse, None, shape, block_hw, self.grid,
+            filt.size, filt.separable() is not None, quantize, self.hw)
+        return spp * px * iters / n_dev

@@ -80,10 +80,15 @@ __all__ = [
 
 # Every wire field that lands in the replica's EngineKey (the compile
 # identity).  Image CONTENT is deliberately absent: equal configs share
-# one warm executable, so they must share one home replica.
+# one warm executable, so they must share one home replica.  r17 adds
+# col_mode/solver/mg_levels — they land in the EngineKey too (r15/r16),
+# and the warm-placement observatory replays exactly these fields, so a
+# field missing here would make a joining replica pre-warm the WRONG
+# program for requests that set it.
 ROUTE_KEY_FIELDS = ("rows", "cols", "mode", "filter", "iters", "backend",
                     "storage", "fuse", "boundary", "quantize", "overlap",
-                    "tile", "check_every")
+                    "tile", "check_every", "col_mode", "solver",
+                    "mg_levels")
 
 
 def route_key(body: dict) -> str:
@@ -106,8 +111,13 @@ class HashRing:
             raise ValueError("vnodes >= 1 required")
         self.vnodes = int(vnodes)
         self._names: set[str] = set()
-        self._points: list[int] = []
-        self._owners: list[str] = []
+        # (points, owners, distinct-member count) swapped as ONE tuple
+        # so a concurrent reader (the dispatch path, while the
+        # autoscaler joins/leaves a member) can never see a half-rebuilt
+        # table; the count rides along so the hot path stays O(1) on it.
+        self._table: tuple[tuple[int, ...], tuple[str, ...], int] = (
+            (), (), 0)
+        self._mutate = threading.Lock()
         for n in names:
             self.add(n)
 
@@ -119,34 +129,39 @@ class HashRing:
         pairs = sorted(
             (self._hash(f"{name}#{i}"), name)
             for name in self._names for i in range(self.vnodes))
-        self._points = [p for p, _ in pairs]
-        self._owners = [n for _, n in pairs]
+        self._table = (tuple(p for p, _ in pairs),
+                       tuple(n for _, n in pairs),
+                       len(self._names))
 
     def add(self, name: str) -> None:
-        self._names.add(str(name))
-        self._rebuild()
+        with self._mutate:
+            self._names.add(str(name))
+            self._rebuild()
 
     def remove(self, name: str) -> None:
-        self._names.discard(str(name))
-        self._rebuild()
+        with self._mutate:
+            self._names.discard(str(name))
+            self._rebuild()
 
     def members(self) -> list[str]:
-        return sorted(self._names)
+        with self._mutate:
+            return sorted(self._names)
 
     def candidates(self, key: str) -> list[str]:
         """All members in ring order from ``key``'s point (home first)."""
-        if not self._points:
+        points, owners, distinct = self._table
+        if not points:
             return []
         out: list[str] = []
         seen: set[str] = set()
-        start = bisect.bisect_left(self._points, self._hash(key))
-        n = len(self._owners)
+        start = bisect.bisect_left(points, self._hash(key))
+        n = len(owners)
         for i in range(n):
-            owner = self._owners[(start + i) % n]
+            owner = owners[(start + i) % n]
             if owner not in seen:
                 seen.add(owner)
                 out.append(owner)
-                if len(seen) == len(self._names):
+                if len(seen) == distinct:
                     break
         return out
 
@@ -159,7 +174,12 @@ class TokenBucket:
 
     def __init__(self, rate: float, burst: float, clock=time.monotonic):
         self.rate = float(rate)
-        self.burst = max(1.0, float(burst))
+        # Burst must only be POSITIVE, not >= 1: under cost-priced
+        # admission a bucket's unit is predicted device-seconds, and a
+        # tenant's whole budget can legitimately be a fraction of one —
+        # the old 1.0 floor silently re-minted such buckets 30x larger
+        # (caught live by the greedy-tenant drill).
+        self.burst = max(1e-9, float(burst))
         self._clock = clock
         self._tokens = self.burst
         self._last = clock()
@@ -173,16 +193,25 @@ class TokenBucket:
 
     def try_take(self, n: float = 1.0) -> tuple[bool, float]:
         """(granted, retry_after_s).  On refusal, ``retry_after_s`` is the
-        exact wall time until the bucket holds ``n`` tokens again."""
+        exact wall time until the bucket can grant ``n`` again.
+
+        A charge larger than the burst is granted once the bucket is
+        FULL and drives the balance NEGATIVE (debt): with cost-priced
+        admission one legitimate big job can cost more than the burst,
+        and refusing it forever would make ``burst`` a silent per-job
+        size cap instead of a smoothing window.  The debt refills at
+        ``rate`` like any other deficit, so long-run fairness is
+        untouched — the tenant just waits out its own big job."""
         if self.rate <= 0:
             return True, 0.0
+        need = min(float(n), self.burst)
         with self._lock:
             now = self._clock()
             self._refill(now)
-            if self._tokens >= n:
-                self._tokens -= n
+            if self._tokens >= need:
+                self._tokens -= float(n)
                 return True, 0.0
-            return False, (n - self._tokens) / self.rate
+            return False, (need - self._tokens) / self.rate
 
     def refund(self, n: float = 1.0) -> None:
         if self.rate <= 0:
@@ -239,11 +268,14 @@ class TenantQuotas:
                 self._buckets.pop(victim)
             return b
 
-    def take(self, tenant: str) -> tuple[bool, float]:
-        return self.bucket(tenant).try_take()
+    def take(self, tenant: str, n: float = 1.0) -> tuple[bool, float]:
+        """Charge ``n`` work units (cost-priced admission passes the
+        request's predicted device-seconds; the legacy request-count
+        scheme is the degenerate ``n=1``)."""
+        return self.bucket(tenant).try_take(n)
 
-    def refund(self, tenant: str) -> None:
-        self.bucket(tenant).refund()
+    def refund(self, tenant: str, n: float = 1.0) -> None:
+        self.bucket(tenant).refund(n)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -288,6 +320,11 @@ class InProcessReplica:
 
     def readyz(self):
         return self._live().readyz()
+
+    def warm(self, configs) -> tuple[int, dict]:
+        """Pre-compile declared configs on the live service (the
+        warm-placement surface the autoscaler drives BEFORE ring join)."""
+        return self._live().warm(configs)
 
     def snapshot(self) -> dict:
         return self._live().stats()[1]
@@ -413,6 +450,18 @@ class HTTPReplica:
     def readyz(self):
         return self._get("/readyz", timeout=self.probe_timeout)
 
+    def warm(self, configs) -> tuple[int, dict]:
+        """POST /v1/warm — pre-compile declared configs (warm placement
+        over the wire; compiles can take a while, so no probe budget)."""
+        resp = self._post("/v1/warm", {"configs": list(configs or ())},
+                          None, None)
+        with resp if hasattr(resp, "__enter__") else _closing(resp) as r:
+            status = getattr(r, "status", None) or r.code
+            try:
+                return status, json.loads(r.read())
+            except ValueError:
+                return status, {"ok": False, "detail": f"http {status}"}
+
     def snapshot(self) -> dict:
         return self._get("/stats")[1]
 
@@ -478,6 +527,7 @@ class ReplicaRouter:
     """
 
     def __init__(self, replicas, *, quotas: TenantQuotas | None = None,
+                 pricer=None,
                  vnodes: int = 64, breaker_threshold: int = 3,
                  breaker_cooldown_s: float = 1.0,
                  poll_interval_s: float = 0.25, load_factor: float = 2.0,
@@ -488,6 +538,8 @@ class ReplicaRouter:
         names = [r.name for r in replicas]
         if len(set(names)) != len(names):
             raise ValueError(f"replica names must be unique, got {names}")
+        self._clock = clock
+        self.breaker_threshold = int(breaker_threshold)
         self._replicas = {
             r.name: _ReplicaState(
                 r, CircuitBreaker(breaker_threshold, breaker_cooldown_s,
@@ -495,12 +547,28 @@ class ReplicaRouter:
             for r in replicas}
         self.ring = HashRing(names, vnodes=vnodes)
         self.quotas = quotas
+        # Cost-priced admission (serving.pricing.WorkPricer): when armed,
+        # tenant buckets are charged the request's predicted
+        # device-seconds instead of 1 — an 8192² multigrid job pays its
+        # real price and a thumbnail blur stays almost free.
+        self.pricer = pricer
         self.load_factor = float(load_factor)
         self.hedge_s = hedge_s
         self.poll_interval_s = float(poll_interval_s)
         self.breaker_cooldown_s = float(breaker_cooldown_s)
         self._ids = itertools.count(1)
         self._lock = threading.Lock()
+        # The key-config observatory: route_key -> the wire CONFIG fields
+        # last seen for it (never image content).  This is the warm-
+        # placement input — a JOINING replica pre-warms exactly the
+        # configs whose consistent-hash home it is about to become
+        # (shard_configs), before its vnodes enter the ring.  Bounded
+        # FIFO; batch-path configs only (a converge job's warm state is
+        # its chunk/level programs, which the first job re-warms).
+        from collections import OrderedDict
+
+        self._key_configs: "OrderedDict[str, dict]" = OrderedDict()
+        self._key_configs_cap = 512
         self.stats = obs_metrics.MirroredStats(obs_metrics.gauge(
             "pctpu_router_stats", "replica-router admission/outcome counters",
             ("key",)), initial={
@@ -547,12 +615,20 @@ class ReplicaRouter:
         with self._lock:
             self.stats[key] += n
 
-    def _tenant_admit(self, tenant: str, rid: str, trace_id: str):
-        """None when admitted; the (status, wire) shed otherwise."""
+    def _tenant_admit(self, tenant: str, rid: str, trace_id: str,
+                      cost: float = 1.0):
+        """None when admitted; the (status, wire) shed otherwise.
+        ``cost`` is the work-unit charge (predicted device-seconds with
+        a pricer armed; 1.0 in the legacy request-count scheme)."""
         if self.quotas is None:
             return None
-        ok, retry_after = self.quotas.take(tenant)
+        ok, retry_after = self.quotas.take(tenant, cost)
         if ok:
+            if self.pricer is not None and obs_metrics.enabled():
+                obs_metrics.counter(
+                    "pctpu_router_work_units_total",
+                    "work units (predicted device-seconds) charged at "
+                    "admission", ("tenant",)).inc(cost, tenant=tenant)
             return None
         self._bump("rejected_tenant_quota")
         if obs_metrics.enabled():
@@ -561,15 +637,26 @@ class ReplicaRouter:
                 "tenant-bucket admission sheds", ("tenant",)).inc(
                 tenant=tenant)
             obs_events.emit("router", event="tenant_quota", tenant=tenant,
-                            request_id=rid,
+                            request_id=rid, cost_units=round(cost, 6),
                             retry_after_s=round(retry_after, 4))
         return 429, {
             "ok": False, "rejected": "tenant_quota", "retryable": True,
             "retry_after_s": round(retry_after, 4), "tenant": tenant,
+            "cost_units": round(cost, 6),
             "request_id": rid, "trace_id": trace_id,
             "detail": f"tenant {tenant!r} bucket empty; refills at "
-                      f"{self.quotas.bucket(tenant).rate}/s",
+                      f"{self.quotas.bucket(tenant).rate}/s "
+                      f"(this request costs {cost:.4g} units)",
         }
+
+    def _observe_config(self, key: str, body: dict) -> None:
+        """Record a route_key's wire CONFIG (warm-placement input)."""
+        cfg = {k: body[k] for k in ROUTE_KEY_FIELDS if k in body}
+        with self._lock:
+            self._key_configs[key] = cfg
+            self._key_configs.move_to_end(key)
+            while len(self._key_configs) > self._key_configs_cap:
+                self._key_configs.popitem(last=False)
 
     # -- dispatch -------------------------------------------------------------
     def _load_bound(self) -> int:
@@ -677,7 +764,9 @@ class ReplicaRouter:
                 break
             bound = self._load_bound()
             for name in order:
-                rep = self._replicas[name]
+                rep = self._replicas.get(name)
+                if rep is None:   # removed while this walk was underway
+                    continue
                 if not relaxed:
                     if not rep.ready or rep.in_flight >= bound:
                         meta["spills"] += 1
@@ -737,9 +826,11 @@ class ReplicaRouter:
         tenant = str(tenant or body.get("tenant") or "default")
         body["tenant"] = tenant
         self._bump("routed")
+        cost = (self.pricer.price(body)
+                if self.pricer is not None else 1.0)
         with obs_trace.span("route", request_id=rid, tenant=tenant) as sp:
             tid = sp.context.trace_id if sp.context is not None else ""
-            shed = self._tenant_admit(tenant, rid, tid)
+            shed = self._tenant_admit(tenant, rid, tid, cost)
             if shed is not None:
                 sp.set(outcome="tenant_quota")
                 status, wire = shed
@@ -747,6 +838,7 @@ class ReplicaRouter:
                                   "failovers": 0, "spills": 0}
                 return status, wire
             key = route_key(body)
+            self._observe_config(key, body)
             sp.set(key=key)
             if self.hedge_s is not None:
                 status, wire, meta = self._dispatch_hedged(
@@ -760,8 +852,12 @@ class ReplicaRouter:
                 self._bump("completed")
             elif (self.quotas is not None
                   and wire.get("rejected") in _REFUND_REJECTS):
-                self.quotas.refund(tenant)
+                # Refund the SAME charge admission took: with a pricer
+                # armed that is the request's work units, not 1.
+                self.quotas.refund(tenant, cost)
             wire.setdefault("router", meta)
+            if self.pricer is not None:
+                wire["router"].setdefault("cost_units", round(cost, 6))
             return status, wire
 
     def _dispatch_hedged(self, key: str, body: dict, timeout, sp):
@@ -818,10 +914,12 @@ class ReplicaRouter:
         body["tenant"] = tenant
         self._bump("routed")
         self._bump("progressive")
+        cost = (self.pricer.price(body, converge=True)
+                if self.pricer is not None else 1.0)
         with obs_trace.span("route", request_id=rid, tenant=tenant,
                             progressive=True) as sp:
             tid = sp.context.trace_id if sp.context is not None else ""
-            shed = self._tenant_admit(tenant, rid, tid)
+            shed = self._tenant_admit(tenant, rid, tid, cost)
             if shed is not None:
                 sp.set(outcome="tenant_quota")
                 status, wire = shed
@@ -842,7 +940,9 @@ class ReplicaRouter:
                     break
                 bound = self._load_bound()
                 for name in order:
-                    rep = self._replicas[name]
+                    rep = self._replicas.get(name)
+                    if rep is None:   # removed mid-walk
+                        continue
                     if not relaxed and (not rep.ready
                                         or rep.in_flight >= bound):
                         self._bump("spills")
@@ -915,11 +1015,11 @@ class ReplicaRouter:
                 wire = last[1][0] if last[1] else {}
                 if (self.quotas is not None
                         and wire.get("rejected") in _REFUND_REJECTS):
-                    self.quotas.refund(tenant)
+                    self.quotas.refund(tenant, cost)
                 return last[0], iter(last[1])
             self._bump("rejected_unavailable")
             if self.quotas is not None:
-                self.quotas.refund(tenant)
+                self.quotas.refund(tenant, cost)
             return 503, iter([{
                 "kind": "rejected", "ok": False,
                 "rejected": "replica_unavailable", "retryable": True,
@@ -955,6 +1055,106 @@ class ReplicaRouter:
         finally:
             release()
 
+    # -- pool mutation (autoscaling) ------------------------------------------
+    def add_replica(self, transport, join_ring: bool = True) -> None:
+        """Register a NEW replica (unique ``transport.name``).
+
+        With ``join_ring=False`` the replica is registered (health-
+        polled, dispatchable as a relaxed-pass fallback via nothing —
+        it owns no ring span) but receives no routed traffic until
+        :meth:`join_ring`: the autoscaler's warm-placement window sits
+        between the two calls — pre-warm the joining replica's key
+        shard FIRST, then add its vnodes, so the remapped keys land on
+        warm executables instead of a compile storm.
+        """
+        name = str(transport.name)
+        with self._lock:
+            if name in self._replicas:
+                raise ValueError(f"replica {name!r} already registered")
+            rep = _ReplicaState(transport, CircuitBreaker(
+                self.breaker_threshold, self.breaker_cooldown_s,
+                clock=self._clock))
+            # Copy-on-write: concurrent dispatch threads iterate the OLD
+            # dict object; in-place insertion could blow their iterators.
+            self._replicas = {**self._replicas, name: rep}
+        # One immediate active probe: the first routed request must not
+        # ride the optimistic default into a replica that isn't up yet.
+        try:
+            status, payload = rep.transport.readyz()
+            rep.ready, rep.ready_payload = status == 200, payload
+        except Exception as e:  # noqa: BLE001 — a dead newborn
+            rep.ready, rep.ready_payload = False, {"error": repr(e)[:200]}
+        if obs_metrics.enabled():
+            obs_events.emit("router", event="replica_added", replica=name,
+                            in_ring=bool(join_ring))
+        if join_ring:
+            self.join_ring(name)
+
+    def join_ring(self, name: str) -> None:
+        """Add a registered replica's vnodes to the ring (it starts
+        receiving its key shard NOW — pre-warm first)."""
+        if name not in self._replicas:
+            raise KeyError(f"unknown replica {name!r}")
+        self.ring.add(name)
+        if obs_metrics.enabled():
+            obs_events.emit("router", event="ring_join", replica=name)
+
+    def remove_replica(self, name: str, drain_s: float = 10.0,
+                       close: bool = True) -> dict:
+        """Drain one replica out of the pool (the scale-down path).
+
+        Ring removal happens FIRST — new requests route to the
+        remaining members (the same remap-only-the-touched-member
+        property as a kill, but voluntary) — then in-flight work gets
+        ``drain_s`` wall seconds to land (progressive streams count:
+        they hold ``in_flight`` for their whole life).  A request that
+        races the final close surfaces as the usual transport-death
+        failover, i.e. a typed retryable outcome, never a dropped
+        request.  Returns ``{"replica", "drained", "in_flight"}``.
+        """
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is None:
+                raise KeyError(f"unknown replica {name!r}")
+            if len(self._replicas) <= 1:
+                raise ValueError("cannot remove the last replica")
+        self.ring.remove(name)
+        deadline = time.monotonic() + max(0.0, float(drain_s))
+        while rep.in_flight > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        with self._lock:
+            remaining = dict(self._replicas)
+            remaining.pop(name, None)
+            self._replicas = remaining
+        if close:
+            try:
+                rep.transport.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        info = {"replica": name, "drained": rep.in_flight == 0,
+                "in_flight": rep.in_flight}
+        if obs_metrics.enabled():
+            obs_events.emit("router", event="replica_removed", **info)
+        return info
+
+    def shard_configs(self, name: str) -> list[dict]:
+        """The wire configs a replica named ``name`` would become HOME
+        for if it joined the ring now — the pre-warm worklist (from the
+        key-config observatory; config fields only, no image content).
+        """
+        with self._lock:
+            items = list(self._key_configs.items())
+        members = self.ring.members()
+        if name not in members:
+            members = [*members, name]
+        probe = HashRing(members, vnodes=self.ring.vnodes)
+        out = []
+        for key, cfg in items:
+            cands = probe.candidates(key)
+            if cands and cands[0] == name:
+                out.append(dict(cfg))
+        return out
+
     # -- lifecycle / introspection -------------------------------------------
     def readyz(self):
         """(status, payload): 200 iff at least one replica is ready."""
@@ -969,16 +1169,30 @@ class ReplicaRouter:
             "ok": ready, "ready": ready, "replicas": reps}
 
     def snapshot(self) -> dict:
+        members = set(self.ring.members())
         with self._lock:
             stats = dict(self.stats)
-            per = {name: {"ready": rep.ready,
-                          "breaker": rep.breaker.snapshot(),
-                          "in_flight": rep.in_flight, **rep.stats}
-                   for name, rep in self._replicas.items()}
+            per = {}
+            for name, rep in self._replicas.items():
+                payload = rep.ready_payload or {}
+                per[name] = {
+                    "ready": rep.ready,
+                    "breaker": rep.breaker.snapshot(),
+                    "in_flight": rep.in_flight,
+                    # The autoscaler's own inputs, exposed for operators
+                    # and tests alike (from the last /readyz poll):
+                    "queue_depth": payload.get("queue_depth"),
+                    "queue_bound": payload.get("queue_bound"),
+                    "warm_keys": payload.get("warm_keys"),
+                    "degraded": payload.get("degraded") or [],
+                    "in_ring": name in members,
+                    **rep.stats,
+                }
         return {
             "router": stats,
             "replicas": per,
-            "ring": self.ring.members(),
+            "ring": sorted(members),
+            "observed_keys": len(self._key_configs),
             **({"tenants": self.quotas.snapshot()}
                if self.quotas is not None else {}),
         }

@@ -854,6 +854,7 @@ class ConvolutionService:
             channels = 3 if c.get("mode", "grey") == "rgb" else 1
             fuse = c.get("fuse", 1)
             tile = c.get("tile")
+            overlap = c.get("overlap")
             keys.append(self.engine.key_for(
                 (channels, int(c["rows"]), int(c["cols"])),
                 filter_name=c.get("filter", c.get("filter_name", "blur3")),
@@ -863,7 +864,15 @@ class ConvolutionService:
                 tile=None if tile is None else tuple(int(v) for v in tile),
                 boundary=c.get("boundary", "zero"),
                 quantize=bool(c.get("quantize", True)),
-                backend=c.get("backend", "shifted")))
+                backend=c.get("backend", "shifted"),
+                # Knob parity with the request path (resolve_key settles
+                # both pre-keying): a pre-warmed key must be EXACTLY the
+                # key the live request will hit, or warm placement
+                # compiles the wrong program and the join pays a compile
+                # storm anyway.
+                overlap=None if overlap is None else bool(overlap),
+                col_mode=(None if c.get("col_mode") is None
+                          else str(c.get("col_mode")))))
         return self.engine.warmup(keys)
 
     def readiness(self) -> tuple[bool, dict]:
@@ -882,17 +891,26 @@ class ConvolutionService:
         bound = self.batcher.max_queue
         degraded = self.engine.degraded()
         ready = not self._reshaping and depth < bound
+        warm_keys = self.engine.warm_key_count()
         return ready, {
             "ready": ready,
             "reshaping": bool(self._reshaping),
             "queue_depth": depth,
             "queue_bound": bound,
             "queue_full": depth >= bound,
+            # In-flight work the batcher can't see: progressive streams
+            # run on consumer threads — the autoscaler's pressure signal
+            # must count them or converge load never scales the pool.
+            "progressive_active": self._progressive_active,
+            "progressive_bound": self.max_progressive,
+            "warm_keys": warm_keys,
             "degraded": degraded,
             "grid": "x".join(str(v) for v in self.engine.grid()),
         }
 
     def snapshot(self) -> dict:
+        from parallel_convolution_tpu.utils.platform import topology
+
         with self._lock:
             stats = dict(self.stats)
         snap = self.engine.snapshot()
@@ -908,6 +926,11 @@ class ConvolutionService:
                                        self.engine.mesh.shape["y"])),
             "platform": dev.platform,
             "device_kind": getattr(dev, "device_kind", "") or "",
+            # Topology identity (ROADMAP item 1's keying, pulled forward
+            # in r17): loadgen summaries and perf_gate.row_key consume
+            # these so a future multi-host row never shares a baseline
+            # with a single-host one.
+            **topology(self.engine.mesh),
         }
 
     def close(self) -> None:

@@ -31,7 +31,7 @@ from parallel_convolution_tpu.parallel.mesh import (
 from parallel_convolution_tpu.utils.jax_compat import shard_map
 from parallel_convolution_tpu.utils.platform import (
     needs_readback_fence as _needs_readback_fence,
-    timing_mode,
+    timing_mode, topology,
 )
 
 
@@ -332,6 +332,10 @@ def bench_iterate(
         "predicted_gpx_per_chip": round(predicted, 3),
         "mesh": "x".join(str(s) for s in grid),
         "devices": n_dev,
+        # Topology identity (ROADMAP item 1's keying, pulled forward in
+        # r17): perf_gate.row_key keys multi-host rows separately so
+        # they are never judged against single-host baselines.
+        **topology(mesh),
         "wall_s": round(secs, 4),
         "gpixels_per_s": round(gpx, 3),
         "gpixels_per_s_per_chip": round(gpx / n_dev, 3),
@@ -412,6 +416,8 @@ def bench_converge(
         "mesh": "x".join(str(s) for s in grid),
         "devices": mesh.size,
         "tol": float(tol),
+        # Topology identity — same r17 keying rule as bench_iterate.
+        **topology(mesh),
     }
     t0 = time.perf_counter()
     if solver == "multigrid":

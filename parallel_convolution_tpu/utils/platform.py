@@ -223,6 +223,41 @@ def on_tpu() -> bool:
     return device_on_tpu(d)
 
 
+def topology(mesh=None) -> dict:
+    """``{"hosts": N, "slice_topology": "SxD:kind"}`` of this process's
+    accelerator layout — the ROADMAP-item-1 row-keying identity, pulled
+    forward (r17) so perf evidence is stamped BEFORE multi-host meshes
+    exist and future multi-host rows never share a perf_gate baseline
+    with single-host ones.
+
+    ``hosts`` is the process count of the distributed runtime (1 for
+    every single-controller run).  ``slice_topology`` is
+    ``<slices>x<devices-per-slice>:<device_kind>`` derived from the mesh
+    devices' ``slice_index`` (0/absent on CPU and single-slice TPU).
+    Never raises: an uninitialized backend reports the 1-host unknown
+    topology rather than killing a bench row.
+    """
+    try:
+        import jax
+
+        hosts = int(jax.process_count())
+        devs = (list(mesh.devices.flat) if mesh is not None
+                else list(jax.devices()))
+    except Exception:  # noqa: BLE001 — row stamping must never fail
+        return {"hosts": 1, "slice_topology": "1x0:unknown"}
+    if not devs:
+        return {"hosts": hosts, "slice_topology": "1x0:unknown"}
+    slices: dict[int, int] = {}
+    for d in devs:
+        idx = int(getattr(d, "slice_index", 0) or 0)
+        slices[idx] = slices.get(idx, 0) + 1
+    per_slice = max(slices.values())
+    kind = (getattr(devs[0], "device_kind", "") or devs[0].platform
+            or "unknown").replace(" ", "_")
+    return {"hosts": max(1, hosts),
+            "slice_topology": f"{len(slices)}x{per_slice}:{kind}"}
+
+
 def cpu_devices(n: int | None = None) -> list:
     """CPU devices, forcing the platform when nothing initialized yet.
 

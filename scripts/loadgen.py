@@ -53,6 +53,44 @@ def _percentile(sorted_vals: list[float], q: float) -> float | None:
     return sorted_vals[i]
 
 
+def poisson_arrivals(rps: float, fire, *, duration_s: float | None = None,
+                     n: int | None = None, seed: int = 0):
+    """Open-loop POISSON arrival process (the sustained-load harness's
+    one arrival loop — ``scale_smoke.py`` drives its curve steps through
+    this same function so the two can never drift).
+
+    Spawns ``fire(i)`` on a daemon thread at exponential inter-arrival
+    gaps with mean rate ``rps`` (seeded — a rerun offers the same
+    process), until ``duration_s`` wall seconds elapse (when given) else
+    ``n`` arrivals.  Arrivals ignore completions, so a saturated server
+    shows up as latency growth and typed sheds, never a silently
+    reduced offered rate.  Returns ``(issued, threads)`` — the caller
+    joins the threads on its own timeout.
+    """
+    import random
+
+    rng = random.Random(seed)
+    threads: list[threading.Thread] = []
+    t0 = time.perf_counter()
+    deadline = t0 + duration_s if duration_s is not None else None
+    target = t0
+    i = 0
+    while True:
+        if deadline is None and i >= (n or 0):
+            break
+        target += rng.expovariate(rps)
+        delay = target - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        if deadline is not None and time.perf_counter() >= deadline:
+            break
+        th = threading.Thread(target=fire, args=(i,), daemon=True)
+        th.start()
+        threads.append(th)
+        i += 1
+    return i, threads
+
+
 def _drain_rows(rows) -> dict:
     """Drain a converge NDJSON stream to its FINAL row (or the typed
     rejection), folding the row count in as ``rows_streamed`` — the one
@@ -133,6 +171,16 @@ def main() -> int:
                     help="closed-loop worker count (ignored with --rate)")
     ap.add_argument("--rate", type=float, default=None, metavar="RPS",
                     help="open loop: fixed arrival rate in requests/sec")
+    ap.add_argument("--rps", type=float, default=None, metavar="RPS",
+                    help="open loop with POISSON arrivals at this mean "
+                         "rate (exponential inter-arrival gaps — the "
+                         "sustained-load harness; pair with "
+                         "--duration-s, which then overrides --n)")
+    ap.add_argument("--duration-s", type=float, default=None,
+                    metavar="SEC",
+                    help="run for this long instead of a fixed --n "
+                         "(--rps only); the summary row stamps offered "
+                         "vs achieved RPS")
     ap.add_argument("--rows", type=int, default=48)
     ap.add_argument("--cols", type=int, default=64)
     ap.add_argument("--mode", default="grey", choices=["grey", "rgb"])
@@ -311,8 +359,23 @@ def main() -> int:
         with results_lock:
             results.append((i, ts, lat, status, resp))
 
+    if args.rps and args.rate:
+        ap.error("--rps (Poisson) and --rate (fixed clock) are exclusive")
+    if args.duration_s and not args.rps:
+        ap.error("--duration-s needs --rps")
+
+    n_issued = args.n
     t_start = time.perf_counter()
-    if args.rate:
+    if args.rps:
+        # Open loop, POISSON arrivals (see poisson_arrivals):
+        # --duration-s bounds the run by wall time (the sustained-load
+        # harness shape), else --n bounds it by count.
+        n_issued, threads = poisson_arrivals(
+            args.rps, one_request, duration_s=args.duration_s,
+            n=None if args.duration_s else args.n, seed=args.seed)
+        for th in threads:
+            th.join(args.timeout)
+    elif args.rate:
         # Open loop: arrivals on a fixed clock regardless of completions —
         # each request gets its own thread so a slow server shows up as
         # latency (and eventually typed queue_full sheds), not as a
@@ -448,10 +511,22 @@ def main() -> int:
                      + (f"converge tol={args.converge}"
                         if args.converge is not None
                         else f"{args.iters} iters")),
-        "loop": "open" if args.rate else "closed",
-        "n": args.n,
-        **({"rate_rps": args.rate} if args.rate
-           else {"concurrency": args.concurrency}),
+        "loop": ("open-poisson" if args.rps
+                 else ("open" if args.rate else "closed")),
+        "n": n_issued,
+        **({"offered_rps": args.rps,
+            # The arrival process actually realized (scheduling jitter
+            # can under-deliver on a loaded host) vs the completion
+            # throughput the service sustained — the load-curve row
+            # states all three, so "the server kept up" is checkable.
+            "issued_rps": (round(n_issued / wall, 3) if wall else None),
+            "achieved_rps": (round(len(completed) / wall, 3)
+                             if wall else None),
+            **({"duration_s": args.duration_s}
+               if args.duration_s else {})}
+           if args.rps
+           else ({"rate_rps": args.rate} if args.rate
+                 else {"concurrency": args.concurrency})),
         "backend": args.backend,
         "effective_backend": (effective[0] if len(effective) == 1
                               else effective),
@@ -509,8 +584,17 @@ def main() -> int:
         row["mesh"] = snap.get("mesh", "")
         row["engine"] = snap.get("engine", {})
         row["service"] = snap.get("service", {})
+        # Topology identity (ROADMAP item 1's keying, pulled forward):
+        # the SERVER's hosts/slice layout when it reports one, else this
+        # process's own — perf_gate keys multi-host rows separately.
+        row["hosts"] = snap.get("hosts")
+        row["slice_topology"] = snap.get("slice_topology")
     except Exception as e:  # noqa: BLE001 — the row survives a dead /stats
         row["snapshot_error"] = repr(e)[:200]
+    if not row.get("hosts"):
+        from parallel_convolution_tpu.utils.platform import topology
+
+        row.update(topology())
     if failures:
         row["failure_sample"] = failures[:3]
 

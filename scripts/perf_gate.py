@@ -8,7 +8,17 @@ ones.  This script is the gate:
 * **History** — ``evidence/perf_history.jsonl`` (committed), one line
   per accepted measurement, keyed by ``plan_key + backend + grid`` (the
   same tuning identity the plan cache and the drift series use; rows
-  without a plan_key fall back to their workload string).
+  without a plan_key fall back to their workload string).  Round 17:
+  multi-host/multi-slice rows append ``|hosts=N|topo=...`` (a future
+  multi-host row never shares a baseline with a single-host one) and
+  sustained-load rows append ``|rps=R`` (a latency point is only
+  comparable at the same offered load).
+* **Latency gating** (round 17) — rows stamped ``gate_metric:
+  "latency"`` (the p50/p95/p99-vs-offered-load curve, where throughput
+  equals the offered rate by construction) gate on INVERSE p99: a 2×
+  latency regression fails exactly like a 2× throughput loss.  History
+  lines carry the gated value under ``metric`` (older lines fall back
+  to their ``gpixels_per_s``).
 * **Baseline** — the median of the last ``--window`` history entries
   for the row's key.  A key with fewer than ``--min-samples`` entries is
   SEEDED (recorded, gate passes): a fresh machine/config cannot regress
@@ -81,13 +91,56 @@ def row_key(row: dict) -> str:
     solver = row.get("solver")
     if solver and f"solver={solver}" not in key:
         key += f"|solver={solver}"
+    # Topology keying (r17, ROADMAP item 1 pulled forward): multi-host /
+    # multi-slice rows get their own history lane so they are never
+    # judged against single-host baselines.  Single-host rows keep their
+    # unsuffixed keys — the committed history stays continuous.
+    hosts = row.get("hosts")
+    topo = str(row.get("slice_topology") or "")
+    try:
+        multi = (hosts is not None and int(hosts) > 1) or (
+            topo and not topo.startswith("1x"))
+    except (TypeError, ValueError):
+        multi = False
+    if multi:
+        key += f"|hosts={hosts}|topo={topo}"
+    # Load-curve keying (r17): a latency point is only comparable at the
+    # SAME offered load — each RPS step is its own lane.
+    rps = row.get("offered_rps")
+    if rps:
+        key += f"|rps={rps:g}"
     return key
 
 
 def row_metric(row: dict) -> float | None:
-    """Throughput, higher-is-better (None = row carries no gateable
-    number, e.g. a zero-completion loadgen run)."""
+    """The gated number, HIGHER-IS-BETTER (None = row carries no
+    gateable number, e.g. a zero-completion loadgen run).
+
+    Default: throughput (``gpixels_per_s``).  Rows stamped
+    ``gate_metric: "latency"`` — the sustained-load curve, where
+    throughput equals the offered rate by construction and latency IS
+    the regression surface — gate on inverse p99 (``1000 / p99_ms``),
+    so a 2× latency regression halves the metric and fails exactly like
+    a 2× throughput loss.
+    """
+    if row.get("gate_metric") == "latency":
+        try:
+            p99 = float(row.get("p99_ms"))
+        except (TypeError, ValueError):
+            return None
+        return 1000.0 / p99 if p99 > 0 else None
     v = row.get("gpixels_per_s")
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        return None
+    return v if v > 0 else None
+
+
+def hist_value(h: dict) -> float | None:
+    """One history line's metric: ``metric`` (r17 lines) falling back to
+    ``gpixels_per_s`` (every line written before latency gating)."""
+    v = h.get("metric", h.get("gpixels_per_s"))
     try:
         v = float(v)
     except (TypeError, ValueError):
@@ -139,15 +192,16 @@ def evaluate(row: dict, history: list[dict], *, window: int,
     """One row's verdict against its key's rolling baseline."""
     key = row_key(row)
     gpx = row_metric(row)
-    verdict = {"key": key, "gpixels_per_s": gpx, "src": row.get("_src", "")}
+    verdict = {"key": key, "metric": gpx,
+               "gpixels_per_s": row.get("gpixels_per_s"),
+               "src": row.get("_src", "")}
     if gpx is None:
         verdict.update(status="skipped",
-                       note="row carries no positive gpixels_per_s")
+                       note="row carries no positive gateable metric")
         return verdict
-    hist = [float(h["gpixels_per_s"]) for h in history
-            if h.get("key") == key
-            and isinstance(h.get("gpixels_per_s"), (int, float))
-            and h["gpixels_per_s"] > 0][-window:]
+    hist = [v for v in (hist_value(h) for h in history
+                        if h.get("key") == key)
+            if v is not None][-window:]
     if len(hist) < min_samples:
         verdict.update(status="seeded", samples=len(hist),
                        note=f"fewer than {min_samples} history samples")
@@ -157,6 +211,7 @@ def evaluate(row: dict, history: list[dict], *, window: int,
               if len(hist) >= 3 and base > 0 else 0.0)
     t = min(0.9, max(threshold, noise_mult * rel_sd))
     ratio = gpx / base if base > 0 else None
+    # gpx here is the gated METRIC (inverse p99 for latency rows).
     verdict.update(samples=len(hist), baseline=round(base, 6),
                    rel_stdev=round(rel_sd, 4), threshold=round(t, 4),
                    ratio=round(ratio, 4) if ratio is not None else None)
@@ -261,8 +316,12 @@ def main() -> int:
                     continue
                 f.write(json.dumps({
                     "key": v["key"],
+                    # The gated metric (throughput, or inverse p99 for
+                    # latency-gated rows) — hist_value reads this first.
+                    "metric": v["metric"],
                     "gpixels_per_s": v["gpixels_per_s"],
                     "p95_ms": r.get("p95_ms"),
+                    "p99_ms": r.get("p99_ms"),
                     "status": v["status"],
                     "ts": round(time.time(), 3),
                     "src": v["src"],
@@ -279,7 +338,7 @@ def main() -> int:
     if not args.quiet:
         for v in verdicts:
             line = (f"{v['status']:10s} {v['key']}  "
-                    f"gpx={v['gpixels_per_s']}")
+                    f"metric={v['metric']}")
             if "baseline" in v:
                 line += (f"  baseline={v['baseline']} "
                          f"ratio={v['ratio']} thr={v['threshold']}")

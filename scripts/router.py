@@ -75,6 +75,21 @@ def main() -> int:
                     help="fire one extra attempt when the home replica "
                          "hasn't answered within this budget (off by "
                          "default)")
+    # Round 17 — fleet autoscaling + cost-priced admission:
+    ap.add_argument("--autoscale-max", type=int, default=0, metavar="N",
+                    help="enable the autoscaler: grow the in-process "
+                         "pool up to N replicas under load and shrink "
+                         "back on idle (0 = fixed pool; in-process "
+                         "replicas only)")
+    ap.add_argument("--autoscale-interval-s", type=float, default=0.5,
+                    help="control-loop tick period")
+    ap.add_argument("--autoscale-cooldown-s", type=float, default=5.0,
+                    help="minimum wall time between scale actions")
+    ap.add_argument("--price-admission", action="store_true",
+                    help="charge tenant buckets the cost model's "
+                         "predicted device-seconds per request instead "
+                         "of 1 token (--tenant-rate then means "
+                         "device-seconds per second)")
     args = ap.parse_args()
 
     if bool(args.target) == bool(args.replicas):
@@ -120,13 +135,39 @@ def main() -> int:
 
     quotas = (TenantQuotas(args.tenant_rate, args.tenant_burst)
               if args.tenant_rate > 0 else None)
+    pricer = None
+    if args.price_admission:
+        from parallel_convolution_tpu.serving.pricing import WorkPricer
+
+        grid = (1, 1)
+        if args.mesh:
+            r, c = args.mesh.lower().split("x")
+            grid = (int(r), int(c))
+        pricer = WorkPricer(grid=grid)
     router = ReplicaRouter(
-        replicas, quotas=quotas, vnodes=args.vnodes,
+        replicas, quotas=quotas, pricer=pricer, vnodes=args.vnodes,
         breaker_threshold=args.breaker_threshold,
         breaker_cooldown_s=args.breaker_cooldown_s,
         poll_interval_s=args.poll_interval_s,
         load_factor=args.load_factor,
         hedge_s=args.hedge_ms / 1e3 if args.hedge_ms else None)
+
+    scaler = None
+    if args.autoscale_max:
+        if args.target:
+            ap.error("--autoscale-max needs in-process --replicas (HTTP "
+                     "targets have no provisioner to grow through)")
+        from parallel_convolution_tpu.serving.autoscaler import AutoScaler
+
+        def transport_factory(name):
+            return InProcessReplica(factory, name=name)
+
+        scaler = AutoScaler(
+            router, transport_factory, min_replicas=len(replicas),
+            max_replicas=max(args.autoscale_max, len(replicas)),
+            interval_s=args.autoscale_interval_s,
+            cooldown_s=args.autoscale_cooldown_s)
+        scaler.start()
 
     server = make_router_http_server(router, args.host, args.port)
     host, port = server.server_address[:2]
@@ -134,7 +175,10 @@ def main() -> int:
                     replicas=[r.name for r in replicas])
     print(json.dumps({"routing": f"http://{host}:{port}",
                       "replicas": [r.name for r in replicas],
-                      "tenant_quota": bool(quotas)}), flush=True)
+                      "tenant_quota": bool(quotas),
+                      "priced_admission": bool(pricer),
+                      "autoscale_max": args.autoscale_max or None},
+                     ), flush=True)
 
     stopping = []
 
@@ -154,6 +198,8 @@ def main() -> int:
         server.serve_forever()
     finally:
         server.server_close()
+        if scaler is not None:
+            scaler.close()
         router.close()
     return 0
 

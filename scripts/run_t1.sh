@@ -38,6 +38,27 @@
 #                                      Row (failures: 0) lands in
 #                                      evidence/router_smoke.json (the
 #                                      supervisor leg's done_file).
+#   scripts/run_t1.sh --scale-smoke    fleet autoscaling end-to-end on the
+#                                      CPU mesh (round 17): 1 replica
+#                                      behind the router + autoscaler +
+#                                      cost-priced admission; a fixed-RPS
+#                                      Poisson load curve lands in
+#                                      evidence/scale_curve.jsonl, a
+#                                      saturation pack grows the pool
+#                                      (the newcomer PRE-WARMS its ring
+#                                      shard before its vnodes join —
+#                                      per-key compile ledger gated
+#                                      flat), idle shrinks it back, and a
+#                                      greedy converge tenant is priced
+#                                      out (work-unit buckets) while the
+#                                      polite tenant's p99 stays within
+#                                      its stated bound.  Rows fold
+#                                      through perf_gate.py against the
+#                                      smoke's own history, incl. a
+#                                      synthetic 2x-p99 row that must
+#                                      FAIL.  Row (failures: 0) lands in
+#                                      evidence/scale_smoke.json (the
+#                                      supervisor leg's done_file).
 #   scripts/run_t1.sh --serving-smoke  boot the in-process serving stack on
 #                                      the 8-virtual-device CPU mesh, push
 #                                      50 loadgen requests, exit nonzero on
@@ -169,6 +190,14 @@ if [ "${1:-}" = "--mg-smoke" ]; then
     XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python scripts/mg_smoke.py --rows 96 --cols 64 --mesh 2x4 \
       --out evidence/mg_smoke.json
+fi
+
+if [ "${1:-}" = "--scale-smoke" ]; then
+  exec timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    PCTPU_OBS=1 \
+    python scripts/scale_smoke.py --rows 48 --cols 64 --mesh 1x2 \
+      --out evidence/scale_smoke.json
 fi
 
 if [ "${1:-}" = "--router-smoke" ]; then

@@ -491,6 +491,145 @@ def run_router_kill(args) -> int:
     return 1 if failures else 0
 
 
+def run_autoscale_drill(args) -> int:
+    """Sustained-load autoscale drill: N grow/shrink cycles (round 17).
+
+    One in-process replica behind the router with the control loop
+    armed; each cycle saturates the pool with a closed-loop worker pack
+    until the autoscaler GROWS it, then idles until it SHRINKS back —
+    the serving analogue of the reshape ladder drill, run repeatedly so
+    flapping, leaked replicas, and drain races surface.  Gates:
+
+    1. zero non-rejected failures across every cycle (typed retryable
+       sheds re-driven with capped backoff, the loadgen contract);
+    2. every completed response byte-identical to the NumPy oracle;
+    3. every cycle both grew (>= 1 added replica) and shrank (back to
+       the 1-replica floor);
+    4. at least one scale-up pre-warmed its ring shard (warm placement
+       exercised, not just pool arithmetic).
+    """
+    import base64
+    import threading
+
+    import numpy as np
+
+    from parallel_convolution_tpu.ops import filters, oracle
+    from parallel_convolution_tpu.parallel.mesh import mesh_from_spec
+    from parallel_convolution_tpu.serving.autoscaler import AutoScaler
+    from parallel_convolution_tpu.serving.router import (
+        InProcessReplica, ReplicaRouter,
+    )
+    from parallel_convolution_tpu.serving.service import ConvolutionService
+    from parallel_convolution_tpu.utils import imageio
+
+    n_cycles = args.autoscale
+    img = imageio.generate_test_image(40, 56, "grey", seed=args.seed)
+    b64 = base64.b64encode(np.ascontiguousarray(img).tobytes()).decode()
+    iters_pool = [1, 2, 3]
+    oracles = {it: oracle.run_serial_u8(img, filters.get_filter("blur3"),
+                                        it) for it in iters_pool}
+
+    def factory():
+        return ConvolutionService(mesh_from_spec("1x2"), max_batch=1,
+                                  max_delay_s=0.001, max_queue=16)
+
+    def transport_factory(name):
+        return InProcessReplica(factory, name=name)
+
+    router = ReplicaRouter([InProcessReplica(factory, name="r0")],
+                           poll_interval_s=0.05, breaker_cooldown_s=0.2)
+    scaler = AutoScaler(router, transport_factory, min_replicas=1,
+                        max_replicas=2, up_pressure=0.3,
+                        down_pressure=0.02, up_ticks=2, down_ticks=10,
+                        cooldown_s=1.0, interval_s=0.2, drain_s=5.0)
+    results, lock = [], threading.Lock()
+    counter = [0]
+
+    def one(i: int) -> None:
+        it = iters_pool[i % len(iters_pool)]
+        body = {"image_b64": b64, "rows": 40, "cols": 56, "mode": "grey",
+                "filter": "blur3", "iters": it, "request_id": f"as{i}"}
+        for attempt in range(6):
+            status, wire = router.request(dict(body), tenant="drill")
+            if wire.get("ok") or not wire.get("retryable"):
+                break
+            time.sleep(min(float(wire.get("retry_after_s") or 0.05), 0.5))
+        ok = bool(wire.get("ok"))
+        byte_ok = None
+        if ok:
+            got = np.frombuffer(base64.b64decode(wire["image_b64"]),
+                                np.uint8).reshape(40, 56)
+            byte_ok = bool(np.array_equal(got, oracles[it]))
+        with lock:
+            results.append({"i": i, "ok": ok, "byte_ok": byte_ok,
+                            "rejected": wire.get("rejected"),
+                            "retryable": wire.get("retryable")})
+
+    # Observatory warm-up: the pre-warm worklist needs observed configs.
+    for i in range(len(iters_pool)):
+        one(i)
+    scaler.start()
+    cycles = []
+    for cycle in range(n_cycles):
+        stop = threading.Event()
+
+        def worker():
+            while not stop.is_set():
+                with lock:
+                    i = counter[0] + 100
+                    counter[0] += 1
+                one(i)
+
+        pack = [threading.Thread(target=worker, daemon=True)
+                for _ in range(24)]
+        for th in pack:
+            th.start()
+        grew = False
+        t_sat = time.time()
+        while time.time() - t_sat < 30.0:
+            if len(router.ring.members()) >= 2:
+                grew = True
+                break
+            time.sleep(0.1)
+        stop.set()
+        for th in pack:
+            th.join(60)
+        shrank = False
+        t_idle = time.time()
+        while time.time() - t_idle < 30.0:
+            if len(router.ring.members()) == 1:
+                shrank = True
+                break
+            time.sleep(0.1)
+        cycles.append({"cycle": cycle, "grew": grew, "shrank": shrank})
+    scaler.close()
+    router.close()
+
+    completed = [r for r in results if r["ok"]]
+    byte_fails = [r for r in completed if not r["byte_ok"]]
+    non_rejected = [r for r in results
+                    if not r["ok"] and not r.get("retryable")]
+    bad_cycles = [c for c in cycles if not (c["grew"] and c["shrank"])]
+    prewarmed = scaler.stats["prewarmed_configs"]
+    failures = (len(byte_fails) + len(non_rejected) + len(bad_cycles)
+                + (1 if prewarmed < 1 else 0))
+    summary = {
+        "summary": "autoscale-drill", "cycles": cycles,
+        "n": len(results), "completed": len(completed),
+        "scaler": dict(scaler.stats),
+        "prewarmed_configs": prewarmed,
+        "byte_mismatches": len(byte_fails),
+        "non_rejected_failures": len(non_rejected),
+        "failures": failures,
+    }
+    if args.summary_out:
+        p = Path(args.summary_out)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(json.dumps(summary) + "\n")
+    print(json.dumps(summary), flush=True)
+    return 1 if failures else 0
+
+
 RESHAPE_TARGETS = [(1, 2), (2, 2), (1, 1)]
 
 
@@ -714,6 +853,12 @@ def main() -> int:
                          "continuous traffic; gates on zero non-rejected "
                          "failures, byte-identical results, and >= 1 "
                          "observed failover")
+    ap.add_argument("--autoscale", type=int, default=0, metavar="N",
+                    help="fleet-autoscale drill: 1 replica + the control "
+                         "loop, N saturate/idle cycles; gates on zero "
+                         "non-rejected failures, byte-identical results, "
+                         "every cycle growing AND shrinking the pool, "
+                         "and >= 1 pre-warmed ring shard")
     ap.add_argument("--summary-out", default=None, metavar="FILE",
                     help="also write the final summary row to FILE "
                          "(the tier-1 --elastic-smoke leg's done_file)")
@@ -747,6 +892,8 @@ def main() -> int:
         ap.error("--reshape and --faults are separate modes")
     if args.router_kill:
         return run_router_kill(args)
+    if args.autoscale:
+        return run_autoscale_drill(args)
     if args.faults or args.reshape:
         return run_fault_soak(args)
 

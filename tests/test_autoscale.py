@@ -1,0 +1,524 @@
+"""Fleet autoscaling (round 17): control loop, pricing, warm placement.
+
+The ISSUE-12 acceptance properties on the 8-virtual-device CPU mesh:
+
+* scale-up/down hysteresis walks deterministically under an injected
+  clock (streaks, dead band, cooldown, min/max clamps);
+* a joining replica PRE-WARMS its ring shard before its vnodes enter
+  the ring, and the shard's per-key compile ledger stays flat through
+  the remapped traffic that follows;
+* work-unit pricing math: predicted device-seconds scale with pixels
+  and iterations, converge jobs price their work budget, the floor and
+  the cache behave, and the jax-free multigrid mirror tracks the real
+  solver's schedule constants;
+* cost-priced token buckets: debt semantics for bigger-than-burst jobs,
+  priced charge/refund, and greedy-tenant isolation — a polite tenant's
+  p99 stays bounded while one admitted multigrid job runs and the rest
+  are priced out;
+* the router exposes the autoscaler's own inputs (per-replica
+  in-flight, queue depth, warm-key count) via /stats;
+* perf_gate gates latency rows (a synthetic 2× p99 regression fails)
+  and keys multi-host rows separately.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from parallel_convolution_tpu.ops import filters, oracle
+from parallel_convolution_tpu.parallel import mesh as mesh_lib
+from parallel_convolution_tpu.serving.autoscaler import AutoScaler
+from parallel_convolution_tpu.serving.pricing import WorkPricer
+from parallel_convolution_tpu.serving.router import (
+    InProcessReplica, ReplicaRouter, TenantQuotas, TokenBucket, route_key,
+)
+from parallel_convolution_tpu.serving.service import ConvolutionService
+from parallel_convolution_tpu.tuning import costmodel
+from parallel_convolution_tpu.utils import imageio
+
+SCRIPTS = Path(__file__).resolve().parent.parent / "scripts"
+
+
+def _mesh(shape=(1, 2)):
+    return mesh_lib.make_grid_mesh(jax.devices()[: shape[0] * shape[1]],
+                                   shape)
+
+
+def _img(rows=32, cols=48, seed=5):
+    return imageio.generate_test_image(rows, cols, "grey", seed=seed)
+
+
+def _body(img, **kw):
+    body = {"image_b64": base64.b64encode(
+        np.ascontiguousarray(img).tobytes()).decode("ascii"),
+        "rows": img.shape[0], "cols": img.shape[1], "mode": "grey"}
+    body.update(kw)
+    return body
+
+
+def _factory(shape=(1, 2), **kw):
+    kw.setdefault("max_delay_s", 0.002)
+    kw.setdefault("max_batch", 1)
+
+    def make():
+        return ConvolutionService(_mesh(shape), **kw)
+
+    return make
+
+
+class _StubRouter:
+    """decide()-only scaffolding: the decision never touches the pool."""
+
+
+def _scaler(clock, **kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("up_ticks", 2)
+    kw.setdefault("down_ticks", 3)
+    kw.setdefault("cooldown_s", 10.0)
+    return AutoScaler(_StubRouter(), None, clock=clock, **kw)
+
+
+def _sig(pressure, replicas=2, p99_ms=None):
+    return {"replicas": replicas, "live": replicas, "in_flight": 0,
+            "queue_depth": 0, "queue_bound": 64, "pressure": pressure,
+            "degraded": 0, "p99_ms": p99_ms}
+
+
+# ------------------------------------------------- hysteresis (injected clock)
+
+
+def test_scale_up_needs_consecutive_over_pressure_ticks():
+    clock = [0.0]
+    sc = _scaler(lambda: clock[0], up_pressure=0.5)
+    assert sc.decide(_sig(0.9)).action == "hold"     # streak 1 < up_ticks
+    assert sc.decide(_sig(0.9)).action == "up"       # streak 2
+    # A mixed (dead-band) tick resets the streak: two MORE over-pressure
+    # ticks are needed, not one.
+    sc2 = _scaler(lambda: clock[0], up_pressure=0.5)
+    assert sc2.decide(_sig(0.9)).action == "hold"
+    assert sc2.decide(_sig(0.3)).action == "hold"    # dead band: reset
+    assert sc2.decide(_sig(0.9)).action == "hold"    # streak back to 1
+    assert sc2.decide(_sig(0.9)).action == "up"
+
+
+def test_scale_down_needs_longer_streak_and_floor():
+    clock = [0.0]
+    sc = _scaler(lambda: clock[0], down_pressure=0.1, down_ticks=3)
+    assert sc.decide(_sig(0.0)).action == "hold"
+    assert sc.decide(_sig(0.0)).action == "hold"
+    assert sc.decide(_sig(0.0)).action == "down"     # 3rd idle tick
+    # At the min-replica floor the same streak holds instead.
+    sc2 = _scaler(lambda: clock[0], down_pressure=0.1, down_ticks=1)
+    assert sc2.decide(_sig(0.0, replicas=1)).action == "hold"
+
+
+def test_cooldown_blocks_actions_until_it_elapses():
+    clock = [0.0]
+    sc = _scaler(lambda: clock[0], up_pressure=0.5, cooldown_s=10.0)
+    sc._last_change = 0.0
+    clock[0] = 5.0       # mid-cooldown: over-pressure must hold
+    assert sc.decide(_sig(0.9)).action == "hold"
+    assert sc.decide(_sig(0.9)).reason == "cooldown"
+    clock[0] = 11.0      # past cooldown: the accumulated streak fires
+    assert sc.decide(_sig(0.9)).action == "up"
+
+
+def test_max_replicas_clamps_scale_up():
+    clock = [0.0]
+    sc = _scaler(lambda: clock[0], up_pressure=0.5, max_replicas=2)
+    assert sc.decide(_sig(0.9, replicas=2)).action == "hold"
+    assert sc.decide(_sig(0.9, replicas=2)).action == "hold"
+
+
+def test_windowed_p99_is_tick_delta_not_lifetime():
+    """The p99 signal must read only THIS tick's new samples: a pile of
+    ancient fast samples must not numb it, and a pile of ancient slow
+    samples must not pin it high after latencies recover."""
+    from parallel_convolution_tpu.obs import metrics as obs_metrics
+
+    hist = obs_metrics.histogram(
+        "pctpu_request_phase_seconds",
+        "per-request serving latency by phase", ("phase", "backend"))
+    clock = [0.0]
+    sc = _scaler(lambda: clock[0])
+    for _ in range(1000):          # ancient fast history
+        hist.observe(0.001, phase="total", backend="shifted")
+    assert sc._windowed_p99_ms() is None       # first sight: no window
+    for _ in range(10):            # the overload arrives THIS tick
+        hist.observe(2.0, phase="total", backend="shifted")
+    p99 = sc._windowed_p99_ms()
+    assert p99 is not None and p99 > 1000.0    # delta sees it at once
+    for _ in range(10):            # recovery: fast again
+        hist.observe(0.001, phase="total", backend="shifted")
+    p99 = sc._windowed_p99_ms()
+    assert p99 is not None and p99 < 100.0     # and lets go at once
+
+
+def test_p99_trigger_scales_up_without_queue_pressure():
+    clock = [0.0]
+    sc = _scaler(lambda: clock[0], up_pressure=0.9, p99_up_ms=100.0,
+                 up_ticks=1)
+    assert sc.decide(_sig(0.0, p99_ms=250.0)).action == "up"
+    sc2 = _scaler(lambda: clock[0], up_pressure=0.9, p99_up_ms=100.0,
+                  up_ticks=1)
+    assert sc2.decide(_sig(0.0, p99_ms=50.0)).action != "up"
+
+
+# --------------------------------------------------- work-unit pricing math
+
+
+def test_pricing_scales_with_pixels_and_iters():
+    # min_units lowered so the floor doesn't mask the scaling law under
+    # test (the default floor is itself tested below).
+    p = WorkPricer(grid=(2, 2), platform="cpu", min_units=1e-9)
+    small = {"rows": 64, "cols": 64, "filter": "blur3", "iters": 2}
+    big = {"rows": 4096, "cols": 4096, "filter": "blur3", "iters": 2}
+    # Pixel ratio is 4096x; the price ratio is intentionally smaller
+    # (small sharded blocks are exchange-latency-bound, so their per-px
+    # cost is higher — the model pricing real marginal cost, not a flat
+    # per-px fee) but must still be decisively work-proportional.
+    assert p.price(big) > 50 * p.price(small)
+    twice = p.price({"rows": 4096, "cols": 4096, "filter": "blur3",
+                     "iters": 4})
+    assert twice == pytest.approx(2.0 * p.price(big), rel=0.05)
+
+
+def test_pricing_floor_cache_and_garbage():
+    p = WorkPricer(grid=(1, 1), platform="cpu", min_units=1e-3)
+    tiny = p.price({"rows": 2, "cols": 2, "filter": "blur3", "iters": 1})
+    assert tiny == 1e-3                      # floored, still metered
+    assert p.price({"rows": "garbage"}) == 1e-3   # malformed -> floor
+    body = {"rows": 512, "cols": 512, "filter": "blur3", "iters": 3}
+    assert p.price(body) == p.price(dict(body))   # cache: stable value
+
+
+def test_converge_jobs_price_their_work_budget():
+    p = WorkPricer(grid=(1, 2), platform="cpu")
+    jac = {"rows": 1024, "cols": 1024, "filter": "blur3",
+           "solver": "jacobi", "max_iters": 2000, "quantize": False}
+    mg = dict(jac, solver="multigrid")
+    pj, pm = p.price(jac, converge=True), p.price(mg, converge=True)
+    # Same fine-grid work budget: the two solvers price within a small
+    # factor of each other (the V-cycle adds transfer overhead), and
+    # both dwarf a thumbnail request.
+    assert 0.5 * pj < pm < 2.0 * pj
+    assert pm > 1000 * p.price({"rows": 48, "cols": 64,
+                                "filter": "blur3", "iters": 2})
+    # Budget linearity: half the max_iters, about half the price.
+    half = p.price(dict(mg, max_iters=1000), converge=True)
+    assert half == pytest.approx(0.5 * pm, rel=0.1)
+
+
+def test_mg_pricing_mirror_tracks_solver_schedule():
+    """The jax-free cost-model mirror must track solvers.multigrid's
+    actual schedule: constants pinned, work units per cycle within
+    tolerance of the real planner's accounting."""
+    from parallel_convolution_tpu.solvers import multigrid
+
+    assert costmodel.MG_PRE_SWEEPS == multigrid.NU_PRE
+    assert costmodel.MG_POST_SWEEPS == multigrid.NU_POST
+    assert costmodel.MG_COARSE_SWEEPS == multigrid.NU_COARSE
+    assert costmodel.MG_MIN_EXTENT == multigrid.MG_MIN_EXTENT
+    assert costmodel.MG_MAX_LEVELS == multigrid.MG_MAX_LEVELS
+    mesh = _mesh((1, 2))
+    levels = multigrid.plan_levels(mesh, (96, 64), 1, "zero", None)
+    real_wu = multigrid.cycle_work_units(levels)
+    hw = costmodel.hardware_for("cpu")
+    _, wu = costmodel.predict_mg_cycle_seconds(
+        (1, 96, 64), (1, 2), 3, "f32", False, hw, levels=len(levels))
+    assert wu == pytest.approx(real_wu, rel=0.25)
+
+
+# ------------------------------------------------- priced buckets & quotas
+
+
+def test_token_bucket_debt_admits_bigger_than_burst_jobs():
+    clock = [0.0]
+    b = TokenBucket(rate=1.0, burst=2.0, clock=lambda: clock[0])
+    ok, _ = b.try_take(5.0)          # bigger than burst: full bucket pays
+    assert ok and b.level() == pytest.approx(-3.0)
+    ok, retry = b.try_take(0.5)      # in debt: refused with honest wait
+    assert not ok and retry == pytest.approx(3.5)
+    clock[0] = 3.6                   # debt refills at rate
+    ok, _ = b.try_take(0.5)
+    assert ok
+    # But a PARTIAL bucket never grants an oversized job (debt needs a
+    # full bucket): otherwise burst would stop meaning anything.
+    b2 = TokenBucket(rate=1.0, burst=2.0, clock=lambda: clock[0])
+    assert b2.try_take(1.5)[0]
+    ok, _ = b2.try_take(5.0)
+    assert not ok
+
+
+def test_quotas_charge_and_refund_work_units():
+    clock = [0.0]
+    q = TenantQuotas(rate=1.0, burst=4.0, clock=lambda: clock[0])
+    ok, _ = q.take("t", 3.0)
+    assert ok and q.bucket("t").level() == pytest.approx(1.0)
+    ok, _ = q.take("t", 3.0)         # only 1 token left
+    assert not ok
+    q.refund("t", 3.0)
+    assert q.bucket("t").level() == pytest.approx(4.0)
+
+
+def test_router_charges_priced_units_and_stamps_cost():
+    img = _img()
+    pricer = WorkPricer(grid=(1, 2), platform="cpu", min_units=1.0)
+    # min_units=1.0 makes every request cost exactly 1 unit here, so the
+    # bucket math is deterministic: burst 2 -> third request sheds.
+    quotas = TenantQuotas(rate=0.001, burst=2.0)
+    router = ReplicaRouter([InProcessReplica(_factory(), name="r0")],
+                           quotas=quotas, pricer=pricer,
+                           start_health=False)
+    try:
+        seen = []
+        for i in range(3):
+            status, wire = router.request(
+                _body(img, iters=1, request_id=f"c{i}"), tenant="t")
+            seen.append(wire)
+        assert seen[0]["ok"] and seen[1]["ok"]
+        assert seen[0]["router"]["cost_units"] == 1.0
+        shed = seen[2]
+        assert shed["rejected"] == "tenant_quota" and shed["retryable"]
+        assert shed["cost_units"] == 1.0
+        assert shed["retry_after_s"] > 0
+    finally:
+        router.close()
+
+
+# --------------------------------------------- pool mutation & warm placement
+
+
+def test_prewarm_flat_compile_on_joining_replica():
+    img = _img()
+    router = ReplicaRouter([InProcessReplica(_factory(), name="r0")],
+                           start_health=False)
+    try:
+        for it in (1, 2, 3):
+            status, wire = router.request(
+                _body(img, iters=it, request_id=f"w{it}"))
+            assert wire["ok"], wire
+
+        def tfactory(name):
+            return InProcessReplica(_factory(), name=name)
+
+        sc = AutoScaler(router, tfactory, min_replicas=1, max_replicas=2,
+                        up_ticks=1, down_ticks=1, cooldown_s=0.0)
+        name = sc.scale_up()
+        router.poll_once()
+        newcomer = router.replica(name)
+        eng = newcomer.service.engine
+        # Pre-warm happened BEFORE ring join: whatever is resident now
+        # was compiled off the observatory's shard replay.
+        shard = [it for it in (1, 2, 3)
+                 if router.ring.candidates(route_key(
+                     _body(img, iters=it)))[0] == name]
+        resident = {k.iters for k in eng._entries}
+        assert set(shard) <= resident, (shard, resident)
+        before = {k.iters: e.compiles for k, e in eng._entries.items()}
+        assert all(v == 1 for v in before.values())
+        # Remapped traffic for the shard keys lands warm: the per-key
+        # compile ledger stays EXACTLY flat (max_batch=1 pool).
+        for rep in range(3):
+            for it in shard:
+                status, wire = router.request(
+                    _body(img, iters=it, request_id=f"p{rep}x{it}"))
+                assert wire["ok"] and wire["router"]["replica"] == name
+        after = {k.iters: e.compiles for k, e in eng._entries.items()}
+        assert all(after[it] == before[it] for it in shard), (before,
+                                                             after)
+        assert eng.stats["compiles"] == len(before)
+        # Scale-down drains the newcomer back out; the pool keeps
+        # serving and only the leaver's keys re-home.
+        assert sc.scale_down() == name
+        assert router.ring.members() == ["r0"]
+        status, wire = router.request(_body(img, iters=1,
+                                            request_id="post"))
+        assert wire["ok"]
+    finally:
+        router.close()
+
+
+def test_remove_replica_guards_and_drain():
+    img = _img()
+    reps = [InProcessReplica(_factory(), name=f"r{i}") for i in range(2)]
+    router = ReplicaRouter(reps, start_health=False)
+    try:
+        with pytest.raises(KeyError):
+            router.remove_replica("nope")
+        info = router.remove_replica("r1", drain_s=1.0)
+        assert info["drained"] and router.ring.members() == ["r0"]
+        with pytest.raises(ValueError):
+            router.remove_replica("r0")
+        status, wire = router.request(_body(img, iters=1))
+        assert wire["ok"]
+    finally:
+        router.close()
+
+
+def test_add_replica_rejects_duplicate_names():
+    router = ReplicaRouter([InProcessReplica(_factory(), name="r0")],
+                           start_health=False)
+    try:
+        with pytest.raises(ValueError):
+            router.add_replica(InProcessReplica(_factory(), name="r0"))
+    finally:
+        router.close()
+
+
+def test_router_stats_expose_autoscaler_inputs():
+    img = _img()
+    router = ReplicaRouter([InProcessReplica(_factory(), name="r0")],
+                           start_health=False)
+    try:
+        status, wire = router.request(_body(img, iters=2))
+        assert wire["ok"]
+        router.poll_once()
+        snap = router.snapshot()
+        rep = snap["replicas"]["r0"]
+        assert rep["in_flight"] == 0
+        assert rep["queue_depth"] == 0
+        assert rep["warm_keys"] == 1       # the served key is resident
+        assert rep["in_ring"] is True
+        assert snap["observed_keys"] == 1  # the observatory saw it
+    finally:
+        router.close()
+
+
+def test_service_readiness_reports_warm_keys_and_progressive():
+    svc = _factory()()
+    try:
+        ready, payload = svc.readiness()
+        assert ready
+        assert payload["warm_keys"] == 0
+        assert payload["progressive_active"] == 0
+        assert payload["progressive_bound"] == svc.max_progressive
+    finally:
+        svc.close()
+
+
+# ------------------------------------------------- greedy-tenant isolation
+
+
+def test_greedy_converge_tenant_is_priced_out_and_polite_p99_bounded():
+    img = _img()
+    pricer = WorkPricer(grid=(1, 2), platform="cpu")
+    big_job = {"rows": 128, "cols": 128, "mode": "grey",
+               "filter": "blur3", "solver": "multigrid",
+               "max_iters": 120, "tol": 0.0, "quantize": False,
+               "storage": "f32", "check_every": 1}
+    big_cost = pricer.price(big_job, converge=True)
+    small_cost = pricer.price({"rows": 32, "cols": 48, "mode": "grey",
+                               "filter": "blur3", "iters": 1})
+    assert big_cost > 10 * small_cost   # work-unit pricing premise
+    quotas = TenantQuotas(rate=5.0, burst=8.0,
+                          overrides={"greedy": (big_cost / 100.0,
+                                                big_cost * 1.2)})
+    router = ReplicaRouter([InProcessReplica(_factory(), name="r0")],
+                           quotas=quotas, pricer=pricer,
+                           start_health=False)
+    try:
+        big = {"image_b64": base64.b64encode(np.ascontiguousarray(
+            imageio.generate_test_image(128, 128, "grey", seed=3)
+        ).tobytes()).decode("ascii"), **{
+            k: v for k, v in big_job.items()}}
+        # First big job: admitted (debt semantics), runs in background.
+        status, rows = router.converge(dict(big, request_id="g1"),
+                                       tenant="greedy")
+        assert status == 200
+        drained = threading.Event()
+
+        def drain():
+            for _ in rows:
+                pass
+            drained.set()
+
+        t = threading.Thread(target=drain, daemon=True)
+        t.start()
+        # Second big job while the first runs: priced out, typed shed
+        # carrying the work-unit bill.
+        status2, rows2 = router.converge(dict(big, request_id="g2"),
+                                         tenant="greedy")
+        shed = next(iter(rows2))
+        assert shed["rejected"] == "tenant_quota" and shed["retryable"]
+        assert shed["cost_units"] == pytest.approx(big_cost, abs=1e-6)
+        # The polite tenant keeps serving small requests with a bounded
+        # p99 while the admitted V-cycle job occupies the pool.
+        lats = []
+        for i in range(12):
+            t0 = time.perf_counter()
+            status, wire = router.request(
+                _body(img, iters=1, request_id=f"pol{i}"),
+                tenant="polite")
+            lats.append(time.perf_counter() - t0)
+            assert wire["ok"], wire
+            assert wire.get("rejected") != "tenant_quota"
+        lats.sort()
+        assert lats[-1] < 10.0   # bounded: the OTHER big jobs were
+        #                          priced out, so the queue never piles
+        t.join(120)
+        assert drained.is_set()
+    finally:
+        router.close()
+
+
+# ----------------------------------------------------- perf_gate extensions
+
+
+def _gate(tmp_path, rows, extra=()):
+    hist = tmp_path / "hist.jsonl"
+    row_files = []
+    for i, r in enumerate(rows):
+        p = tmp_path / f"row{i}.json"
+        p.write_text(json.dumps(r))
+        row_files += ["--row", str(p)]
+    return subprocess.run(
+        [sys.executable, str(SCRIPTS / "perf_gate.py"),
+         "--history", str(hist), *row_files, "--quiet", *extra],
+        capture_output=True, text=True)
+
+
+def test_perf_gate_latency_rows_fail_on_2x_p99(tmp_path):
+    base = {"workload": "curve", "gate_metric": "latency",
+            "p99_ms": 80.0, "offered_rps": 20.0,
+            "effective_backend": "shifted", "mesh": "1x2"}
+    assert _gate(tmp_path, [base], ["--update"]).returncode == 0
+    assert _gate(tmp_path, [base]).returncode == 0
+    assert _gate(tmp_path, [dict(base, p99_ms=160.0)]).returncode == 1
+    # An IMPROVEMENT (lower latency) never fails.
+    assert _gate(tmp_path, [dict(base, p99_ms=40.0)]).returncode == 0
+
+
+def test_perf_gate_rps_and_topology_key_lanes(tmp_path):
+    out = tmp_path / "report.json"
+    row = {"workload": "w", "gate_metric": "latency", "p99_ms": 50.0,
+           "offered_rps": 15.0, "effective_backend": "shifted",
+           "mesh": "2x4", "hosts": 4, "slice_topology": "4x8:v5e"}
+    r = _gate(tmp_path, [row], ["--update", "--out", str(out)])
+    assert r.returncode == 0
+    key = json.loads(out.read_text())["verdicts"][0]["key"]
+    assert "rps=15" in key and "hosts=4" in key and "4x8:v5e" in key
+    # Single-host rows stay on their historical unsuffixed keys.
+    row1 = dict(row, hosts=1, slice_topology="1x8:cpu")
+    r = _gate(tmp_path, [row1], ["--out", str(out)])
+    key1 = json.loads(out.read_text())["verdicts"][0]["key"]
+    assert "hosts=" not in key1 and "rps=15" in key1
+
+
+def test_topology_stamp_shape():
+    from parallel_convolution_tpu.utils.platform import topology
+
+    t = topology(_mesh((1, 2)))
+    assert t["hosts"] == 1
+    assert t["slice_topology"].startswith("1x2:")
