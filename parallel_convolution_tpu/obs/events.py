@@ -74,6 +74,12 @@ KINDS = frozenset({
     "autoscale",           # fleet control loop: scale decision + the
     #                        signals that drove it, pre-warm report,
     #                        drain report (round 17)
+    "resume",              # durable converge job resumed mid-stream on a
+    #                        surviving replica from its ledger token
+    #                        (round 18: from/to replica, iters, work
+    #                        units already spent)
+    "chaos",               # chaos transport injected a network-shaped
+    #                        failure (round 18: site, mode, replica)
     "span",                # one closed trace span (obs.trace): trace_id/
     #                        span_id/parent_id + start_ts/dur_s/links
 })

@@ -40,15 +40,34 @@ import random
 import threading
 
 # One name per observed outage mode; specs naming anything else are
-# rejected up front so a typo'd site can't silently never fire.
-KNOWN_SITES = frozenset({
-    "backend_compile",        # tracing/compiling an iteration runner
-    "halo_exchange",          # building the exchange (ppermute or RDMA)
-    "checkpoint_write_shard", # before each per-shard .npy write
-    "checkpoint_write_meta",  # before meta.json, and before the LATEST flip
-    "device_probe",           # backend liveness probe (the tunnel check)
-    "io_read",                # sharded block read from disk
-})
+# rejected up front so a typo'd site can't silently never fire.  THIS
+# TABLE is the documented site registry (previously DESIGN.md prose
+# only — drift-guarded by tests/test_chaos.py, which greps the tree for
+# every ``fault_point(name)`` consult and pins it against these keys):
+# compute/IO sites first (rounds 7+), then the transport sites the
+# round-18 chaos layer injects through (serving.chaos.ChaosTransport
+# consults them around every router→replica hop).
+SITE_TABLE = {
+    "backend_compile":        "tracing/compiling an iteration runner",
+    "halo_exchange":          "building the exchange (ppermute or RDMA)",
+    "checkpoint_write_shard": "before each per-shard .npy write",
+    "checkpoint_write_meta":  "before meta.json, and before the LATEST flip",
+    "device_probe":           "backend liveness probe (the tunnel check)",
+    "io_read":                "sharded block read from disk",
+    "transport_send":         "router→replica request leaving the client "
+                              "(drop / latency / black-hole: the work "
+                              "never reaches the replica)",
+    "transport_recv":         "replica→router response on the way back "
+                              "(drop / corrupt: the work EXECUTED but the "
+                              "response is lost or unparseable — the "
+                              "idempotency-ledger case)",
+    "transport_stream":       "one progressive NDJSON row in flight "
+                              "(mid-stream disconnect after best-so-far "
+                              "rows already landed — the resume case)",
+    "readyz_probe":           "active-health /readyz poll (flapping "
+                              "readiness: the router's routing input lies)",
+}
+KNOWN_SITES = frozenset(SITE_TABLE)
 
 
 class InjectedFault(RuntimeError):

@@ -42,6 +42,17 @@ stack, none of which duplicate compute code:
                  hysteresis + cooldown, pre-warming a joining replica's
                  ring shard before its vnodes take traffic and draining
                  leavers through the ring-remove path.
+``jobs.py``      durable convergence jobs (round 18): the router's
+                 resume-token ledger keyed on request_id — per-row
+                 bounded tokens (iteration/cycle index, residual, f32
+                 field state), mid-stream failover/resume seeding, and
+                 the exactly-once final-row gate.
+``chaos.py``     the chaos transport (round 18): seeded network-shaped
+                 failure injection (latency, drops, mid-stream
+                 disconnects, corrupt bodies, black-holes, flapping
+                 readiness) at the PCTPU_FAULTS transport sites, so the
+                 serving plane's failover/resume machinery is drilled
+                 under replayable schedules.
 
 CLI surfaces: ``scripts/serve.py`` (boot one replica's HTTP server),
 ``scripts/router.py`` (boot the router over N replicas, optionally
@@ -51,17 +62,21 @@ schema; ``--rps``/``--duration-s`` is the sustained-load harness).
 """
 
 from parallel_convolution_tpu.serving.autoscaler import AutoScaler
+from parallel_convolution_tpu.serving.chaos import ChaosTransport
 from parallel_convolution_tpu.serving.engine import EngineKey, WarmEngine
+from parallel_convolution_tpu.serving.jobs import JobLedger
 from parallel_convolution_tpu.serving.pricing import WorkPricer
 from parallel_convolution_tpu.serving.router import (
-    HTTPReplica, InProcessReplica, ReplicaRouter, TenantQuotas,
+    CorruptReplicaBody, HTTPReplica, InProcessReplica, ReplicaRouter,
+    TenantQuotas,
 )
 from parallel_convolution_tpu.serving.service import (
     ConvolutionService, Rejected, Request, Response, Snapshot,
 )
 
 __all__ = [
-    "AutoScaler", "ConvolutionService", "EngineKey", "HTTPReplica",
-    "InProcessReplica", "Rejected", "ReplicaRouter", "Request", "Response",
+    "AutoScaler", "ChaosTransport", "ConvolutionService",
+    "CorruptReplicaBody", "EngineKey", "HTTPReplica", "InProcessReplica",
+    "JobLedger", "Rejected", "ReplicaRouter", "Request", "Response",
     "Snapshot", "TenantQuotas", "WarmEngine", "WorkPricer",
 ]

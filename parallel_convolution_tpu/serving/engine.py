@@ -730,7 +730,9 @@ class WarmEngine:
             return fn
 
     def run_converge(self, key: EngineKey, image: np.ndarray, *,
-                     tol: float, max_iters: int, check_every: int):
+                     tol: float, max_iters: int, check_every: int,
+                     start_done: int = 0, start_wu: float = 0.0,
+                     start_diff: float = float("inf")):
         """Progressive run-to-convergence through the warm cache.
 
         ``image`` is ONE (C, H, W) f32 field; ``key.iters`` should equal
@@ -747,6 +749,17 @@ class WarmEngine:
         pixel-weighted per-level accounting that makes the two solvers
         comparable under one budget.
 
+        ``start_done``/``start_wu`` seed a RESUMED job (round 18):
+        ``image`` is then a mid-stream field from a resume token, the
+        iteration/cycle count continues from ``start_done``, and
+        ``max_iters`` keeps meaning the job's TOTAL budget — the
+        resumed stream only spends what the token hasn't.  Tokens are
+        minted on ``check_every`` (resp. V-cycle) boundaries, so the
+        remaining chunk sizes are exactly the uninterrupted run's —
+        which is why the resumed final row is byte-identical (asserted
+        in tests/test_chaos.py; crop + zero-re-pad is bit-exact on any
+        grid, so it holds even resuming onto a different mesh).
+
         A mid-stream mesh reshape raises the same stale-grid ValueError
         as :meth:`run_batch` — the service turns it into a typed,
         retryable ``resharding`` row after the best-so-far snapshots
@@ -762,6 +775,12 @@ class WarmEngine:
             raise ValueError(
                 f"image shape {tuple(image.shape)} does not match key "
                 f"{key.shape}")
+        start_done, start_wu = int(start_done), float(start_wu)
+        if float(start_diff) < tol:
+            # The token already met the tolerance (the dead stream died
+            # between its last chunk and the final row): nothing left to
+            # run — the caller emits the final row from the token.
+            return
         if key.solver == "multigrid":
             # The V-cycle's level programs are module-level lru-cached
             # (solvers.multigrid) on (mesh, filter, geometry, backend) —
@@ -775,9 +794,12 @@ class WarmEngine:
             entry.mg_levels = len(multigrid.plan_levels(
                 self.mesh, image.shape[1:], filt.radius, key.boundary,
                 key.mg_levels))
+            budget = float(max_iters) - start_wu
+            if budget <= 0:
+                return
             stream = multigrid.mg_converge_stream(
                 np.ascontiguousarray(image, dtype=np.float32), filt,
-                tol=tol, max_iters=max_iters, mesh=self.mesh,
+                tol=tol, max_iters=budget, mesh=self.mesh,
                 quantize=key.quantize, backend=entry.effective_backend,
                 storage=key.storage, boundary=key.boundary,
                 tile=key.tile, overlap=entry.effective_overlap,
@@ -788,13 +810,16 @@ class WarmEngine:
                     raise ValueError(
                         f"stale key grid {key.grid}: engine mesh is now "
                         f"{self.grid()} (resharded mid-process)")
-                yield (out, cycles, residual, wu)
+                yield (out, cycles + start_done, residual,
+                       round(wu + start_wu, 3))
             return
         xs, valid_hw, _ = step_lib._prepare(
             np.ascontiguousarray(image, dtype=np.float32), self.mesh,
             filt.radius, key.storage)
         check_every, max_iters = int(check_every), int(max_iters)
-        done, diff = 0, float("inf")
+        done, diff = start_done, float("inf")   # start_diff >= tol here:
+        #                                         the chunk loop re-reads
+        #                                         its own residual
         while done < max_iters and diff >= tol:
             if key.grid != self.grid():
                 raise ValueError(

@@ -201,11 +201,33 @@ def decode_converge(body: dict) -> tuple[Request, dict]:
     Same body as ``/v1/convolve`` minus ``iters``/``deadline_ms`` plus
     ``tol`` / ``max_iters`` / ``check_every``; ``quantize`` defaults to
     FALSE here (convergence runs float carries — the u8 store-back
-    semantics would clamp the diff trajectory)."""
+    semantics would clamp the diff trajectory).
+
+    Round 18 (durable jobs): ``resume_state: true`` asks every snapshot
+    row to carry its own resume token (``state_b64``/``state_shape`` —
+    the exact f32 carries, since the u8 image is lossy), and ``resume``
+    (a token dict: iters / diff / work_units / state_b64 / state_shape)
+    seeds the stream from that token instead of iteration 0 — the
+    mid-stream failover surface ``router.converge`` drives."""
     try:
         params = {"tol": float(body.get("tol", 1e-3)),
                   "max_iters": int(body.get("max_iters", 500)),
-                  "check_every": int(body.get("check_every", 10))}
+                  "check_every": int(body.get("check_every", 10)),
+                  "carry_state": bool(body.get("resume_state", False))}
+        token = body.get("resume")
+        if token is not None:
+            from parallel_convolution_tpu.serving import jobs
+
+            if not isinstance(token, dict):
+                raise ValueError("resume must be a token object")
+            params["resume"] = {
+                "iters": int(token.get("iters", 0)),
+                "diff": float(token.get("diff", float("inf"))),
+                "work_units": float(token.get("work_units", 0.0)),
+                "state": jobs.state_from_wire(
+                    token.get("state_b64") or "",
+                    token.get("state_shape") or ()),
+            }
     except (TypeError, ValueError) as e:
         raise ValueError(f"malformed request body: {e}") from e
     b = dict(body)
@@ -222,7 +244,7 @@ def encode_stream_row(row) -> dict:
         wire["kind"] = "rejected"
         return wire
     assert isinstance(row, Snapshot)
-    return {
+    out = {
         "kind": "final" if row.final else "snapshot",
         "ok": True,
         "iters": row.iters,
@@ -244,6 +266,14 @@ def encode_stream_row(row) -> dict:
         "plan_key": row.plan_key,
         "trace_id": row.trace_id,
     }
+    if row.state is not None:
+        # The resume-token payload (round 18): exact f32 carries, only
+        # when the job asked for durability (resume_state on the wire).
+        from parallel_convolution_tpu.serving import jobs
+
+        out["state_b64"], out["state_shape"] = jobs.state_to_wire(
+            row.state)
+    return out
 
 
 def drain_body(handler) -> None:

@@ -68,12 +68,24 @@ from parallel_convolution_tpu.resilience.breaker import (
 from parallel_convolution_tpu.serving.frontend import (
     InProcessClient, drain_body, send_json, send_ndjson_stream,
 )
+from parallel_convolution_tpu.serving.jobs import JobLedger, token_progress
 from parallel_convolution_tpu.serving.service import ReleasingStream
 
 __all__ = [
-    "HTTPReplica", "HashRing", "InProcessReplica", "ReplicaRouter",
-    "TenantQuotas", "TokenBucket", "make_router_http_server", "route_key",
+    "CorruptReplicaBody", "HTTPReplica", "HashRing", "InProcessReplica",
+    "ReplicaRouter", "TenantQuotas", "TokenBucket",
+    "make_router_http_server", "route_key",
 ]
+
+
+class CorruptReplicaBody(ConnectionError):
+    """A replica answered with bytes that do not parse as the protocol
+    (corrupt/truncated JSON).  A ``ConnectionError`` subclass ON
+    PURPOSE: ``resilience.retry.classify`` already calls that transient,
+    so the breaker/failover machinery treats a corrupting replica
+    exactly like a dead one — it must never escape the router as an
+    uncaught ``JSONDecodeError``.  The distinct type feeds the
+    per-replica ``corrupt_responses`` counter (``/stats``)."""
 
 
 # -- compile-key routing ------------------------------------------------------
@@ -315,8 +327,24 @@ class InProcessReplica:
 
     def converge(self, body: dict, timeout: float | None = None,
                  traceparent: str | None = None):
-        return self._live().converge(body, timeout=timeout,
-                                     traceparent=traceparent)
+        status, rows = self._live().converge(body, timeout=timeout,
+                                             traceparent=traceparent)
+        if status != 200:
+            return status, rows
+
+        def guarded():
+            # A killed process's chunked stream BREAKS — emulate that
+            # faithfully (without this, an in-process drill's kill would
+            # leave the already-attached generator silently computing on
+            # the closed service, and mid-stream failover would never be
+            # exercised the way a real host death exercises it).
+            for row in rows:
+                if self.client is None:
+                    raise ConnectionError(
+                        f"replica {self.name} died mid-stream")
+                yield row
+
+        return status, guarded()
 
     def readyz(self):
         return self._live().readyz()
@@ -394,7 +422,7 @@ class HTTPReplica:
             try:
                 return status, json.loads(r.read())
             except ValueError as e:
-                raise ConnectionError(
+                raise CorruptReplicaBody(
                     f"replica {self.name} sent unparseable body "
                     f"(http {status}): {e}") from e
 
@@ -417,8 +445,19 @@ class HTTPReplica:
                     for line in resp:   # http.client de-chunks for us
                         line = line.strip()
                         if line:
-                            yield json.loads(line)
-            except (OSError, ValueError) as e:
+                            try:
+                                yield json.loads(line)
+                            except ValueError as e:
+                                # Corrupt NDJSON line: typed transport
+                                # failure, flagged so the router's
+                                # corrupt_responses counter sees it.
+                                yield {"ok": False, "kind": "rejected",
+                                       "rejected": "replica_unavailable",
+                                       "retryable": True, "corrupt": True,
+                                       "detail": "stream corrupt: "
+                                                 f"{e}"[:300]}
+                                return
+            except OSError as e:
                 # TRANSPORT death, not a typed execution failure: the
                 # job itself may be fine elsewhere, so the row is
                 # retryable — unlike a replica-typed `error` row, which
@@ -437,12 +476,21 @@ class HTTPReplica:
         try:
             with urllib.request.urlopen(f"{self.base}{path}",
                                         timeout=timeout or self.timeout) as r:
-                return r.status, json.loads(r.read())
+                try:
+                    return r.status, json.loads(r.read())
+                except ValueError as ve:
+                    raise CorruptReplicaBody(
+                        f"replica {self.name} sent unparseable body "
+                        f"({path}): {ve}") from ve
         except urllib.error.HTTPError as e:
             try:
                 return e.code, json.loads(e.read())
             except Exception:  # noqa: BLE001
                 return e.code, {"ok": False}
+        except CorruptReplicaBody:
+            # Already typed — it must not be re-wrapped by the generic
+            # OSError handler below (CorruptReplicaBody IS an OSError).
+            raise
         except (urllib.error.URLError, OSError, TimeoutError) as e:
             raise ConnectionError(
                 f"replica {self.name} unreachable: {e}") from e
@@ -500,8 +548,15 @@ class _ReplicaState:
         self.ready = True          # optimistic until the first poll
         self.ready_payload: dict = {}
         self.in_flight = 0
+        # resumes counts durable converge jobs that resumed ONTO this
+        # replica; mid_stream_failovers counts streams that died ON it
+        # after rows flowed; corrupt_responses counts unparseable bodies
+        # it sent (CorruptReplicaBody / corrupt stream rows) — the
+        # operator-debuggable chaos-drill surface, exposed in /stats
+        # next to the autoscaler inputs.
         self.stats = {"routed": 0, "completed": 0, "sheds": 0,
-                      "failures": 0}
+                      "failures": 0, "resumes": 0,
+                      "mid_stream_failovers": 0, "corrupt_responses": 0}
 
 
 # Rejections that mean "no device work happened anywhere" — the tenant's
@@ -532,6 +587,7 @@ class ReplicaRouter:
                  breaker_cooldown_s: float = 1.0,
                  poll_interval_s: float = 0.25, load_factor: float = 2.0,
                  hedge_s: float | None = None, start_health: bool = True,
+                 durable: bool = True, job_capacity: int = 64,
                  clock=time.monotonic):
         if not replicas:
             raise ValueError("at least one replica required")
@@ -569,12 +625,20 @@ class ReplicaRouter:
 
         self._key_configs: "OrderedDict[str, dict]" = OrderedDict()
         self._key_configs_cap = 512
+        # Durable convergence jobs (round 18): the resume-token ledger.
+        # With durable=True (the default) every converge body is asked
+        # to carry per-row token state, mid-stream deaths fail over to
+        # the surviving ring candidates seeded from the newest token,
+        # and the final row is exactly-once per request_id.
+        self.durable = bool(durable)
+        self.jobs = JobLedger(capacity=job_capacity)
         self.stats = obs_metrics.MirroredStats(obs_metrics.gauge(
             "pctpu_router_stats", "replica-router admission/outcome counters",
             ("key",)), initial={
             "routed": 0, "completed": 0, "failovers": 0, "spills": 0,
             "hedges": 0, "rejected_tenant_quota": 0,
-            "rejected_unavailable": 0, "progressive": 0,
+            "rejected_unavailable": 0, "progressive": 0, "resumes": 0,
+            "mid_stream_failovers": 0,
         })
         self._closed = threading.Event()
         self._poll_thread: threading.Thread | None = None
@@ -698,6 +762,8 @@ class ReplicaRouter:
             rep.breaker.record_failure(e)
             with self._lock:
                 rep.stats["failures"] += 1
+                if isinstance(e, CorruptReplicaBody):
+                    rep.stats["corrupt_responses"] += 1
             self._record_counter(rep.name, "transport_error")
             if obs_metrics.enabled():
                 obs_events.emit("router", event="failover",
@@ -898,14 +964,141 @@ class ReplicaRouter:
             return results[0]
 
     # -- progressive ----------------------------------------------------------
+    def _converge_cost(self, body: dict) -> float:
+        """The admission charge for one converge job: with a pricer
+        armed, the predicted device-seconds of the REMAINING work — a
+        resumed job (body carries a token) is charged only for the
+        budget the token hasn't spent (the r17 refund rule, extended:
+        work already done was charged in the job's previous life)."""
+        if self.pricer is None:
+            return 1.0
+        done = token_progress(body.get("resume"))
+        if done > 0:
+            total = float(body.get("max_iters", 500) or 500)
+            remaining = max(1, int(total - done))
+            return self.pricer.price(dict(body, max_iters=remaining),
+                                     converge=True)
+        return self.pricer.price(body, converge=True)
+
+    def _converge_walk(self, key: str, body: dict, timeout, tp,
+                       tried: set):
+        """Walk ring candidates not yet ``tried`` with this job.
+
+        Returns ``("stream", rep, rows)`` on an attached 200 stream
+        (the replica's in-flight count already bumped), ``("pass",
+        status, wire)`` for a request's-own-fault typed outcome
+        (invalid/deadline/tenant — pass through verbatim), or
+        ``("reject", status, wire)`` when the walk exhausted (typed
+        retryable).  Pass 1 honors readiness + bounded load; pass 2
+        relaxes them only if pass 1 dispatched nothing — replicas
+        already in ``tried`` (they failed or shed THIS job) are never
+        re-submitted.
+        """
+        rid = body.get("request_id", "")
+        order = [n for n in self.ring.candidates(key) if n not in tried]
+        last = None
+        dispatched_any = False
+        for relaxed in (False, True):
+            if relaxed and dispatched_any:
+                break
+            bound = self._load_bound()
+            for name in order:
+                if name in tried:
+                    continue
+                rep = self._replicas.get(name)
+                if rep is None:   # removed mid-walk
+                    continue
+                if not relaxed and (not rep.ready
+                                    or rep.in_flight >= bound):
+                    self._bump("spills")
+                    continue
+                if not rep.breaker.allow():
+                    self._bump("spills")
+                    continue
+                dispatched_any = True
+                try:
+                    status, rows = rep.transport.converge(
+                        body, timeout=timeout, traceparent=tp)
+                except Exception as e:  # noqa: BLE001
+                    rep.breaker.record_failure(e)
+                    tried.add(name)
+                    self._bump("failovers")
+                    self._record_counter(rep.name, "transport_error")
+                    with self._lock:
+                        rep.stats["failures"] += 1
+                        if isinstance(e, CorruptReplicaBody):
+                            rep.stats["corrupt_responses"] += 1
+                    last = (503, {
+                        "kind": "rejected", "ok": False,
+                        "rejected": "replica_unavailable",
+                        "retryable": True, "request_id": rid,
+                        "retry_after_s": round(self.breaker_cooldown_s, 4),
+                        "detail": repr(e)[:200]})
+                    continue
+                if status != 200:
+                    first = list(rows)[:1]
+                    wire = first[0] if first else {"ok": False}
+                    reason = wire.get("rejected")
+                    if reason in _SPILL_REJECTS:
+                        rep.breaker.record_success()
+                        self._bump("spills")
+                        last = (status, wire)
+                        continue
+                    if reason == "error" or status >= 500:
+                        rep.breaker.record_failure()
+                        tried.add(name)
+                        self._bump("failovers")
+                        with self._lock:
+                            rep.stats["failures"] += 1
+                        last = (status, wire)
+                        continue
+                    # invalid / deadline / tenant-level outcomes: the
+                    # request's own fault — no ring walk helps, and it
+                    # is NOT replica-health evidence (same taxonomy as
+                    # `_try_one`).
+                    rep.breaker.record_success()
+                    return "pass", status, wire
+                rep.breaker.record_success()
+                self._record_counter(rep.name, "progressive")
+                # The stream counts against the replica's in-flight
+                # load for its WHOLE lifetime (progressive jobs are the
+                # longest-running work in the system — invisible to
+                # bounded-load spill, they'd pile onto one replica).
+                with self._lock:
+                    rep.in_flight += 1
+                    rep.stats["routed"] += 1
+                return "stream", rep, rows
+        if last is not None:
+            return "reject", last[0], last[1]
+        self._bump("rejected_unavailable")
+        return "reject", 503, {
+            "kind": "rejected", "ok": False,
+            "rejected": "replica_unavailable", "retryable": True,
+            "retry_after_s": round(self.breaker_cooldown_s, 4),
+            "request_id": rid,
+            "detail": f"no live replica among "
+                      f"{len(order)} candidates"}
+
     def converge(self, body: dict, timeout: float | None = None,
                  tenant: str | None = None):
         """Route one progressive convergence job; ``(status, rows)``.
 
-        Failover happens only BEFORE the first streamed row (a pre-stream
-        shed/failure walks the ring exactly like ``request``); once rows
-        flow, a mid-stream death ends the stream with a typed retryable
-        row — the client keeps its best-so-far snapshots.
+        Round 18 (durable jobs): with ``durable=True`` every snapshot
+        row the replica streams carries a bounded resume token (state
+        recorded in the router's :class:`~..serving.jobs.JobLedger`,
+        STRIPPED from the rows the client sees), and a mid-stream death
+        — transport break, typed ``error`` row, untyped 5xx — after
+        rows have flowed FAILS OVER to the remaining ring candidates
+        seeded from the newest token: the job continues on a surviving
+        replica from its last ``check_every``/V-cycle boundary instead
+        of ending the stream.  Rows after a resume stamp ``router:
+        {resumed_from, resume_count}``; the final row is exactly-once
+        per ``request_id`` (ledger-gated).  Only when NO candidate
+        remains does the stream end with the typed retryable row, and
+        the tenant is refunded the UNEXECUTED fraction of the admission
+        charge (quota meters work).  A client retry of that typed row
+        (same ``request_id``) resumes from the ledger's token — and is
+        admission-charged only for the remaining work.
         """
         body = dict(body)
         rid = body.get("request_id") or f"rt{next(self._ids)}"
@@ -914,8 +1107,32 @@ class ReplicaRouter:
         body["tenant"] = tenant
         self._bump("routed")
         self._bump("progressive")
-        cost = (self.pricer.price(body, converge=True)
-                if self.pricer is not None else 1.0)
+        key = route_key(body)
+        # The ledger identity is TENANT-SCOPED: request_id is
+        # client-stamped, and route_key carries neither tenant nor image
+        # content — without the scope, tenant B reusing tenant A's id on
+        # a same-config job would be seeded from A's private field state.
+        lid = f"{tenant}\x1f{rid}"
+        ledger_seeded = False
+        if self.durable:
+            # Ask replicas for per-row token state; seed a client retry
+            # from the ledger's newest token (explicit body tokens win).
+            body["resume_state"] = True
+            if "resume" not in body:
+                token = self.jobs.begin(lid, key)
+                if token is not None and not self._token_fits(token,
+                                                              body):
+                    # The retry changed the budget/cadence such that the
+                    # token's boundary is no longer legal (e.g. raising
+                    # max_iters past the old budget's short final
+                    # chunk): start fresh rather than fail the job
+                    # terminally 'invalid' on a token the CLIENT never
+                    # supplied.
+                    token = None
+                if token is not None:
+                    body["resume"] = token
+                    ledger_seeded = True
+        cost = self._converge_cost(body)
         with obs_trace.span("route", request_id=rid, tenant=tenant,
                             progressive=True) as sp:
             tid = sp.context.trace_id if sp.context is not None else ""
@@ -925,135 +1142,302 @@ class ReplicaRouter:
                 status, wire = shed
                 wire["kind"] = "rejected"
                 return status, iter([wire])
-            key = route_key(body)
+            # NOT observed into the warm-placement observatory: a
+            # converge job's warm state is its chunk/level programs,
+            # which warmup() cannot reproduce from these fields (the
+            # observatory is batch-path configs only, by design).
             tp = (obs_trace.format_traceparent(sp.context)
                   if sp.context is not None else None)
-            order = self.ring.candidates(key)
-            last = None
-            dispatched_any = False
-            for relaxed in (False, True):
-                if relaxed and dispatched_any:
-                    # Same rule as `_dispatch`: the relaxed pass exists
-                    # for when EVERY replica looked unready — replicas
-                    # already tried (and failed/shed) must not get the
-                    # same job re-submitted.
-                    break
-                bound = self._load_bound()
-                for name in order:
-                    rep = self._replicas.get(name)
-                    if rep is None:   # removed mid-walk
-                        continue
-                    if not relaxed and (not rep.ready
-                                        or rep.in_flight >= bound):
-                        self._bump("spills")
-                        continue
-                    if not rep.breaker.allow():
-                        self._bump("spills")
-                        continue
-                    dispatched_any = True
-                    try:
-                        status, rows = rep.transport.converge(
-                            body, timeout=timeout, traceparent=tp)
-                    except Exception as e:  # noqa: BLE001
-                        rep.breaker.record_failure(e)
-                        self._bump("failovers")
-                        self._record_counter(rep.name, "transport_error")
-                        last = (503, [{
-                            "kind": "rejected", "ok": False,
-                            "rejected": "replica_unavailable",
-                            "retryable": True, "request_id": rid,
-                            "detail": repr(e)[:200]}])
-                        continue
-                    if status != 200:
-                        first = list(rows)[:1]
-                        wire = first[0] if first else {}
-                        reason = wire.get("rejected")
-                        if reason in _SPILL_REJECTS:
-                            rep.breaker.record_success()
-                            self._bump("spills")
-                            last = (status, first or [{"ok": False}])
-                            continue
-                        if reason == "error" or status >= 500:
-                            rep.breaker.record_failure()
-                            self._bump("failovers")
-                            last = (status, first or [{"ok": False}])
-                            continue
-                        # invalid / deadline / tenant-level outcomes: the
-                        # request's own fault — no ring walk helps, and
-                        # it is NOT replica-health evidence (same
-                        # taxonomy as `_try_replica`).
-                        rep.breaker.record_success()
-                        sp.set(outcome=reason or "rejected")
-                        return status, iter(first or [{"ok": False}])
-                    rep.breaker.record_success()
-                    self._record_counter(rep.name, "progressive")
-                    sp.set(outcome="streaming", replica=name)
-                    # The stream counts against the replica's in-flight
-                    # load for its WHOLE lifetime (progressive jobs are
-                    # the longest-running work in the system — invisible
-                    # to bounded-load spill, they'd pile onto one
-                    # replica); released exactly once, even when the
-                    # caller drops the stream un-started.
-                    with self._lock:
-                        rep.in_flight += 1
-                        rep.stats["routed"] += 1
-                    released: list = []
-
-                    def release(rep=rep):
-                        with self._lock:
-                            if not released:
-                                released.append(True)
-                                rep.in_flight -= 1
-
-                    return 200, ReleasingStream(
-                        self._stream_through(rep, name, rows, release),
-                        release)
-            if last is not None:
+            tried: set[str] = set()
+            verdict, a, b = self._converge_walk(key, body, timeout, tp,
+                                                tried)
+            if verdict == "pass":
+                sp.set(outcome=b.get("rejected") or "rejected")
+                return a, iter([b])
+            if verdict == "reject":
+                sp.set(outcome=b.get("rejected") or "rejected")
                 # Same refund rule as `request`: the token comes back
                 # only when NO replica did work — a terminal `error`
                 # outcome executed on a device and stays charged.
-                wire = last[1][0] if last[1] else {}
                 if (self.quotas is not None
-                        and wire.get("rejected") in _REFUND_REJECTS):
+                        and b.get("rejected") in _REFUND_REJECTS):
                     self.quotas.refund(tenant, cost)
-                return last[0], iter(last[1])
-            self._bump("rejected_unavailable")
-            if self.quotas is not None:
-                self.quotas.refund(tenant, cost)
-            return 503, iter([{
-                "kind": "rejected", "ok": False,
-                "rejected": "replica_unavailable", "retryable": True,
-                "retry_after_s": round(self.breaker_cooldown_s, 4),
-                "request_id": rid, "detail": "no live replica"}])
+                return a, iter([b])
+            rep, rows = a, b
+            sp.set(outcome="streaming", replica=rep.name)
+            if ledger_seeded:
+                # A client retry resuming from the ledger is a resume
+                # too — counted and stamped like a mid-stream one ("the
+                # job left a dead stream"; the ledger doesn't know which
+                # replica died, the retry gap hides it).
+                self._record_resume(lid, key, rid, "client-retry", rep,
+                                    body["resume"])
+            # `hold` shares the live attempt between the durable driver
+            # and the release closure: released exactly once, for
+            # whichever replica currently carries the stream — even
+            # when the caller drops the stream un-started.
+            hold = {"rep": rep, "released": False}
 
-    def _stream_through(self, rep: _ReplicaState, name: str, rows,
-                        release):
-        """Pass replica stream rows through, stamping the router and
-        converting a mid-stream transport death into a typed retryable
-        ``replica_unavailable`` row (a replica-typed ``error`` row
-        passes through verbatim, retryable:false — the taxonomy
-        split)."""
-        got_final = False
-        try:
-            try:
-                for row in rows:
-                    row = dict(row)
-                    row["router"] = {"replica": name}
-                    got_final = got_final or row.get("kind") == "final"
-                    yield row
-            except Exception as e:  # noqa: BLE001 — mid-stream death
-                rep.breaker.record_failure(e)
-                yield {"kind": "rejected", "ok": False,
-                       "rejected": "replica_unavailable",
-                       "retryable": True, "detail": repr(e)[:300],
-                       "router": {"replica": name}}
-                return
-            if got_final:
-                self._bump("completed")
+            def release():
                 with self._lock:
-                    rep.stats["completed"] += 1
+                    if not hold["released"]:
+                        hold["released"] = True
+                        hold["rep"].in_flight -= 1
+
+            return 200, ReleasingStream(
+                self._stream_durable(key, body, timeout, tp, rid, lid,
+                                     tenant, cost, tried, hold, rows),
+                release)
+
+    @staticmethod
+    def _token_fits(token: dict, body: dict) -> bool:
+        """Is this ledger token a legal seed for THIS body's budget?
+        Jacobi tokens sit on check_every boundaries — or the minting
+        budget's own final short chunk, which a changed max_iters may
+        invalidate.  Multigrid tokens count V-CYCLES (every cycle is a
+        legal boundary; max_iters is a fine-grid WORK-UNIT budget), so
+        only the banked work must still fit the budget.
+        """
+        try:
+            solver = str(token.get("solver")
+                         or body.get("solver") or "jacobi")
+            mi = float(body.get("max_iters", 500) or 500)
+            if solver == "multigrid":
+                return token_progress(token) <= mi
+            it = int(token.get("iters", 0))
+            ce = max(1, int(body.get("check_every", 10) or 10))
+        except (TypeError, ValueError):
+            return False
+        return it <= mi and (it % ce == 0 or it == int(mi))
+
+    def _record_resume(self, lid: str, key: str, rid: str,
+                       from_name: str, to_rep, token: dict) -> None:
+        """One resume's bookkeeping — ledger note, counters, obs event —
+        shared by the mid-stream failover and client-retry paths so the
+        stamp/metric vocabulary cannot drift between them."""
+        n_res, _ = self.jobs.note_resume(lid, key, from_name)
+        self._bump("resumes")
+        with self._lock:
+            to_rep.stats["resumes"] += 1
+        if obs_metrics.enabled():
+            obs_metrics.counter(
+                "pctpu_converge_resumes_total",
+                "durable converge jobs resumed mid-stream on a "
+                "surviving replica", ("replica",)).inc(
+                replica=to_rep.name)
+            obs_events.emit(
+                "resume", request_id=rid, from_replica=from_name,
+                to_replica=to_rep.name,
+                at_iters=int(token.get("iters", 0)),
+                work_units=float(token.get("work_units", 0.0)),
+                resume_count=n_res)
+
+    def _switch_stream(self, hold, rep) -> None:
+        """Move the in-flight accounting from the dying replica to the
+        resumed one (the walk already bumped the newcomer)."""
+        with self._lock:
+            if not hold["released"]:
+                hold["rep"].in_flight -= 1
+            hold["rep"], hold["released"] = rep, False
+
+    def _note_mid_stream_death(self, rep: _ReplicaState, kind: str,
+                               detail: str, corrupt: bool) -> None:
+        """Breaker + counter bookkeeping for one mid-stream death."""
+        if kind == "resharding":
+            # Healthy-but-unable (a reshape window): spill semantics,
+            # not breaker food — but still a mid-stream failover.
+            rep.breaker.record_success()
+            self._bump("spills")
+        else:
+            rep.breaker.record_failure()
+            self._bump("failovers")
+        self._bump("mid_stream_failovers")
+        with self._lock:
+            rep.stats["mid_stream_failovers"] += 1
+            if kind != "resharding":
+                rep.stats["failures"] += 1
+            if corrupt:
+                rep.stats["corrupt_responses"] += 1
+        self._record_counter(rep.name, "mid_stream_death")
+        if obs_metrics.enabled():
+            obs_events.emit("router", event="mid_stream_death",
+                            replica=rep.name, reason=kind,
+                            detail=detail[:200])
+
+    def _stream_durable(self, key: str, body: dict, timeout, tp,
+                        rid: str, lid: str, tenant: str, cost: float,
+                        tried: set, hold: dict, rows):
+        """The durable stream driver: pass rows through (token recorded,
+        state stripped, router stamped), and on a mid-stream death walk
+        the remaining ring candidates with the newest resume token until
+        the job finishes or no candidate remains."""
+        wu_start = token_progress(body.get("resume"))
+        budget = float(body.get("max_iters", 500) or 500)
+        wu_last = wu_start
+        rows_flowed = 0
+        try:
+            while True:
+                rep = hold["rep"]
+                death = None   # (reason, detail, corrupt, row|None)
+                try:
+                    for row in rows:
+                        row = dict(row)
+                        if row.get("kind") == "rejected":
+                            reason = row.get("rejected")
+                            if reason in ("error", "replica_unavailable",
+                                          "resharding"):
+                                death = (reason,
+                                         str(row.get("detail", ""))[:300],
+                                         bool(row.get("corrupt")), row)
+                                break
+                            # invalid / tenant-level mid-stream rows: the
+                            # request's own story — pass through and stop.
+                            row.setdefault("router",
+                                           {"replica": rep.name})
+                            yield row
+                            return
+                        if self.durable:
+                            self.jobs.observe(lid, key, row)
+                            row.pop("state_b64", None)
+                            row.pop("state_shape", None)
+                        wu_last = max(wu_last, float(
+                            row.get("work_units", 0.0) or 0.0))
+                        rows_flowed += 1
+                        stamp = {"replica": rep.name}
+                        n_res, res_from = self.jobs.resume_info(lid)
+                        if n_res:
+                            stamp["resume_count"] = n_res
+                            stamp["resumed_from"] = res_from
+                        row["router"] = stamp
+                        if row.get("kind") == "final":
+                            if (self.durable
+                                    and not self.jobs.finalize(lid)):
+                                # Exactly-once: a concurrent stream for
+                                # the same id already delivered the
+                                # final.  End THIS stream with a typed
+                                # terminal row — every stream must end
+                                # in a final or a typed rejection (a
+                                # silent EOF would let a client take
+                                # its last snapshot for the result) —
+                                # and never via the death classifier,
+                                # which would charge a healthy replica
+                                # a breaker failure for a completed job.
+                                yield {
+                                    "kind": "rejected", "ok": False,
+                                    "rejected": "error",
+                                    "retryable": False,
+                                    "request_id": rid,
+                                    "detail": "request_id collision: "
+                                              "the final row was "
+                                              "already delivered to a "
+                                              "concurrent stream for "
+                                              "this id",
+                                    "router": {"replica": rep.name}}
+                                return
+                            self._bump("completed")
+                            with self._lock:
+                                rep.stats["completed"] += 1
+                            yield row
+                            return
+                        yield row
+                    else:
+                        # Loop EXHAUSTED (no typed-death break): the
+                        # stream ended without a final row — treat as a
+                        # transport death (a half-closed HTTP stream can
+                        # end cleanly mid-job).  Must be the for's else:
+                        # after a typed-death break this line would
+                        # clobber the captured death row.
+                        death = ("replica_unavailable",
+                                 "stream ended early", False, None)
+                except Exception as e:  # noqa: BLE001 — mid-stream death
+                    death = ("replica_unavailable", repr(e)[:300],
+                             isinstance(e, CorruptReplicaBody), None)
+                reason, detail, corrupt, death_row = death
+                self._note_mid_stream_death(rep, reason, detail, corrupt)
+                # This replica failed THIS job mid-stream: the resume
+                # walk must not hand the job straight back to it.
+                tried.add(rep.name)
+                token = (self.jobs.token(lid, key)
+                         if self.durable else None)
+                if self.durable and (token is not None
+                                     or rows_flowed == 0):
+                    resume_body = dict(body)
+                    if token is not None:
+                        resume_body["resume"] = token
+                    verdict, a, b = self._converge_walk(
+                        key, resume_body, timeout, tp, tried)
+                    if verdict == "stream":
+                        self._switch_stream(hold, a)
+                        rows = b
+                        if token is not None:
+                            # resumed_from names the DYING replica (the
+                            # one the job left), per stamp contract.
+                            self._record_resume(lid, key, rid, rep.name,
+                                                a, token)
+                        continue
+                    if verdict == "pass":
+                        b.setdefault("router", {"replica": ""})
+                        yield b
+                        return
+                    # Walk exhausted.  A NON-retryable typed death (a
+                    # replica-typed `error` row — possibly reproduced on
+                    # every candidate the walk just tried) must pass
+                    # through verbatim, retryable:false: reporting it as
+                    # a retryable `replica_unavailable` would send the
+                    # client into an infinite retry loop re-executing a
+                    # deterministic failure (the r14 taxonomy split).
+                    if (death_row is not None
+                            and not death_row.get("retryable", False)):
+                        end_row = death_row
+                    else:
+                        end_row = b
+                elif death_row is not None:
+                    # Non-resumable typed death: the replica's own row
+                    # passes through (trace_id and detail intact), with
+                    # a Retry-After hint where the reason is retryable.
+                    end_row = death_row
+                    if end_row.get("retryable"):
+                        end_row.setdefault(
+                            "retry_after_s",
+                            round(self.breaker_cooldown_s, 4))
+                else:
+                    end_row = {
+                        "kind": "rejected", "ok": False,
+                        "rejected": reason,
+                        "retryable": reason != "error",
+                        "retry_after_s": round(
+                            self.breaker_cooldown_s, 4),
+                        "request_id": rid, "detail": detail}
+                # No candidate left: refund the UNEXECUTED fraction of
+                # the admission charge (with a pricer armed, cost covers
+                # [wu_start, budget]; without one, keep the r14 rule —
+                # refund only when NO replica did work).
+                if self.quotas is not None:
+                    if self.pricer is not None:
+                        denom = max(budget - wu_start, 1e-9)
+                        frac = max(0.0, min(1.0,
+                                            (budget - wu_last) / denom))
+                        if frac > 0:
+                            self.quotas.refund(tenant, cost * frac)
+                    elif (rows_flowed == 0
+                          and end_row.get("rejected") in _REFUND_REJECTS):
+                        self.quotas.refund(tenant, cost)
+                n_res, res_from = self.jobs.resume_info(lid)
+                stamp = {"replica": ""}
+                if n_res:
+                    stamp["resume_count"] = n_res
+                    stamp["resumed_from"] = res_from
+                end_row["router"] = {**stamp,
+                                     **end_row.get("router", {})}
+                yield end_row
+                return
         finally:
-            release()
+            # Generator-exhaustion release twin of the wrapper closure:
+            # whichever runs first wins (hold["released"] gates both).
+            with self._lock:
+                if not hold["released"]:
+                    hold["released"] = True
+                    hold["rep"].in_flight -= 1
 
     # -- pool mutation (autoscaling) ------------------------------------------
     def add_replica(self, transport, join_ring: bool = True) -> None:
@@ -1193,6 +1577,9 @@ class ReplicaRouter:
             "replicas": per,
             "ring": sorted(members),
             "observed_keys": len(self._key_configs),
+            # Durable-job ledger (round 18): live tokens + total resumes
+            # — the chaos-drill operator surface.
+            "jobs": self.jobs.snapshot(),
             **({"tenants": self.quotas.snapshot()}
                if self.quotas is not None else {}),
         }

@@ -203,6 +203,14 @@ class Snapshot:
     col_mode: str = "packed"         # the compiled program's RESOLVED
     #                                  column-slab transport (same
     #                                  stamping rule as batch responses)
+    state: np.ndarray | None = None  # the FLOAT32 field at the valid
+    #                                  extent — the resume-token payload
+    #                                  (round 18), carried only when the
+    #                                  job asked for it (resume_state on
+    #                                  the wire): the u8 ``image`` is
+    #                                  lossy, so durability needs the
+    #                                  exact carries.  Final rows never
+    #                                  carry it (nothing left to resume).
 
     ok = True
 
@@ -630,7 +638,9 @@ class ConvolutionService:
 
     # -- progressive convergence ---------------------------------------------
     def submit_progressive(self, req: Request, *, tol: float,
-                           max_iters: int, check_every: int = 10):
+                           max_iters: int, check_every: int = 10,
+                           resume: dict | None = None,
+                           carry_state: bool = False):
         """Admit one progressive convergence job.
 
         Returns an immediate :class:`Rejected` (invalid / resharding /
@@ -642,6 +652,21 @@ class ConvolutionService:
         snapshots already streamed, which is the point: a long Jacobi job
         interrupted by a fault or a mesh reshape has delivered its
         best-so-far image plus the diff trajectory, not a timeout.
+
+        ``resume`` (round 18) seeds the stream from a resume token
+        instead of iteration 0: a dict with ``iters``/``work_units``
+        (how far the dead stream got — a ``check_every``/V-cycle
+        boundary), ``diff`` (the residual there), and ``state`` (the
+        DECODED (C, H, W) float32 field; ``frontend.decode_converge``
+        decodes the wire form).  ``max_iters`` keeps meaning the job's
+        TOTAL budget.  The token's field reshards onto THIS service's
+        grid in ``_prepare`` (crop + zero-re-pad is bit-exact — the
+        checkpoint-reshard invariant), so resume works across replicas
+        holding different meshes; because chunk math re-aligns on the
+        same boundaries, the resumed final row is byte-identical to the
+        uninterrupted run's.  ``carry_state=True`` makes every snapshot
+        row carry its own token state (what a durability-aware router
+        asks for via the wire's ``resume_state``).
 
         Progressive jobs bypass the micro-batcher (chunk fences make them
         incompatible with co-batching) and are bounded by
@@ -680,6 +705,8 @@ class ConvolutionService:
                         req, iters=(1 if req.solver == "multigrid"
                                     else check_every)),
                     progressive=True)
+                resume = self._validate_resume(resume, key, planar,
+                                               check_every, max_iters)
             except Exception as e:  # noqa: BLE001 — typed contract errors
                 asp.set(outcome="invalid")
                 return self._shed("invalid", rid, detail=str(e),
@@ -701,8 +728,45 @@ class ConvolutionService:
         release = self._progressive_release()
         return ReleasingStream(
             self._progressive_stream(req, rid, key, planar, tol,
-                                     max_iters, check_every, root, release),
+                                     max_iters, check_every, root, release,
+                                     resume=resume,
+                                     carry_state=carry_state),
             release)
+
+    @staticmethod
+    def _validate_resume(resume, key, planar, check_every, max_iters):
+        """Normalize/validate one resume token against the admitted key
+        (terminal ValueError → the typed ``invalid`` rejection).
+        Returns ``None`` or ``{"iters", "diff", "work_units", "state"}``
+        with ``state`` a (C, H, W) float32 array."""
+        if resume is None:
+            return None
+        state = np.asarray(resume.get("state"), dtype=np.float32)
+        if state.shape != tuple(planar.shape):
+            raise ValueError(
+                f"resume state shape {state.shape} does not match the "
+                f"request's planar shape {tuple(planar.shape)}")
+        iters = int(resume.get("iters", 0))
+        wu = float(resume.get("work_units", iters))
+        diff = float(resume.get("diff", float("inf")))
+        if iters < 0 or wu < 0:
+            raise ValueError(
+                f"resume iters/work_units must be >= 0, got "
+                f"{iters}/{wu}")
+        if (key.solver == "jacobi" and iters % max(1, check_every)
+                and iters != int(max_iters)):
+            # Tokens are minted on chunk boundaries; an off-boundary
+            # token would silently change the remaining chunk math and
+            # break the byte-identity contract — reject it typed.  The
+            # one legitimate off-multiple boundary is max_iters itself:
+            # the final chunk is short when the budget is not a
+            # check_every multiple, and its token (a stream that died
+            # between the last snapshot and the final row) must resume.
+            raise ValueError(
+                f"resume iters={iters} is not a check_every="
+                f"{check_every} boundary")
+        return {"iters": iters, "diff": diff, "work_units": wu,
+                "state": state}
 
     def _progressive_release(self):
         """One idempotent slot-release closure per admitted job: called
@@ -719,7 +783,8 @@ class ConvolutionService:
         return release
 
     def _progressive_stream(self, req, rid, key, planar, tol, max_iters,
-                            check_every, root, release):
+                            check_every, root, release, resume=None,
+                            carry_state=False):
         """The admitted job's generator (runs on the CONSUMER's thread)."""
         from parallel_convolution_tpu.utils import imageio
 
@@ -738,14 +803,29 @@ class ConvolutionService:
                 yield self._shed("error", rid, detail=repr(e)[:300],
                                  counter="rejected_error", trace=root)
                 return
+            # A resumed job seeds from the token's field and counters
+            # instead of iteration 0; `last*` start at the token so a
+            # token that already met the budget/tolerance still emits
+            # its (byte-identical) final row below.
+            start_field, start_done, start_wu = planar, 0, 0.0
+            last_out, last = None, None
+            if resume is not None:
+                start_field = resume["state"]
+                start_done = resume["iters"]
+                start_wu = resume["work_units"]
+                last_out = start_field
+                last = (start_done, resume["diff"], start_wu)
             with obs_trace.attach(root), obs_trace.span(
                     "progressive", request_id=rid, backend=req.backend,
-                    check_every=check_every) as psp:
-                last_out, last = None, None
+                    check_every=check_every,
+                    resumed_at=start_done) as psp:
                 try:
                     for out, done, diff, wu in self.engine.run_converge(
-                            key, planar, tol=tol, max_iters=max_iters,
-                            check_every=check_every):
+                            key, start_field, tol=tol, max_iters=max_iters,
+                            check_every=check_every, start_done=start_done,
+                            start_wu=start_wu,
+                            start_diff=(last[1] if last is not None
+                                        else float("inf"))):
                         last_out, last = out, (done, diff, wu)
                         yield Snapshot(
                             image=to_u8(out), iters=done, diff=diff,
@@ -755,7 +835,8 @@ class ConvolutionService:
                             trace_id=tid, solver=key.solver,
                             work_units=round(float(wu), 3),
                             mg_levels=entry.mg_levels,
-                            col_mode=entry.effective_col_mode)
+                            col_mode=entry.effective_col_mode,
+                            state=(out if carry_state else None))
                 except Exception as e:  # noqa: BLE001 — typed stream end
                     reason = ("resharding"
                               if ("resharded" in str(e) or self._reshaping)

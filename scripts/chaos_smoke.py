@@ -1,0 +1,328 @@
+#!/usr/bin/env python
+"""Chaos-transport smoke: the ``run_t1.sh --chaos-smoke`` leg (round 18).
+
+Boot THREE in-process replicas behind the durable router, every
+transport wrapped in :class:`serving.chaos.ChaosTransport`, and drive
+mixed batch/converge traffic under a SEEDED transport-fault schedule
+(``PCTPU_FAULTS`` transport sites: send drops, latency, lost responses,
+corrupt bodies, flapping readiness, mid-stream disconnects) plus a
+mid-stream replica KILL.  Gates, in order of importance:
+
+1. **zero non-rejected failures** — every request/job either completed
+   or ended in a typed RETRYABLE rejection (client backoff honored);
+2. every completed batch response and every completed converge FINAL row
+   **byte-identical to the uninterrupted oracle run**;
+3. **>= 1 observed mid-stream resume** — a converge job continued on a
+   surviving replica from its ledger token after its stream died
+   (including the killed-replica drill), with the ``router:
+   {resumed_from, resume_count}`` stamp client-visible;
+4. **exactly one final row per request_id** (the exactly-once ledger
+   gate, asserted across every stream this smoke consumed);
+5. **resumed jobs' tenant charge equals incremental work only** — with
+   the pricer armed and a frozen quota clock, the whole
+   die-resume-complete saga costs ONE uninterrupted job's units;
+6. **counters consistent with the injected schedule** — corrupt
+   responses, mid-stream failovers and resumes in ``/stats`` match what
+   the chaos wrappers report injecting.
+
+The summary row lands in ``--out`` (``evidence/chaos_smoke.json``) with
+``"failures": 0`` iff every gate held, then feeds ``perf_gate.py``
+against the smoke's OWN history file (seed + re-gate — never the
+committed ``evidence/perf_history.jsonl``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import _path  # noqa: F401  (repo root + JAX_PLATFORMS re-apply)
+
+SCRIPTS = Path(__file__).resolve().parent
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n", type=int, default=30,
+                    help="batch requests under chaos")
+    ap.add_argument("--rows", type=int, default=40)
+    ap.add_argument("--cols", type=int, default=56)
+    ap.add_argument("--mesh", default="1x2", help="grid per replica")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default="evidence/chaos_smoke.json")
+    ap.add_argument("--history",
+                    default="evidence/chaos_smoke_history.jsonl",
+                    help="the smoke's OWN perf history, seeded fresh "
+                         "each run; never the committed "
+                         "evidence/perf_history.jsonl")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from _chaos_common import (
+        chaos_pool, converge_body as _cbody, oracle_converge_final,
+        request_with_backoff,
+    )
+    from parallel_convolution_tpu.obs import events as obs_events
+    from parallel_convolution_tpu.ops import filters, oracle
+    from parallel_convolution_tpu.parallel.mesh import mesh_from_spec
+    from parallel_convolution_tpu.resilience import faults
+    from parallel_convolution_tpu.serving.pricing import WorkPricer
+    from parallel_convolution_tpu.serving.router import ReplicaRouter, TenantQuotas
+    from parallel_convolution_tpu.serving.service import ConvolutionService
+    from parallel_convolution_tpu.utils import imageio
+
+    obs_events.install_from_env()
+    failures: list[str] = []
+    t0 = time.time()
+    img = imageio.generate_test_image(args.rows, args.cols, "grey",
+                                      seed=7)
+    b64 = base64.b64encode(np.ascontiguousarray(img).tobytes()).decode()
+    iters_pool = [1, 2, 3]
+    oracles = {it: oracle.run_serial_u8(
+        img, filters.get_filter("blur3"), it) for it in iters_pool}
+
+    def batch_body(i: int) -> dict:
+        return {"image_b64": b64, "rows": args.rows, "cols": args.cols,
+                "mode": "grey", "filter": "blur3",
+                "iters": iters_pool[i % len(iters_pool)],
+                "request_id": f"cb{i}", "tenant": "drill"}
+
+    def converge_body(rid: str) -> dict:
+        return _cbody(b64, args.rows, args.cols, rid, tenant="drill")
+
+    def factory():
+        return ConvolutionService(mesh_from_spec(args.mesh),
+                                  max_delay_s=0.002, max_queue=256)
+
+    # ---- the uninterrupted ORACLE converge run (clean router, no chaos)
+    try:
+        oracle_final = oracle_converge_final(factory,
+                                             converge_body("oracle"))
+    except RuntimeError as e:
+        failures.append(str(e))
+        oracle_final = {}
+
+    # ---- the chaos pool: per-replica failure shapes over one seeded
+    # schedule (hit-indexed — replayable bit-for-bit).
+    reps = chaos_pool(factory, args.seed)
+    clock = [0.0]   # frozen quota clock: exact charge arithmetic
+    quotas = TenantQuotas(rate=1.0, burst=1e6, clock=lambda: clock[0])
+    pricer = WorkPricer(min_units=1e-9)
+    router = ReplicaRouter(reps, quotas=quotas, pricer=pricer,
+                           breaker_threshold=3, breaker_cooldown_s=0.2,
+                           poll_interval_s=0.05)
+    finals_per_rid: dict[str, int] = {}
+
+    def drain(rows):
+        out = []
+        for r in rows:
+            out.append(r)
+            if r.get("kind") == "final":
+                rid = r.get("request_id", "")
+                finals_per_rid[rid] = finals_per_rid.get(rid, 0) + 1
+        return out
+
+    # ---- phase 1: batch traffic under the seeded schedule -----------------
+    plan = faults.plan_from_spec(
+        "transport_send:2,transport_recv:4,readyz_probe:3",
+        seed=args.seed)
+    batch_completed = batch_failovers = 0
+    non_rejected: list[dict] = []
+    byte_fails = 0
+    with faults.injected(plan):
+        for i in range(args.n):
+            wire = request_with_backoff(router, batch_body(i))
+            if wire.get("ok"):
+                batch_completed += 1
+                if wire["router"].get("failovers", 0) > 0:
+                    batch_failovers += 1
+                got = np.frombuffer(base64.b64decode(wire["image_b64"]),
+                                    np.uint8).reshape(img.shape)
+                it = iters_pool[i % len(iters_pool)]
+                if not np.array_equal(got, oracles[it]):
+                    byte_fails += 1
+            elif not wire.get("retryable"):
+                non_rejected.append({"i": i, "wire": {
+                    k: v for k, v in wire.items() if k != "image_b64"}})
+    if byte_fails:
+        failures.append(f"{byte_fails} batch oracle byte mismatches")
+    if non_rejected:
+        failures.append(f"{len(non_rejected)} non-rejected batch "
+                        f"failures, e.g. {non_rejected[0]}")
+    if batch_completed < args.n - 2:
+        failures.append(
+            f"only {batch_completed}/{args.n} batch requests completed")
+
+    # ---- phase 1b: a corrupt body, deterministically ----------------------
+    # Route a request whose consistent-hash HOME is the corrupt-mode
+    # replica (c1) and fire its recv site: the router must classify the
+    # garbage typed (breaker food + failover), count it, and still
+    # complete the request on a survivor.
+    from parallel_convolution_tpu.serving.router import route_key
+
+    corrupt_body = None
+    for j in range(1, 65):   # iters is a route-key field: 64 ring points
+        cand = dict(batch_body(0), request_id=f"corrupt{j}", iters=j)
+        if router.ring.candidates(route_key(cand))[0] == "c1":
+            corrupt_body = cand
+            break
+    if corrupt_body is None:
+        failures.append("could not find a key homed on c1")
+    else:
+        with faults.injected("transport_recv:1", seed=args.seed):
+            status, wire = router.request(corrupt_body)
+        if not wire.get("ok"):
+            failures.append(f"corrupt-leg request failed: {wire}")
+        elif wire["router"].get("failovers", 0) < 1:
+            failures.append("corrupt body caused no failover walk")
+        else:
+            got = np.frombuffer(base64.b64decode(wire["image_b64"]),
+                                np.uint8).reshape(img.shape)
+            want = oracle.run_serial_u8(
+                img, filters.get_filter("blur3"),
+                corrupt_body["iters"])
+            if not np.array_equal(got, want):
+                failures.append(
+                    "corrupt-leg completion not byte-identical")
+
+    # ---- phase 2: converge under mid-stream disconnects -------------------
+    level0 = quotas.bucket("drill").level()
+    resumed_jobs = 0
+    with faults.injected("transport_stream:3", seed=args.seed):
+        st, rows = router.converge(converge_body("cv-chaos"))
+        got = drain(rows)
+    final = got[-1]
+    if final.get("kind") != "final":
+        failures.append(f"chaos converge did not finish: {final}")
+    else:
+        if final.get("router", {}).get("resume_count", 0) < 1:
+            failures.append("chaos converge never resumed "
+                            f"(router stamp: {final.get('router')})")
+        else:
+            resumed_jobs += 1
+        if final.get("image_b64") != oracle_final.get("image_b64"):
+            failures.append("resumed converge final row is NOT "
+                            "byte-identical to the oracle run")
+    # Incremental-charge gate: the die-resume-complete saga must cost
+    # exactly ONE uninterrupted job (frozen clock: no refill slack).
+    charged = level0 - quotas.bucket("drill").level()
+    one_job = pricer.price(converge_body("price-ref"), converge=True)
+    if not (0.85 * one_job <= charged <= 1.15 * one_job):
+        failures.append(
+            f"resumed job charged {charged:.3g} units, expected one "
+            f"uninterrupted job's {one_job:.3g} (incremental rule)")
+
+    # ---- phase 3: the mid-stream replica KILL drill -----------------------
+    st, rows = router.converge(converge_body("cv-kill"))
+    it = iter(rows)
+    first = next(it)
+    victim = first.get("router", {}).get("replica", "")
+    router.replica(victim).kill()
+    obs_events.emit("router", event="kill", replica=victim)
+    got = drain([first, *it])
+    final = got[-1]
+    if final.get("kind") != "final":
+        failures.append(f"kill-drill converge did not finish: {final}")
+    else:
+        stamp = final.get("router", {})
+        if stamp.get("resume_count", 0) < 1 or victim not in stamp.get(
+                "resumed_from", []):
+            failures.append(
+                f"kill drill: no resume off {victim!r} ({stamp})")
+        else:
+            resumed_jobs += 1
+        if final.get("image_b64") != oracle_final.get("image_b64"):
+            failures.append("kill-drill final row is NOT byte-identical "
+                            "to the oracle run")
+    router.replica(victim).revive()
+
+    # ---- gates over the whole run -----------------------------------------
+    dup_finals = {rid: n for rid, n in finals_per_rid.items() if n != 1}
+    if dup_finals:
+        failures.append(
+            f"exactly-once final rows violated: {dup_finals}")
+    snap = router.snapshot()
+    injected = {}
+    for rep in reps:
+        for site, n in rep.injected.items():
+            injected[site] = injected.get(site, 0) + n
+    corrupt_counted = sum(p["corrupt_responses"]
+                          for p in snap["replicas"].values())
+    if corrupt_counted < 1:
+        # Phase 1b injected a corrupt body at c1 deterministically: the
+        # router MUST have counted it.
+        failures.append(
+            "corrupt body injected but corrupt_responses counter flat")
+    if snap["router"]["resumes"] < resumed_jobs:
+        failures.append(
+            f"router resumes counter {snap['router']['resumes']} < "
+            f"observed resumed jobs {resumed_jobs}")
+    if snap["router"]["mid_stream_failovers"] < resumed_jobs:
+        failures.append("mid_stream_failovers counter inconsistent "
+                        f"({snap['router']['mid_stream_failovers']} < "
+                        f"{resumed_jobs})")
+    if resumed_jobs < 1:
+        failures.append("no mid-stream resume observed anywhere")
+    if not injected:
+        failures.append("the chaos schedule injected nothing "
+                        "(dead drill proves nothing)")
+
+    wall = time.time() - t0
+    px = args.rows * args.cols * (
+        sum(iters_pool[i % len(iters_pool)] for i in range(args.n))
+        + 2 * 40)   # two 40-iteration converge jobs
+    row = {
+        "workload": f"chaos-smoke blur3+jacobi3 {args.rows}x{args.cols} "
+                    "3 replicas seeded-transport-faults kill-1",
+        "n": args.n + 2,
+        "batch_completed": batch_completed,
+        "batch_failovers": batch_failovers,
+        "resumes_observed": resumed_jobs,
+        "router_resumes": snap["router"]["resumes"],
+        "mid_stream_failovers": snap["router"]["mid_stream_failovers"],
+        "corrupt_responses": corrupt_counted,
+        "chaos_injected": injected,
+        "finals_per_request": {k: v for k, v in finals_per_rid.items()},
+        "charged_units": round(charged, 6),
+        "one_job_units": round(one_job, 6),
+        "jobs_ledger": snap["jobs"],
+        "killed": victim,
+        "effective_backend": "shifted",
+        "mesh": args.mesh,
+        "wall_s": round(wall, 3),
+        "gpixels_per_s": round(px / wall / 1e9, 6) if wall else None,
+        "failures": len(failures),
+        "failure_detail": failures[:8],
+    }
+    router.close()
+
+    # ---- perf sentry feed: seed the smoke's own history, then re-gate.
+    out = Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(row, indent=2))
+    hist = Path(args.history)
+    hist.parent.mkdir(parents=True, exist_ok=True)
+    hist.write_text("")   # the smoke's OWN history: truncate per run
+    gate = [sys.executable, str(SCRIPTS / "perf_gate.py"),
+            "--history", str(hist), "--row", str(out), "--quiet"]
+    rc_seed = subprocess.run([*gate, "--update"], check=False).returncode
+    rc_pass = subprocess.run(gate, check=False).returncode
+    if rc_seed != 0:
+        failures.append(f"perf_gate seed run exited {rc_seed}")
+    if rc_pass != 0:
+        failures.append(f"perf_gate re-gate exited {rc_pass}")
+    row["failures"] = len(failures)
+    row["failure_detail"] = failures[:10]
+    out.write_text(json.dumps(row, indent=2))
+    print(json.dumps(row), flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

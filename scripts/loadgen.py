@@ -144,6 +144,15 @@ class _HTTPTransport:
                 return e.code, json.loads(e.read())
             except Exception:  # noqa: BLE001
                 return e.code, {"ok": False, "detail": f"http {e.code}"}
+        except (OSError, ValueError) as e:
+            # The stream broke (or corrupted) mid-drain: a typed
+            # RETRYABLE outcome, not a client crash — the retry loop
+            # re-submits the job and a durable router resumes it from
+            # its ledger token instead of iteration 0.
+            return 200, {"ok": False, "kind": "rejected",
+                         "rejected": "replica_unavailable",
+                         "retryable": True,
+                         "detail": f"stream broke: {e}"[:300]}
 
     def snapshot(self) -> dict:
         import urllib.request
@@ -576,6 +585,13 @@ def main() -> int:
         row["rows_streamed_mean"] = (round(statistics.mean(
             [r.get("rows_streamed", 0) for _, r in completed]), 1)
             if completed else None)
+        # Durable-job visibility (round 18): final rows whose router
+        # stamp says the job resumed on a surviving replica mid-stream
+        # — the client-observable proof that device-seconds already
+        # spent were NOT re-run from iteration 0.
+        row["resumes_observed"] = sum(
+            1 for _, r in completed
+            if r.get("router", {}).get("resume_count", 0) > 0)
     if want is not None:
         row["oracle_mismatches"] = mismatches
     try:

@@ -59,6 +59,30 @@
 #                                      FAIL.  Row (failures: 0) lands in
 #                                      evidence/scale_smoke.json (the
 #                                      supervisor leg's done_file).
+#   scripts/run_t1.sh --chaos-smoke    durable convergence jobs + chaos
+#                                      transport (round 18): 3 in-process
+#                                      replicas behind the durable router,
+#                                      every transport chaos-wrapped;
+#                                      mixed batch/converge traffic under
+#                                      a seeded transport-fault schedule
+#                                      (drops, latency, lost/corrupt
+#                                      responses, flapping readiness,
+#                                      mid-stream disconnects) plus a
+#                                      mid-stream replica kill.  Gates:
+#                                      zero non-rejected failures, every
+#                                      completion byte-identical to the
+#                                      uninterrupted oracle (incl. RESUMED
+#                                      converge finals), >= 1 mid-stream
+#                                      resume, exactly one final row per
+#                                      request_id, resumed jobs charged
+#                                      incremental work only, chaos
+#                                      counters consistent with the
+#                                      injected schedule, and the summary
+#                                      row passes perf_gate.py against the
+#                                      smoke's own history.  Row
+#                                      (failures: 0) lands in
+#                                      evidence/chaos_smoke.json (the
+#                                      supervisor leg's done_file).
 #   scripts/run_t1.sh --serving-smoke  boot the in-process serving stack on
 #                                      the 8-virtual-device CPU mesh, push
 #                                      50 loadgen requests, exit nonzero on
@@ -198,6 +222,14 @@ if [ "${1:-}" = "--scale-smoke" ]; then
     PCTPU_OBS=1 \
     python scripts/scale_smoke.py --rows 48 --cols 64 --mesh 1x2 \
       --out evidence/scale_smoke.json
+fi
+
+if [ "${1:-}" = "--chaos-smoke" ]; then
+  exec timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    PCTPU_OBS=1 \
+    python scripts/chaos_smoke.py --n 30 --rows 40 --cols 56 \
+      --mesh 1x2 --out evidence/chaos_smoke.json
 fi
 
 if [ "${1:-}" = "--router-smoke" ]; then

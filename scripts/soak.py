@@ -54,6 +54,19 @@ response byte-identical to the oracle — the serve-through-any-single-
 replica-failure property, plus at least one client-observed failover:
 
   python scripts/soak.py --router-kill 3 --seed 0
+
+``--chaos N`` is the CHAOS-TRANSPORT drill (round 18): three in-process
+replicas behind the durable router, every transport wrapped in
+``serving.chaos.ChaosTransport``; each of the N cycles samples a seeded
+transport-fault schedule (send drops/latency/black-holes, lost and
+corrupt responses, flapping readiness, mid-stream disconnects) and
+drives mixed batch + converge traffic through it, killing the serving
+replica mid-stream on even cycles.  Gates per run: zero non-rejected
+failures, every completed batch response AND converge final row
+byte-identical to the uninterrupted oracle, >= 1 mid-stream resume
+observed, exactly one final row per request_id:
+
+  python scripts/soak.py --chaos 4 --seed 0
 """
 
 from __future__ import annotations
@@ -491,6 +504,151 @@ def run_router_kill(args) -> int:
     return 1 if failures else 0
 
 
+def run_chaos_drill(args) -> int:
+    """Chaos-transport drill (round 18): mixed traffic under sampled,
+    seeded transport-fault schedules + mid-stream kills; see module
+    docstring for the gates."""
+    import base64
+
+    import numpy as np
+
+    from _chaos_common import (
+        chaos_pool, converge_body, oracle_converge_final,
+        request_with_backoff,
+    )
+    from parallel_convolution_tpu.ops import filters, oracle
+    from parallel_convolution_tpu.parallel.mesh import mesh_from_spec
+    from parallel_convolution_tpu.resilience import faults
+    from parallel_convolution_tpu.serving.router import ReplicaRouter
+    from parallel_convolution_tpu.serving.service import ConvolutionService
+    from parallel_convolution_tpu.utils import imageio
+
+    rng = random.Random(args.seed)
+    img = imageio.generate_test_image(40, 56, "grey", seed=args.seed)
+    b64 = base64.b64encode(np.ascontiguousarray(img).tobytes()).decode()
+    iters_pool = [1, 2, 3]
+    oracles = {it: oracle.run_serial_u8(
+        img, filters.get_filter("blur3"), it) for it in iters_pool}
+
+    def factory():
+        return ConvolutionService(mesh_from_spec("1x2"),
+                                  max_delay_s=0.002, max_queue=256)
+
+    def cbody(rid: str) -> dict:
+        return converge_body(b64, 40, 56, rid)
+
+    try:
+        oracle_final = oracle_converge_final(factory, cbody("oracle"))
+    except RuntimeError as e:
+        print(json.dumps({"summary": "chaos", "failures": 1,
+                          "detail": str(e)}))
+        return 1
+
+    # One replica per failure shape: drops, corrupt bodies, latency.
+    reps = chaos_pool(factory, args.seed)
+    router = ReplicaRouter(reps, breaker_threshold=3,
+                           breaker_cooldown_s=0.2, poll_interval_s=0.05)
+
+    failures: list[str] = []
+    resumes = 0
+    finals_per_rid: dict[str, int] = {}
+    t0 = time.time()
+    specs = []
+    for cycle in range(args.chaos):
+        # A sampled, seeded schedule per cycle — every run replayable.
+        parts = [f"transport_stream:{rng.randint(2, 4)}"]
+        if rng.random() < 0.7:
+            parts.append(f"transport_send:{rng.randint(1, 5)}")
+        if rng.random() < 0.7:
+            parts.append(f"transport_recv:{rng.randint(2, 6)}")
+        if rng.random() < 0.5:
+            parts.append("readyz_probe:p0.2")
+        spec = ",".join(parts)
+        specs.append(spec)
+        with faults.injected(spec, seed=args.seed + cycle):
+            for i in range(8):
+                body = {"image_b64": b64, "rows": 40, "cols": 56,
+                        "mode": "grey", "filter": "blur3",
+                        "iters": iters_pool[i % 3],
+                        "request_id": f"ch{cycle}-{i}"}
+                wire = request_with_backoff(router, body)
+                if wire.get("ok"):
+                    got = np.frombuffer(
+                        base64.b64decode(wire["image_b64"]),
+                        np.uint8).reshape(40, 56)
+                    if not np.array_equal(got, oracles[iters_pool[i % 3]]):
+                        failures.append(
+                            f"cycle {cycle} req {i}: byte mismatch")
+                elif not wire.get("retryable"):
+                    failures.append(
+                        f"cycle {cycle} req {i}: non-rejected failure "
+                        f"{wire.get('rejected')}: "
+                        f"{str(wire.get('detail'))[:120]}")
+            rid = f"cv{cycle}"
+            status, rows = router.converge(cbody(rid))
+            it = iter(rows)
+            drained = []
+            victim = ""
+            try:
+                first = next(it)
+                drained.append(first)
+                if cycle % 2 == 0:
+                    victim = first.get("router", {}).get("replica", "")
+                    if victim:
+                        router.replica(victim).kill()
+                drained.extend(it)
+            except StopIteration:
+                pass
+            if cycle % 2 == 0 and victim:
+                router.replica(victim).revive()
+            final = drained[-1] if drained else {}
+            for r in drained:
+                if r.get("kind") == "final":
+                    finals_per_rid[rid] = finals_per_rid.get(rid, 0) + 1
+            if final.get("kind") == "final":
+                if final.get("image_b64") != oracle_final["image_b64"]:
+                    failures.append(
+                        f"cycle {cycle}: converge final not "
+                        "byte-identical to oracle")
+                if final.get("router", {}).get("resume_count", 0) > 0:
+                    resumes += 1
+            elif not final.get("retryable"):
+                failures.append(
+                    f"cycle {cycle}: converge ended non-rejected: "
+                    f"{ {k: v for k, v in final.items() if k != 'image_b64'} }")
+    dup = {r: n for r, n in finals_per_rid.items() if n != 1}
+    if dup:
+        failures.append(f"exactly-once final rows violated: {dup}")
+    if args.chaos >= 1 and resumes < 1:
+        failures.append("no mid-stream resume observed across the run")
+    snap = router.snapshot()
+    router.close()
+    summary = {
+        "summary": "chaos", "cycles": args.chaos, "seed": args.seed,
+        "specs": specs,
+        "resumes_observed": resumes,
+        "router_resumes": snap["router"]["resumes"],
+        "mid_stream_failovers": snap["router"]["mid_stream_failovers"],
+        "corrupt_responses": sum(p["corrupt_responses"]
+                                 for p in snap["replicas"].values()),
+        "chaos_injected": {site: sum(r.injected.get(site, 0)
+                                     for r in reps)
+                           for site in ("transport_send",
+                                        "transport_recv",
+                                        "transport_stream",
+                                        "readyz_probe")},
+        "wall_s": round(time.time() - t0, 1),
+        "failures": len(failures),
+        "failure_detail": failures[:8],
+    }
+    if args.summary_out:
+        p = Path(args.summary_out)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(json.dumps(summary) + "\n")
+    print(json.dumps(summary), flush=True)
+    return 1 if failures else 0
+
+
 def run_autoscale_drill(args) -> int:
     """Sustained-load autoscale drill: N grow/shrink cycles (round 17).
 
@@ -859,6 +1017,15 @@ def main() -> int:
                          "non-rejected failures, byte-identical results, "
                          "every cycle growing AND shrinking the pool, "
                          "and >= 1 pre-warmed ring shard")
+    ap.add_argument("--chaos", type=int, default=0, metavar="N",
+                    help="chaos-transport drill: 3 chaos-wrapped "
+                         "replicas behind the durable router, N cycles "
+                         "of sampled seeded transport-fault schedules "
+                         "over mixed batch/converge traffic with "
+                         "mid-stream kills; gates on zero non-rejected "
+                         "failures, byte-identical completions incl. "
+                         "resumed converge finals, >= 1 mid-stream "
+                         "resume, exactly one final row per request_id")
     ap.add_argument("--summary-out", default=None, metavar="FILE",
                     help="also write the final summary row to FILE "
                          "(the tier-1 --elastic-smoke leg's done_file)")
@@ -894,6 +1061,8 @@ def main() -> int:
         return run_router_kill(args)
     if args.autoscale:
         return run_autoscale_drill(args)
+    if args.chaos:
+        return run_chaos_drill(args)
     if args.faults or args.reshape:
         return run_fault_soak(args)
 

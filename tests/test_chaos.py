@@ -1,0 +1,781 @@
+"""Durable convergence jobs + chaos transport (round 18, ISSUE 13).
+
+The acceptance properties, all on the 8-virtual-device CPU mesh:
+
+* the fault-site table is DRIFT-GUARDED — every ``fault_point(name)``
+  consult in the tree names a registered ``SITE_TABLE`` site and every
+  registered site is consulted somewhere;
+* the chaos transport injects deterministically (seeded ``PCTPU_FAULTS``
+  schedules) and its failures look like real networks: ConnectionError
+  drops/black-holes, CorruptReplicaBody garbage, mid-stream breaks;
+* corrupt/truncated JSON from a replica is a TYPED transport failure
+  (breaker food + failover walk + per-replica counter), never an
+  uncaught JSONDecodeError out of the router;
+* a resumed converge job's final row is BYTE-IDENTICAL to the
+  uninterrupted run — same grid, different grid, jacobi and multigrid;
+* ``router.converge`` fails over MID-STREAM: after rows have flowed, a
+  transport death walks the surviving ring candidates with the newest
+  resume token, stamps ``router: {resumed_from, resume_count}``, and
+  delivers exactly ONE final row per request_id;
+* a client retry of a mid-stream typed retryable row resumes from the
+  router's job ledger instead of iteration 0, and with the pricer armed
+  the tenant is charged only the INCREMENTAL work.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import re
+import threading
+import time
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from parallel_convolution_tpu.ops import filters, oracle
+from parallel_convolution_tpu.parallel import mesh as mesh_lib, step
+from parallel_convolution_tpu.resilience import degrade, faults
+from parallel_convolution_tpu.serving import jobs
+from parallel_convolution_tpu.serving.chaos import (
+    ChaosTransport, modes_from_spec,
+)
+from parallel_convolution_tpu.serving.frontend import (
+    decode_converge, encode_stream_row,
+)
+from parallel_convolution_tpu.serving.router import (
+    CorruptReplicaBody, HTTPReplica, InProcessReplica, ReplicaRouter,
+    TenantQuotas,
+)
+from parallel_convolution_tpu.serving.service import (
+    ConvolutionService, Rejected, Request, Snapshot,
+)
+from parallel_convolution_tpu.utils import imageio
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_state():
+    yield
+    faults.uninstall_plan()
+    degrade.clear_probe_cache()
+
+
+def _mesh(shape=(1, 2)):
+    return mesh_lib.make_grid_mesh(jax.devices()[: shape[0] * shape[1]],
+                                   shape)
+
+
+def _img(rows=32, cols=48, seed=5):
+    return imageio.generate_test_image(rows, cols, "grey", seed=seed)
+
+
+def _factory(shape=(1, 2), **kw):
+    kw.setdefault("max_delay_s", 0.002)
+
+    def make():
+        return ConvolutionService(_mesh(shape), **kw)
+
+    return make
+
+
+def _converge_body(img, **kw):
+    body = {"image_b64": base64.b64encode(
+        np.ascontiguousarray(img).tobytes()).decode("ascii"),
+        "rows": img.shape[0], "cols": img.shape[1], "mode": "grey",
+        "filter": "jacobi3", "backend": "shifted", "quantize": False,
+        "tol": 0.0, "max_iters": 40, "check_every": 10}
+    body.update(kw)
+    return body
+
+
+def _chaos_router(n=3, shape=(1, 2), seed=1, modes=None, **kw):
+    kw.setdefault("poll_interval_s", 0.05)
+    kw.setdefault("breaker_cooldown_s", 0.2)
+    reps = [ChaosTransport(InProcessReplica(_factory(shape), name=f"c{i}"),
+                           modes=modes, seed=seed + i)
+            for i in range(n)]
+    return ReplicaRouter(reps, **kw)
+
+
+# ------------------------------------------------ fault-site drift guard
+
+
+def test_fault_site_table_is_complete():
+    """Every fault_point(name) consult in the tree is a registered
+    SITE_TABLE site, and every registered site is consulted somewhere —
+    the grammar's documented table can never drift from the code (the
+    six compute/IO sites used to live only in DESIGN.md prose)."""
+    root = Path(step.__file__).resolve().parents[1]
+    referenced: set[str] = set()
+    for py in root.rglob("*.py"):
+        for m in re.finditer(r"fault_point\(\s*['\"]([a-z_]+)['\"]",
+                             py.read_text()):
+            referenced.add(m.group(1))
+    assert referenced == set(faults.SITE_TABLE), (
+        f"fault sites drifted: consulted-but-unregistered "
+        f"{sorted(referenced - set(faults.SITE_TABLE))}, "
+        f"registered-but-never-consulted "
+        f"{sorted(set(faults.SITE_TABLE) - referenced)}")
+    assert faults.KNOWN_SITES == frozenset(faults.SITE_TABLE)
+
+
+def test_transport_sites_parse_in_fault_grammar():
+    plan = faults.plan_from_spec(
+        "transport_send:2,transport_recv:p0.5,transport_stream:3+,"
+        "readyz_probe:*")
+    assert set(plan.rules) == {"transport_send", "transport_recv",
+                               "transport_stream", "readyz_probe"}
+    with pytest.raises(ValueError, match="unknown fault site"):
+        faults.plan_from_spec("transport_sned:1")
+
+
+def test_chaos_mode_spec_parse_and_reject():
+    modes = modes_from_spec(
+        "transport_send=latency,transport_recv=corrupt")
+    assert modes == {"transport_send": "latency",
+                     "transport_recv": "corrupt"}
+    with pytest.raises(ValueError, match="unknown chaos site"):
+        modes_from_spec("transport_sned=drop")
+    with pytest.raises(ValueError, match="unknown mode"):
+        modes_from_spec("transport_send=corrupt")
+    with pytest.raises(ValueError, match="unknown mode"):
+        ChaosTransport(object(), {"readyz_probe": "drop"})
+
+
+# ------------------------------------------------------- chaos transport
+
+
+def test_chaos_send_drop_is_deterministic():
+    rep = ChaosTransport(InProcessReplica(_factory(), name="c0"), seed=0)
+    img = _img()
+    body = {"image_b64": base64.b64encode(
+        np.ascontiguousarray(img).tobytes()).decode("ascii"),
+        "rows": img.shape[0], "cols": img.shape[1], "mode": "grey",
+        "filter": "blur3", "iters": 1, "request_id": "d1"}
+    with faults.injected("transport_send:2"):
+        status, wire = rep.request(dict(body))
+        assert status == 200 and wire["ok"]
+        with pytest.raises(ConnectionError, match="chaos: dropped send"):
+            rep.request(dict(body, request_id="d2"))
+        status, wire = rep.request(dict(body, request_id="d3"))
+        assert status == 200 and wire["ok"]
+    assert rep.injected == {"transport_send": 1}
+    # The dropped send never reached the replica: exactly 2 completions.
+    assert rep.inner.service.stats["completed"] == 2
+    rep.close()
+
+
+def test_chaos_recv_drop_executed_work_dedups_on_retry():
+    """transport_recv drop: the work EXECUTED but the response was lost
+    — the idempotency case.  A client retry with the same request_id
+    must dedup into the first execution, not re-run it."""
+    rep = ChaosTransport(InProcessReplica(_factory(), name="c0"), seed=0)
+    router = ReplicaRouter([rep], start_health=False)
+    img = _img()
+    body = {"image_b64": base64.b64encode(
+        np.ascontiguousarray(img).tobytes()).decode("ascii"),
+        "rows": img.shape[0], "cols": img.shape[1], "mode": "grey",
+        "filter": "blur3", "iters": 2, "request_id": "rv1"}
+    with faults.injected("transport_recv:1"):
+        status, wire = router.request(dict(body))
+    # The single replica's response was dropped: typed retryable.
+    assert wire["rejected"] == "replica_unavailable" and wire["retryable"]
+    svc = rep.inner.service
+    assert svc.stats["completed"] == 1   # the work DID execute
+    status, wire = router.request(dict(body))   # the client retry
+    assert status == 200 and wire["ok"]
+    assert svc.stats["completed"] == 1   # deduped, not re-executed
+    assert svc.stats["deduped"] == 1
+    want = oracle.run_serial_u8(img, filters.get_filter("blur3"), 2)
+    got = np.frombuffer(base64.b64decode(wire["image_b64"]),
+                        np.uint8).reshape(img.shape)
+    np.testing.assert_array_equal(got, want)
+    router.close()
+
+
+def test_chaos_readyz_flap_marks_unready_then_recovers():
+    rep = ChaosTransport(InProcessReplica(_factory(), name="c0"), seed=0)
+    router = ReplicaRouter([rep], start_health=False)
+    with faults.injected("readyz_probe:1"):
+        router.poll_once()
+        assert not router._replicas["c0"].ready
+        router.poll_once()
+        assert router._replicas["c0"].ready
+    router.close()
+
+
+# --------------------------------------- corrupt bodies are typed, counted
+
+
+class _GarbageHTTP:
+    """A minimal HTTP server answering every POST with corrupt JSON."""
+
+    def __init__(self, payload=b"{not json", status=200):
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length", "0") or 0)
+                self.rfile.read(n)
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            do_GET = do_POST  # noqa: N815 — garbage everywhere
+
+        self.server = HTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def test_corrupt_json_is_typed_transport_failure():
+    srv = _GarbageHTTP()
+    try:
+        rep = HTTPReplica(f"http://127.0.0.1:{srv.port}", name="bad")
+        with pytest.raises(CorruptReplicaBody, match="unparseable"):
+            rep.request({"rows": 1, "cols": 1})
+        with pytest.raises(CorruptReplicaBody):
+            rep.readyz()
+    finally:
+        srv.close()
+
+
+def test_router_fails_over_past_corrupting_replica():
+    """The regression the satellite names: a corrupt body is breaker
+    food + a failover walk, NOT an uncaught JSONDecodeError out of the
+    router — and the per-replica corrupt_responses counter sees it."""
+    srv = _GarbageHTTP()
+    good = InProcessReplica(_factory(), name="good")
+    bad = HTTPReplica(f"http://127.0.0.1:{srv.port}", name="bad")
+    router = ReplicaRouter([bad, good], start_health=False)
+    img = _img()
+    want = oracle.run_serial_u8(img, filters.get_filter("blur3"), 1)
+    try:
+        ok = corrupt_seen = 0
+        for i in range(6):
+            body = {"image_b64": base64.b64encode(
+                np.ascontiguousarray(img).tobytes()).decode("ascii"),
+                "rows": img.shape[0], "cols": img.shape[1],
+                "mode": "grey", "filter": "blur3", "iters": 1,
+                "request_id": f"cj{i}"}
+            status, wire = router.request(body)
+            assert status == 200 and wire["ok"], wire
+            got = np.frombuffer(base64.b64decode(wire["image_b64"]),
+                                np.uint8).reshape(img.shape)
+            np.testing.assert_array_equal(got, want)
+            ok += 1
+            if wire["router"]["failovers"] > 0:
+                corrupt_seen += 1
+        assert ok == 6
+        snap = router.snapshot()
+        assert snap["replicas"]["bad"]["corrupt_responses"] >= 1
+        assert snap["replicas"]["good"]["corrupt_responses"] == 0
+    finally:
+        router.close()
+        srv.close()
+
+
+# --------------------------------------------------- resume token codec
+
+
+def test_resume_token_codec_roundtrip_and_rejects():
+    state = np.arange(24, dtype=np.float32).reshape(1, 4, 6) / 7.0
+    b64, shape = jobs.state_to_wire(state)
+    back = jobs.state_from_wire(b64, shape)
+    np.testing.assert_array_equal(back, state)
+    with pytest.raises(ValueError, match="bytes"):
+        jobs.state_from_wire(b64, [1, 4, 7])
+    with pytest.raises(ValueError, match="state_shape"):
+        jobs.state_from_wire(b64, "nope")
+    # through the wire decoder: a malformed token is a typed 400
+    body = _converge_body(_img(), resume={"iters": 10, "diff": 1.0,
+                                          "work_units": 10.0,
+                                          "state_b64": "!!!",
+                                          "state_shape": [1, 4, 6]})
+    with pytest.raises(ValueError, match="malformed request body"):
+        decode_converge(body)
+
+
+def test_job_ledger_exactly_once_and_identity():
+    led = jobs.JobLedger(capacity=4)
+    row = {"ok": True, "kind": "snapshot", "iters": 10, "diff": 0.5,
+           "work_units": 10.0, "solver": "jacobi",
+           "state_b64": jobs.state_to_wire(
+               np.zeros((1, 2, 2), np.float32))[0],
+           "state_shape": [1, 2, 2]}
+    led.observe("r1", "keyA", row)
+    assert led.token("r1", "keyA")["iters"] == 10
+    # a reused id naming a DIFFERENT config never resumes the old field
+    assert led.token("r1", "keyB") is None
+    assert led.begin("r1", "keyB") is None
+    assert led.finalize("r1") is True
+    assert led.finalize("r1") is False          # exactly-once
+    assert led.begin("r1", "keyA") is None      # entry dropped on final
+    assert led.finalize("r1") is True           # fresh life, fresh final
+
+
+# ----------------------------------------------- service-level resume
+
+
+def _progressive_rows(svc, img, rid, **kw):
+    kw.setdefault("tol", 0.0)
+    kw.setdefault("max_iters", 40)
+    kw.setdefault("check_every", 10)
+    stream = svc.submit_progressive(
+        Request(image=img, filter_name="jacobi3", quantize=False,
+                request_id=rid), **kw)
+    assert not isinstance(stream, Rejected), stream
+    return list(stream)
+
+
+def test_service_resume_final_bytes_identical():
+    img = _img(40, 56, seed=3)
+    svc = ConvolutionService(_mesh((2, 2)), max_delay_s=0.002)
+    rows = _progressive_rows(svc, img, "u1", carry_state=True)
+    assert rows[-1].final
+    # snapshots carry the f32 state only when asked; finals never do
+    assert all(r.state is not None for r in rows[:-1])
+    assert rows[-1].state is None
+    uncarried = _progressive_rows(svc, img, "u2")
+    assert all(r.state is None for r in uncarried)
+    tok = rows[1]   # iters=20, a check_every boundary
+    resume = {"iters": tok.iters, "diff": tok.diff,
+              "work_units": tok.work_units, "state": tok.state}
+    resumed = _progressive_rows(svc, img, "u3", resume=resume)
+    assert [r.iters for r in resumed] == [30, 40, 40]
+    assert resumed[-1].final
+    np.testing.assert_array_equal(resumed[-1].image, rows[-1].image)
+    assert resumed[-1].work_units == rows[-1].work_units
+    svc.close()
+
+
+def test_service_resume_across_grids_byte_identical():
+    """The token's field reshards onto the resuming replica's OWN grid
+    (crop + zero-re-pad is bit-exact — the checkpoint-reshard
+    invariant), so a job can resume onto a replica holding a different
+    mesh and still produce the uninterrupted run's exact bytes."""
+    img = _img(40, 56, seed=3)
+    svc_a = ConvolutionService(_mesh((2, 2)), max_delay_s=0.002)
+    rows = _progressive_rows(svc_a, img, "g1", carry_state=True)
+    tok = rows[0]
+    resume = {"iters": tok.iters, "diff": tok.diff,
+              "work_units": tok.work_units, "state": tok.state}
+    for shape in ((1, 2), (1, 1), (2, 4)):
+        svc_b = ConvolutionService(_mesh(shape), max_delay_s=0.002)
+        resumed = _progressive_rows(svc_b, img, f"g-{shape}",
+                                    resume=resume)
+        assert resumed[-1].final
+        assert resumed[-1].effective_grid == f"{shape[0]}x{shape[1]}"
+        np.testing.assert_array_equal(resumed[-1].image, rows[-1].image)
+        svc_b.close()
+    svc_a.close()
+
+
+def test_service_resume_multigrid_byte_identical():
+    img = _img(48, 64, seed=3)
+    kw = dict(tol=1e-3, max_iters=400, check_every=10)
+    svc = ConvolutionService(_mesh((2, 2)), max_delay_s=0.002)
+
+    def mg_rows(rid, **extra):
+        stream = svc.submit_progressive(
+            Request(image=img, filter_name="blur3", quantize=False,
+                    solver="multigrid", request_id=rid), **kw, **extra)
+        assert not isinstance(stream, Rejected), stream
+        return list(stream)
+
+    rows = mg_rows("m1", carry_state=True)
+    assert rows[-1].final and rows[-1].converged
+    tok = rows[2]   # a V-cycle boundary
+    resume = {"iters": tok.iters, "diff": tok.diff,
+              "work_units": tok.work_units, "state": tok.state}
+    resumed = mg_rows("m2", resume=resume)
+    assert resumed[-1].final
+    assert resumed[0].iters == tok.iters + 1   # cycles continue
+    np.testing.assert_array_equal(resumed[-1].image, rows[-1].image)
+    assert resumed[-1].iters == rows[-1].iters
+    svc.close()
+
+
+def test_service_resume_rejects_off_boundary_token():
+    img = _img()
+    svc = ConvolutionService(_mesh(), max_delay_s=0.002)
+    bad = {"iters": 7, "diff": 1.0, "work_units": 7.0,
+           "state": np.zeros((1,) + img.shape, np.float32)}
+    r = svc.submit_progressive(
+        Request(image=img, filter_name="jacobi3", quantize=False),
+        tol=0.0, max_iters=40, check_every=10, resume=bad)
+    assert isinstance(r, Rejected) and r.reason == "invalid"
+    assert "boundary" in r.detail
+    wrong_shape = {"iters": 10, "diff": 1.0, "work_units": 10.0,
+                   "state": np.zeros((1, 4, 4), np.float32)}
+    r = svc.submit_progressive(
+        Request(image=img, filter_name="jacobi3", quantize=False),
+        tol=0.0, max_iters=40, check_every=10, resume=wrong_shape)
+    assert isinstance(r, Rejected) and r.reason == "invalid"
+    svc.close()
+
+
+def test_service_resume_accepts_final_partial_chunk_token():
+    """max_iters that is NOT a check_every multiple: the last chunk is
+    short and its token sits at iters == max_iters — a stream that died
+    between that snapshot and the final row must still resume (the
+    boundary check may not reject the one legitimate off-multiple
+    boundary)."""
+    img = _img(40, 56, seed=3)
+    svc = ConvolutionService(_mesh((2, 2)), max_delay_s=0.002)
+    kw = dict(tol=0.0, max_iters=25, check_every=10)
+    rows = _progressive_rows(svc, img, "fp1", carry_state=True, **kw)
+    assert [r.iters for r in rows] == [10, 20, 25, 25]
+    tok = rows[2]   # the short final chunk's snapshot (iters == 25)
+    resume = {"iters": tok.iters, "diff": tok.diff,
+              "work_units": tok.work_units, "state": tok.state}
+    resumed = _progressive_rows(svc, img, "fp2", resume=resume, **kw)
+    assert [r.iters for r in resumed] == [25] and resumed[-1].final
+    np.testing.assert_array_equal(resumed[-1].image, rows[-1].image)
+    svc.close()
+
+
+def test_stream_rows_carry_state_only_when_asked():
+    img = _img()
+    svc = ConvolutionService(_mesh(), max_delay_s=0.002)
+    stream = svc.submit_progressive(
+        Request(image=img, filter_name="jacobi3", quantize=False),
+        tol=0.0, max_iters=20, check_every=10, carry_state=True)
+    rows = [encode_stream_row(r) for r in stream]
+    assert all("state_b64" in r for r in rows if r["kind"] == "snapshot")
+    assert "state_b64" not in rows[-1]          # finals never carry it
+    tok = jobs.token_from_row(rows[0])
+    assert tok is not None and tok["iters"] == 10
+    np.testing.assert_array_equal(
+        jobs.state_from_wire(tok["state_b64"], tok["state_shape"]).shape,
+        (1,) + img.shape)
+    svc.close()
+
+
+# -------------------------------------------- router mid-stream resume
+
+
+def _oracle_converge(img, body):
+    r0 = ReplicaRouter([InProcessReplica(_factory((1, 2)), name="o0")],
+                       start_health=False)
+    st, rows = r0.converge(dict(body))
+    out = list(rows)
+    r0.close()
+    assert out[-1]["kind"] == "final", out[-1]
+    return out
+
+
+def test_router_mid_stream_resume_chaos_disconnect():
+    img = _img(40, 56, seed=3)
+    body = _converge_body(img, request_id="ms1")
+    want = _oracle_converge(img, body)
+    router = _chaos_router(n=3)
+    try:
+        with faults.injected("transport_stream:3"):
+            status, rows = router.converge(dict(body))
+            got = list(rows)
+        assert status == 200
+        final = got[-1]
+        assert final["kind"] == "final", final
+        assert sum(1 for g in got if g.get("kind") == "final") == 1
+        assert final["image_b64"] == want[-1]["image_b64"]
+        assert final["iters"] == want[-1]["iters"]
+        # the resume is CLIENT-observable via the router stamp...
+        assert final["router"]["resume_count"] == 1
+        assert len(final["router"]["resumed_from"]) == 1
+        # ...and OPERATOR-observable via /stats
+        snap = router.snapshot()
+        assert snap["router"]["resumes"] == 1
+        assert snap["router"]["mid_stream_failovers"] == 1
+        assert sum(p["resumes"]
+                   for p in snap["replicas"].values()) == 1
+        assert sum(p["mid_stream_failovers"]
+                   for p in snap["replicas"].values()) == 1
+        # the client never sees raw token state
+        assert all("state_b64" not in g for g in got)
+    finally:
+        router.close()
+
+
+def test_router_mid_stream_resume_on_replica_kill():
+    """The acceptance drill in miniature: kill the serving replica AFTER
+    rows have flowed; the job resumes on a survivor and the final row is
+    byte-identical to the uninterrupted oracle run."""
+    img = _img(40, 56, seed=3)
+    body = _converge_body(img, request_id="k1")
+    want = _oracle_converge(img, body)
+    reps = [InProcessReplica(_factory((1, 2)), name=f"r{i}")
+            for i in range(3)]
+    router = ReplicaRouter(reps, poll_interval_s=0.05,
+                           breaker_cooldown_s=0.2)
+    try:
+        status, rows = router.converge(dict(body))
+        assert status == 200
+        got = [next(rows)]
+        serving = got[0]["router"]["replica"]
+        router.replica(serving).kill()
+        got.extend(rows)
+        final = got[-1]
+        assert final["kind"] == "final", final
+        assert final["router"]["resume_count"] >= 1
+        assert serving in final["router"]["resumed_from"]
+        assert final["router"]["replica"] != serving
+        assert final["image_b64"] == want[-1]["image_b64"]
+        assert sum(1 for g in got if g.get("kind") == "final") == 1
+    finally:
+        router.close()
+
+
+def test_router_client_retry_resumes_from_ledger():
+    """All candidates dead mid-stream → typed retryable row; the client
+    retry (same request_id) resumes from the router's ledger token
+    instead of iteration 0."""
+    img = _img(40, 56, seed=3)
+    body = _converge_body(img, request_id="cr1")
+    want = _oracle_converge(img, body)
+    router = _chaos_router(n=1)
+    try:
+        with faults.injected("transport_stream:3"):
+            status, rows = router.converge(dict(body))
+            got = list(rows)
+        # rows flowed, then the only replica's stream died: typed end
+        assert [g["kind"] for g in got[:-1]] == ["snapshot", "snapshot"]
+        end = got[-1]
+        assert end["kind"] == "rejected" and end["retryable"], end
+        assert end.get("retry_after_s") is not None
+        # the retry resumes: first row continues PAST the token
+        status, rows = router.converge(dict(body))
+        got2 = list(rows)
+        assert got2[0]["iters"] == 30        # not 10 — resumed at 20
+        final = got2[-1]
+        assert final["kind"] == "final"
+        assert final["router"]["resume_count"] == 1
+        assert final["image_b64"] == want[-1]["image_b64"]
+    finally:
+        router.close()
+
+
+def test_job_ledger_is_tenant_scoped():
+    """request_id is client-stamped and route_key carries neither tenant
+    nor image content: tenant B reusing tenant A's id on a same-config
+    job must START FRESH, never be seeded from A's private field state —
+    while A's own retry still resumes."""
+    img = _img(40, 56, seed=3)
+    router = _chaos_router(n=1)
+    try:
+        body_a = _converge_body(img, request_id="shared", tenant="A")
+        with faults.injected("transport_stream:3"):
+            status, rows = router.converge(dict(body_a))
+            got_a = list(rows)
+        assert got_a[-1]["kind"] == "rejected"          # A died at 20
+        body_b = _converge_body(img, request_id="shared", tenant="B")
+        status, rows = router.converge(dict(body_b))
+        got_b = list(rows)
+        assert got_b[0]["iters"] == 10                  # B: iteration 0
+        assert "resume_count" not in got_b[0].get("router", {})
+        assert got_b[-1]["kind"] == "final"
+        status, rows = router.converge(dict(body_a))    # A's own retry
+        got_a2 = list(rows)
+        assert got_a2[0]["iters"] == 30                 # resumed at 20
+        assert got_a2[-1]["kind"] == "final"
+    finally:
+        router.close()
+
+
+def test_multigrid_client_retry_resumes_from_ledger():
+    """Multigrid tokens count V-cycles, not jacobi chunk boundaries —
+    the router's token-fit guard must not apply the check_every rule to
+    them (it would silently discard every multigrid ledger token and
+    restart jobs from cycle 0 at full price)."""
+    img = _img(48, 64, seed=3)
+    body = _converge_body(img, request_id="mgr1", filter="blur3",
+                          solver="multigrid", tol=1e-3, max_iters=400)
+    router = _chaos_router(n=1)
+    try:
+        with faults.injected("transport_stream:3"):
+            status, rows = router.converge(dict(body))
+            got = list(rows)
+        assert [g.get("iters") for g in got[:-1]] == [1, 2]   # 2 cycles
+        assert got[-1]["kind"] == "rejected" and got[-1]["retryable"]
+        status, rows = router.converge(dict(body))            # retry
+        got2 = list(rows)
+        assert got2[0]["iters"] == 3, got2[0]    # resumed at cycle 2
+        assert got2[0]["router"]["resume_count"] == 1
+        assert got2[-1]["kind"] == "final" and got2[-1]["converged"]
+    finally:
+        router.close()
+
+
+def test_raised_budget_retry_restarts_instead_of_invalid():
+    """A token minted on the OLD budget's short final chunk no longer
+    fits when the client retries with a bigger max_iters — the router
+    must drop the unusable ledger token and restart the job, never fail
+    it terminally 'invalid' on a token the client never supplied."""
+    img = _img(40, 56, seed=3)
+    router = _chaos_router(n=1)
+    try:
+        body = _converge_body(img, request_id="rb1", max_iters=45)
+        with faults.injected("transport_stream:6"):   # die after the
+            status, rows = router.converge(dict(body))  # iters=45 row
+            got = list(rows)
+        assert [g.get("iters") for g in got[:-1]] == [10, 20, 30, 40, 45]
+        assert got[-1]["kind"] == "rejected" and got[-1]["retryable"]
+        retry = dict(body, max_iters=100)
+        status, rows = router.converge(retry)
+        got2 = list(rows)
+        assert got2[0].get("rejected") != "invalid", got2[0]
+        assert got2[0]["iters"] == 10                 # fresh start
+        assert got2[-1]["kind"] == "final"
+        assert got2[-1]["iters"] == 100
+    finally:
+        router.close()
+
+
+def test_router_incremental_charge_on_resume():
+    """The r17 refund rule, extended: a resumed job's tenant charge
+    covers only the incremental work.  Frozen quota clock → exact
+    arithmetic: (full charge) − (refund of unexecuted fraction) +
+    (retry's incremental charge) ≈ one full job's price."""
+    from parallel_convolution_tpu.serving.pricing import WorkPricer
+
+    clock = [0.0]
+    quotas = TenantQuotas(rate=1.0, burst=1000.0,
+                          clock=lambda: clock[0])
+    img = _img(40, 56, seed=3)
+    body = _converge_body(img, request_id="ic1", tenant="t1")
+    # Floor lowered so this small job prices on the linear model (the
+    # default 1e-4 floor would dominate and mask the arithmetic).
+    pricer = WorkPricer(min_units=1e-9)
+    router = _chaos_router(n=1, quotas=quotas, pricer=pricer)
+    try:
+        bucket = quotas.bucket("t1")
+        level0 = bucket.level()
+        with faults.injected("transport_stream:3"):
+            status, rows = router.converge(dict(body))
+            got = list(rows)
+        assert got[-1]["kind"] == "rejected"
+        after_fail = bucket.level()
+        # 20 of 40 iterations ran before the death: roughly half the
+        # charge must have come back as the unexecuted-fraction refund.
+        full = level0 - after_fail
+        assert full > 0
+        status, rows = router.converge(dict(body))
+        got2 = list(rows)
+        assert got2[-1]["kind"] == "final"
+        total_charged = level0 - bucket.level()
+        one_job = pricer.price(dict(body), converge=True)
+        # net charge ≈ one uninterrupted job (the two legs' work sums
+        # to the full budget; pricing is linear in max_iters)
+        assert total_charged == pytest.approx(one_job, rel=0.15)
+    finally:
+        router.close()
+
+
+def test_router_mid_stream_corrupt_counts_and_resumes():
+    img = _img(40, 56, seed=3)
+    body = _converge_body(img, request_id="cc1")
+    want = _oracle_converge(img, body)
+    router = _chaos_router(n=2, modes={"transport_stream": "corrupt"})
+    try:
+        with faults.injected("transport_stream:2"):
+            status, rows = router.converge(dict(body))
+            got = list(rows)
+        final = got[-1]
+        assert final["kind"] == "final"
+        assert final["image_b64"] == want[-1]["image_b64"]
+        snap = router.snapshot()
+        assert sum(p["corrupt_responses"]
+                   for p in snap["replicas"].values()) == 1
+    finally:
+        router.close()
+
+
+class _ErrorStreamReplica:
+    """A fake transport whose streams always die with a typed `error`
+    row after one (token-carrying) snapshot — a DETERMINISTIC mid-
+    stream execution failure every resume reproduces."""
+
+    def __init__(self, name):
+        self.name = name
+        self.streams = 0
+
+    def readyz(self):
+        return 200, {"ok": True, "ready": True}
+
+    def converge(self, body, timeout=None, traceparent=None):
+        self.streams += 1
+        b64, shape = jobs.state_to_wire(np.zeros((1, 8, 8), np.float32))
+        rid = body.get("request_id", "")
+
+        def rows():
+            yield {"ok": True, "kind": "snapshot", "iters": 10,
+                   "diff": 1.0, "work_units": 10.0, "solver": "jacobi",
+                   "state_b64": b64, "state_shape": shape,
+                   "request_id": rid}
+            yield {"ok": False, "kind": "rejected", "rejected": "error",
+                   "retryable": False, "detail": "deterministic boom",
+                   "request_id": rid}
+
+        return 200, rows()
+
+    def close(self):
+        pass
+
+
+def test_deterministic_mid_stream_error_stays_non_retryable():
+    """When the resume walk exhausts because a replica-typed `error`
+    row reproduces on every candidate, the stream must end with THAT
+    row verbatim (retryable:false) — reporting it as a retryable
+    `replica_unavailable` would loop clients on a deterministic
+    failure forever (the r14 taxonomy split, kept under durability)."""
+    reps = [_ErrorStreamReplica(f"e{i}") for i in range(2)]
+    router = ReplicaRouter(reps, start_health=False,
+                           breaker_threshold=5)
+    try:
+        status, rows = router.converge(
+            {"request_id": "det1", "max_iters": 40, "check_every": 10})
+        got = list(rows)
+        end = got[-1]
+        assert end["kind"] == "rejected"
+        assert end["rejected"] == "error", end
+        assert end["retryable"] is False
+        assert "deterministic boom" in end["detail"]
+        # both candidates were tried (the walk DID attempt the resume)
+        assert sum(r.streams for r in reps) == 2
+        assert sum(1 for g in got if g.get("kind") == "final") == 0
+    finally:
+        router.close()
+
+
+def test_non_durable_router_keeps_r14_semantics():
+    """durable=False: a mid-stream death still ends the stream with the
+    typed retryable row (no token traffic, no resume) — the r14
+    contract is a flag away, not rewritten."""
+    img = _img(40, 56, seed=3)
+    body = _converge_body(img, request_id="nd1")
+    router = _chaos_router(n=2, durable=False)
+    try:
+        with faults.injected("transport_stream:2"):
+            status, rows = router.converge(dict(body))
+            got = list(rows)
+        assert got[0]["kind"] == "snapshot"
+        assert got[-1]["kind"] == "rejected"
+        assert got[-1]["rejected"] == "replica_unavailable"
+        assert got[-1]["retryable"]
+        assert router.stats["resumes"] == 0
+        # non-durable converge asks for no token state on the wire
+        assert all("state_b64" not in g for g in got)
+    finally:
+        router.close()
