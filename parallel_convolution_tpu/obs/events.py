@@ -80,6 +80,10 @@ KINDS = frozenset({
     #                        units already spent)
     "chaos",               # chaos transport injected a network-shaped
     #                        failure (round 18: site, mode, replica)
+    "wal",                 # router write-ahead journal lifecycle
+    #                        (round 19: recovered / torn_tail /
+    #                        quarantined / append_failed / takeover —
+    #                        the crash-safe control plane's timeline)
     "span",                # one closed trace span (obs.trace): trace_id/
     #                        span_id/parent_id + start_ts/dur_s/links
 })

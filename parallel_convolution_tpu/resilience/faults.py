@@ -66,6 +66,17 @@ SITE_TABLE = {
                               "rows already landed — the resume case)",
     "readyz_probe":           "active-health /readyz poll (flapping "
                               "readiness: the router's routing input lies)",
+    "wal_write":              "before appending one record to the router "
+                              "WAL (serving/wal.py — a failed append "
+                              "degrades durability loudly, never serving)",
+    "wal_fsync":              "before fsyncing the WAL after an append "
+                              "(the record is written but not yet durable "
+                              "when this fires)",
+    "router_kill":            "drill poll: the moment the router process "
+                              "dies (drills consult it per streamed row "
+                              "via serving.chaos.router_kill_due and "
+                              "convert the verdict into an abandoned "
+                              "stream + a WAL takeover)",
 }
 KNOWN_SITES = frozenset(SITE_TABLE)
 

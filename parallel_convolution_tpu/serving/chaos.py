@@ -44,7 +44,26 @@ from parallel_convolution_tpu.resilience.faults import (
     InjectedFault, fault_point,
 )
 
-__all__ = ["ChaosTransport", "DEFAULT_MODES", "modes_from_spec"]
+__all__ = ["ChaosTransport", "DEFAULT_MODES", "modes_from_spec",
+           "router_kill_due"]
+
+
+def router_kill_due() -> bool:
+    """Consult the ``router_kill`` fault site: True when the seeded
+    plan says the router process dies NOW.  Crash drills
+    (``soak.py --router-restart``, ``scripts/wal_smoke.py``) poll this
+    per streamed row and convert a True into what a real router death
+    looks like — the stream abandoned un-closed, then a standby
+    takeover replaying the WAL — instead of an in-band exception the
+    serving plane would politely handle."""
+    try:
+        fault_point("router_kill")
+    except InjectedFault:
+        if obs_metrics.enabled():
+            obs_events.emit("chaos", site="router_kill", mode="kill",
+                            replica="router")
+        return True
+    return False
 
 # site -> the failure shapes it can take (the first is the default).
 SITE_MODES = {

@@ -89,7 +89,24 @@ def metrics_text() -> str:
 # the body's ``retryable`` field, not the status code, is the contract.
 _REJECT_STATUS = {"invalid": 400, "queue_full": 429, "deadline": 429,
                   "error": 500, "resharding": 503, "timeout": 504,
-                  "tenant_quota": 429, "replica_unavailable": 503}
+                  "tenant_quota": 429, "replica_unavailable": 503,
+                  # Round 19 epoch fencing: the request came from a
+                  # ZOMBIE router (an epoch older than the fence a
+                  # takeover ratcheted).  409 Conflict, retryable:false
+                  # — the zombie must stand down, not back off.
+                  "stale_epoch": 409}
+
+
+def _stale_epoch_wire(body: dict, fence: int, trace_id: str) -> dict:
+    """The typed non-retryable rejection a fenced-out request gets."""
+    return {
+        "ok": False, "rejected": "stale_epoch", "retryable": False,
+        "request_id": body.get("request_id") or "",
+        "fence_epoch": fence, "trace_id": trace_id,
+        "detail": f"router epoch {body.get('router_epoch')!r} is stale "
+                  f"(fence at {fence}): a newer router has taken over "
+                  "this replica set",
+    }
 
 
 def retry_after_header(wire: dict) -> str | None:
@@ -362,6 +379,11 @@ class InProcessClient:
                 **({"remote_parent": True} if pctx is not None
                    else {})) as sp:
             tid = sp.context.trace_id if sp.context is not None else ""
+            admit, fence = self.service.epoch_gate(
+                body.get("router_epoch"))
+            if not admit:
+                sp.set(outcome="stale_epoch")
+                return 409, _stale_epoch_wire(body, fence, tid)
             try:
                 req = decode_request(body)
             except ValueError as e:
@@ -398,6 +420,13 @@ class InProcessClient:
                 **({"remote_parent": True} if pctx is not None
                    else {})) as sp:
             tid = sp.context.trace_id if sp.context is not None else ""
+            admit, fence = self.service.epoch_gate(
+                body.get("router_epoch"))
+            if not admit:
+                sp.set(outcome="stale_epoch")
+                wire = _stale_epoch_wire(body, fence, tid)
+                wire["kind"] = "rejected"
+                return 409, iter([wire])
             try:
                 req, params = decode_converge(body)
             except ValueError as e:
@@ -430,6 +459,18 @@ class InProcessClient:
                          "detail": f"warmup failed: {e}"[:300]}
         return 200, {"ok": True, "warmed": len(effective),
                      "effective_backends": effective}
+
+    def fence(self, epoch) -> tuple[int, dict]:
+        """Ratchet the router-epoch fence (``POST /v1/fence`` twin) —
+        the explicit propagation call a taking-over router makes so a
+        zombie is rejected EVERYWHERE at once, not just on replicas the
+        new router happened to talk to first."""
+        try:
+            e = int(epoch)
+        except (TypeError, ValueError):
+            return 400, {"ok": False, "rejected": "invalid",
+                         "detail": f"bad fence epoch {epoch!r}"}
+        return 200, {"ok": True, "fence_epoch": self.service.fence(e)}
 
     def healthz(self) -> tuple[int, dict]:
         return 200, {"ok": True, **self.service.snapshot()}
@@ -494,7 +535,7 @@ def make_http_server(service: ConvolutionService, host: str = "127.0.0.1",
 
         def do_POST(self):  # noqa: N802 — http.server API
             if self.path not in ("/v1/convolve", "/v1/converge",
-                                 "/v1/warm"):
+                                 "/v1/warm", "/v1/fence"):
                 # Drain the body first: under HTTP/1.1 keep-alive an
                 # unread body would be parsed as the NEXT request line.
                 drain_body(self)
@@ -511,6 +552,9 @@ def make_http_server(service: ConvolutionService, host: str = "127.0.0.1",
                 return
             if self.path == "/v1/warm":
                 self._send(*client.warm(body.get("configs") or []))
+                return
+            if self.path == "/v1/fence":
+                self._send(*client.fence(body.get("epoch")))
                 return
             # Tenant identity: the transport header wins over the body
             # field (the router's QoS key rides either).

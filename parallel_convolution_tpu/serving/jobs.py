@@ -135,6 +135,15 @@ class JobLedger:
         # strings): the exactly-once gate outlives the job entry, which
         # finalize drops to free the token's field bytes.
         self._finalized: "OrderedDict[str, bool]" = OrderedDict()
+        # rids with a LIVE attached stream (the router pins a job while
+        # its rows are flowing): capacity eviction must never take one
+        # of these — evicting a mid-stream job silently loses its
+        # resume token, turning the next mid-stream death into a
+        # restart-from-iteration-0 the client can't explain.  Idle
+        # entries (dead stream, awaiting a client retry) stay FIFO
+        # evictable; `evicted` counts them (exposed in /stats).
+        self._pinned: set[str] = set()
+        self.evicted = 0
         self._lock = threading.Lock()
 
     def _get(self, rid: str, route_key: str | None = None) -> _Job:
@@ -147,17 +156,42 @@ class JobLedger:
             job = _Job(route_key or "")
             self._jobs[rid] = job
         self._jobs.move_to_end(rid)
-        while len(self._jobs) > self.capacity:
-            self._jobs.popitem(last=False)
+        self._evict_locked(keep=rid)
         return job
 
-    def observe(self, rid: str, route_key: str, row: dict) -> None:
-        """Record the newest resume token a snapshot row carries."""
+    def _evict_locked(self, keep: str | None = None) -> None:
+        while len(self._jobs) > self.capacity:
+            victim = next(
+                (k for k in self._jobs
+                 if k != keep and k not in self._pinned), None)
+            if victim is None:
+                # Every entry is mid-stream: the bound goes SOFT rather
+                # than a live job going quietly un-resumable (live
+                # streams are already bounded by max_progressive).
+                break
+            self._jobs.pop(victim)
+            self.evicted += 1
+
+    def pin(self, rid: str) -> None:
+        """Mark ``rid`` as having a live attached stream (eviction-
+        immune until :meth:`unpin`)."""
+        with self._lock:
+            self._pinned.add(rid)
+
+    def unpin(self, rid: str) -> None:
+        with self._lock:
+            self._pinned.discard(rid)
+            self._evict_locked()
+
+    def observe(self, rid: str, route_key: str, row: dict) -> dict | None:
+        """Record the newest resume token a snapshot row carries;
+        returns it (the router's WAL appends exactly what was kept)."""
         token = token_from_row(row)
         if token is None:
-            return
+            return None
         with self._lock:
             self._get(rid, route_key).token = token
+        return token
 
     def token(self, rid: str, route_key: str) -> dict | None:
         """The newest token for ``rid`` — None when unknown, or when the
@@ -223,6 +257,27 @@ class JobLedger:
             self._jobs.pop(rid, None)
             self._finalized.pop(rid, None)
 
+    def restore(self, jobs: dict, finalized=()) -> int:
+        """Seed the ledger from a recovered WAL image (round 19):
+        ``jobs`` maps lid → ``{key, token, resume_count, resumed_from}``
+        (the :class:`~.wal.WALState` shape), ``finalized`` re-arms the
+        exactly-once gate across the restart.  Entries beyond capacity
+        evict FIFO (counted).  Returns how many jobs were restored."""
+        with self._lock:
+            for lid, j in jobs.items():
+                job = _Job(str(j.get("key", "")))
+                job.token = j.get("token")
+                job.resume_count = int(j.get("resume_count", 0))
+                job.resumed_from = [str(x)
+                                    for x in j.get("resumed_from", [])]
+                self._jobs[str(lid)] = job
+            self._evict_locked()
+            for rid in finalized:
+                self._finalized[str(rid)] = True
+            while len(self._finalized) > 4 * self.capacity:
+                self._finalized.popitem(last=False)
+            return len(self._jobs)
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._jobs)
@@ -232,6 +287,11 @@ class JobLedger:
             return {
                 "jobs": len(self._jobs),
                 "capacity": self.capacity,
+                "pinned": len(self._pinned),
+                # Live (un-finalized) jobs evicted at capacity — should
+                # stay 0 under healthy load; a rising count means the
+                # ledger is sized below the idle-retry window.
+                "ledger_evicted": self.evicted,
                 "resumes": sum(j.resume_count
                                for j in self._jobs.values()),
             }

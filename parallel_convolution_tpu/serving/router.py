@@ -46,6 +46,16 @@ Design points:
   fails over BEFORE the first row but never mid-stream (re-running a
   half-delivered job would duplicate device work the client already
   has).
+* **Crash-safe control plane (round 19).**  With ``wal=`` armed, every
+  admission / newest resume token / finalization / ring change / tenant
+  debt level is journaled write-ahead (``serving/wal.py``); constructing
+  a router over an existing WAL replays it — jobs resume from their
+  newest durable token ACROSS a router restart, the exactly-once final
+  gate survives, and a monotonic fencing ``epoch`` (bumped past the WAL's
+  and every replica's own fence on each takeover, stamped on every
+  router→replica request, ratcheted replica-side) guarantees a zombie
+  predecessor is rejected typed ``stale_epoch`` instead of
+  double-delivering a final.
 
 stdlib + numpy only; jax stays inside the replicas.
 """
@@ -203,7 +213,7 @@ class TokenBucket:
                                self._tokens + (now - self._last) * self.rate)
         self._last = now
 
-    def try_take(self, n: float = 1.0) -> tuple[bool, float]:
+    def try_take(self, n: float = 1.0, journal=None) -> tuple[bool, float]:
         """(granted, retry_after_s).  On refusal, ``retry_after_s`` is the
         exact wall time until the bucket can grant ``n`` again.
 
@@ -213,7 +223,14 @@ class TokenBucket:
         and refusing it forever would make ``burst`` a silent per-job
         size cap instead of a smoothing window.  The debt refills at
         ``rate`` like any other deficit, so long-run fairness is
-        untouched — the tenant just waits out its own big job."""
+        untouched — the tenant just waits out its own big job.
+
+        ``journal`` (the WAL hook) is called with the POST-charge
+        balance UNDER this bucket's lock on a successful take: the
+        journaled level is atomic with the balance change and
+        same-tenant journal order equals charge order — a level read
+        outside the lock could race a concurrent take and journal a
+        stale balance that recovery would faithfully re-mint."""
         if self.rate <= 0:
             return True, 0.0
         need = min(float(n), self.burst)
@@ -222,19 +239,35 @@ class TokenBucket:
             self._refill(now)
             if self._tokens >= need:
                 self._tokens -= float(n)
+                if journal is not None:
+                    journal(self._tokens)
                 return True, 0.0
             return False, (need - self._tokens) / self.rate
 
-    def refund(self, n: float = 1.0) -> None:
+    def refund(self, n: float = 1.0, journal=None) -> None:
         if self.rate <= 0:
             return
         with self._lock:
             self._tokens = min(self.burst, self._tokens + n)
+            if journal is not None:
+                journal(self._tokens)
 
     def level(self) -> float:
         with self._lock:
             self._refill(self._clock())
             return self._tokens
+
+    def set_level(self, level: float) -> None:
+        """Restore the balance to an absolute level (WAL recovery:
+        the journal records post-charge levels, and a restarted router
+        must not re-mint a drained tenant a full bucket).  Refill
+        resumes from NOW — downtime refill is deliberately forfeited
+        (conservative: a recovering control plane under-grants)."""
+        if self.rate <= 0:
+            return
+        with self._lock:
+            self._tokens = min(self.burst, float(level))
+            self._last = self._clock()
 
 
 class TenantQuotas:
@@ -280,14 +313,22 @@ class TenantQuotas:
                 self._buckets.pop(victim)
             return b
 
-    def take(self, tenant: str, n: float = 1.0) -> tuple[bool, float]:
+    def take(self, tenant: str, n: float = 1.0,
+             journal=None) -> tuple[bool, float]:
         """Charge ``n`` work units (cost-priced admission passes the
         request's predicted device-seconds; the legacy request-count
-        scheme is the degenerate ``n=1``)."""
-        return self.bucket(tenant).try_take(n)
+        scheme is the degenerate ``n=1``).  ``journal`` rides through
+        to the bucket (called with the post-charge balance under its
+        lock)."""
+        return self.bucket(tenant).try_take(n, journal=journal)
 
-    def refund(self, tenant: str, n: float = 1.0) -> None:
-        self.bucket(tenant).refund(n)
+    def refund(self, tenant: str, n: float = 1.0, journal=None) -> None:
+        self.bucket(tenant).refund(n, journal=journal)
+
+    def restore_level(self, tenant: str, level: float) -> None:
+        """WAL-recovery seeding: set a tenant's balance to the level
+        the journal last recorded for it."""
+        self.bucket(tenant).set_level(level)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -353,6 +394,11 @@ class InProcessReplica:
         """Pre-compile declared configs on the live service (the
         warm-placement surface the autoscaler drives BEFORE ring join)."""
         return self._live().warm(configs)
+
+    def fence(self, epoch: int) -> tuple[int, dict]:
+        """Ratchet the replica's router-epoch fence (takeover
+        propagation — round 19)."""
+        return self._live().fence(epoch)
 
     def snapshot(self) -> dict:
         return self._live().stats()[1]
@@ -498,17 +544,29 @@ class HTTPReplica:
     def readyz(self):
         return self._get("/readyz", timeout=self.probe_timeout)
 
-    def warm(self, configs) -> tuple[int, dict]:
-        """POST /v1/warm — pre-compile declared configs (warm placement
-        over the wire; compiles can take a while, so no probe budget)."""
-        resp = self._post("/v1/warm", {"configs": list(configs or ())},
-                          None, None)
+    def _post_json(self, path: str, body: dict, timeout):
+        """POST + parse-or-typed-fallback (shared by the non-routing
+        control surfaces: warm, fence)."""
+        resp = self._post(path, body, timeout, None)
         with resp if hasattr(resp, "__enter__") else _closing(resp) as r:
             status = getattr(r, "status", None) or r.code
             try:
                 return status, json.loads(r.read())
             except ValueError:
                 return status, {"ok": False, "detail": f"http {status}"}
+
+    def warm(self, configs) -> tuple[int, dict]:
+        """POST /v1/warm — pre-compile declared configs (warm placement
+        over the wire; compiles can take a while, so no probe budget)."""
+        return self._post_json("/v1/warm",
+                               {"configs": list(configs or ())}, None)
+
+    def fence(self, epoch: int) -> tuple[int, dict]:
+        """POST /v1/fence — ratchet the replica's router-epoch fence
+        (short probe budget: fencing is a takeover-path sweep and one
+        black-holing host must not stall it)."""
+        return self._post_json("/v1/fence", {"epoch": int(epoch)},
+                               self.probe_timeout)
 
     def snapshot(self) -> dict:
         return self._get("/stats")[1]
@@ -588,7 +646,7 @@ class ReplicaRouter:
                  poll_interval_s: float = 0.25, load_factor: float = 2.0,
                  hedge_s: float | None = None, start_health: bool = True,
                  durable: bool = True, job_capacity: int = 64,
-                 clock=time.monotonic):
+                 wal=None, clock=time.monotonic):
         if not replicas:
             raise ValueError("at least one replica required")
         names = [r.name for r in replicas]
@@ -638,12 +696,206 @@ class ReplicaRouter:
             "routed": 0, "completed": 0, "failovers": 0, "spills": 0,
             "hedges": 0, "rejected_tenant_quota": 0,
             "rejected_unavailable": 0, "progressive": 0, "resumes": 0,
-            "mid_stream_failovers": 0,
+            "mid_stream_failovers": 0, "wal_records": 0,
+            "wal_write_errors": 0,
         })
+        # Crash-safe control plane (round 19): a write-ahead journal of
+        # admissions / newest resume tokens / finals / ring membership /
+        # tenant debt, replayed at construction — constructing a router
+        # over an existing WAL IS the takeover.  ``self.epoch`` is the
+        # fencing epoch: monotonic per WAL lineage, stamped on every
+        # router→replica request, ratcheted replica-side, so a zombie
+        # predecessor is rejected (``stale_epoch``) everywhere.  With
+        # no WAL the epoch stays 0 and nothing is stamped (fencing is a
+        # property of the durable deployment).
+        self.wal = None
+        self.epoch = 0
+        if wal is not None:
+            from parallel_convolution_tpu.serving.wal import RouterWAL
+
+            self.wal = (wal if isinstance(wal, RouterWAL)
+                        else RouterWAL(wal))
+            self._recover()
         self._closed = threading.Event()
         self._poll_thread: threading.Thread | None = None
         if start_health:
             self.start_health()
+
+    # -- crash recovery (round 19) --------------------------------------------
+    def _wal_append(self, kind: str, **fields) -> None:
+        """One WAL record, never fatal: a durability failure (disk
+        full, injected ``wal_write``/``wal_fsync`` fault) is a LOUD
+        counter + event, not a serving outage — the stream keeps
+        flowing and recovery falls back to the newest record that DID
+        land (an older boundary: more recompute, same bytes)."""
+        if self.wal is None:
+            return
+        try:
+            self.wal.append(kind, **fields)
+        except Exception as e:  # noqa: BLE001 — durability degrades loudly
+            self._bump("wal_write_errors")
+            if obs_metrics.enabled():
+                obs_metrics.counter(
+                    "pctpu_wal_append_errors_total",
+                    "router WAL appends that failed (durability "
+                    "degraded; serving unaffected)", ("kind",)).inc(
+                    kind=kind)
+                obs_events.emit("wal", event="append_failed",
+                                record_kind=kind, error=repr(e)[:200])
+        else:
+            self._bump("wal_records")
+
+    def _refund(self, tenant: str, amount: float) -> None:
+        """Quota refund + its WAL debt record (one path; the journal
+        hook runs UNDER the bucket's lock so the recorded level is
+        atomic with the balance change and same-tenant record order
+        equals operation order — recovery's last-level-wins replay
+        depends on both)."""
+        if self.quotas is None or amount <= 0:
+            return
+        self.quotas.refund(
+            tenant, amount,
+            journal=(None if self.wal is None else (
+                lambda lvl: self._wal_append(
+                    "debt", tenant=tenant, delta=round(-amount, 9),
+                    level=round(lvl, 9)))))
+
+    def _recover(self) -> None:
+        """Startup recovery: fold the WAL into live state, reconcile
+        against the replicas (``/readyz`` + ``/stats``), bump the
+        fencing epoch past everything ever seen, and propagate it.
+
+        Invariants (DESIGN.md "Durable control plane"):
+
+        1. the new epoch is strictly greater than the WAL's AND every
+           reachable replica's fence — so even when the WAL was
+           quarantined (or lost) a zombie predecessor cannot win;
+        2. jobs resume from their newest DURABLE token (the ledger is
+           seeded; the client's retry of the typed mid-stream row picks
+           the token up via ``begin`` exactly like an in-process
+           failover) and the exactly-once final gate survives the
+           restart;
+        3. ring membership replays: a member the WAL saw removed stays
+           out; a provided transport the WAL never met joins normally;
+           a recovered member with NO transport in this pool is dropped
+           loudly (it cannot be dispatched to);
+        4. tenant buckets restore to their journaled post-charge levels
+           (refill resumes from now — recovery under-grants, never
+           re-mints a drained tenant).
+        """
+        state = self.wal.state
+        wal_epoch = state.epoch   # pre-bump (the epoch append below
+        #                           folds into the same state object)
+        report = dict(self.wal.recovery_report)
+        # (2) durable jobs + the exactly-once gate.
+        restored = self.jobs.restore(state.jobs, state.finalized)
+        # (3) ring reconciliation.
+        provided = set(self._replicas)
+        dropped_members = sorted(state.ring - provided)
+        removed = []
+        for name in sorted(provided):
+            if name in state.ring_ever and name not in state.ring:
+                self.ring.remove(name)
+                removed.append(name)
+        if not self.ring.members():
+            # Replay would leave an EMPTY ring (e.g. the only provided
+            # transports are ones the WAL saw scale-removed): a router
+            # that can route nothing is a silent total outage wearing
+            # a clean boot line.  Re-seat every provided replica,
+            # loudly — the operator pointed this pool at this WAL on
+            # purpose.
+            import warnings
+
+            warnings.warn(
+                "WAL recovery: ring replay removed every provided "
+                f"replica ({removed}); re-seating all of "
+                f"{sorted(provided)} rather than booting an "
+                "unroutable router", RuntimeWarning, stacklevel=3)
+            removed = []
+            for name in sorted(provided):
+                self.ring.add(name)
+                self._wal_append("ring_add", name=name)
+        # (4) tenant debt: restore journaled levels, then refund the
+        # UNEXECUTED fraction of every crash-interrupted priced job
+        # (its charge identity rides the admit record) — the
+        # incremental-charge rule across a restart: the client's retry
+        # re-charges only the remaining work, so die-takeover-resume-
+        # complete still costs one uninterrupted job.
+        refunded = {}
+        if self.quotas is not None:
+            for tenant, level in state.debts.items():
+                self.quotas.restore_level(tenant, level)
+            for lid, job in list(state.jobs.items()):
+                cost = job.get("cost")
+                if not cost:
+                    continue
+                budget = float(job.get("budget") or 0.0)
+                wu_start = float(job.get("wu_start") or 0.0)
+                wu_done = max(wu_start, token_progress(job.get("token")))
+                denom = max(budget - wu_start, 1e-9)
+                frac = max(0.0, min(1.0, (budget - wu_done) / denom))
+                amount = float(cost) * frac
+                if amount <= 0:
+                    continue
+                tenant = lid.split("\x1f", 1)[0]
+                self._refund(tenant, amount)
+                self._wal_append("job_settled", lid=lid)
+                refunded[lid] = round(amount, 6)
+        # (1) the fencing epoch: reconcile against every replica's own
+        # fence (its /stats carries fence_epoch), then go one past.
+        max_fence = 0
+        reachable = []
+        for name, rep in self._replicas.items():
+            try:
+                status, _ = rep.transport.readyz()
+                snap = rep.transport.snapshot()
+                max_fence = max(max_fence,
+                                int(snap.get("fence_epoch", 0) or 0))
+                reachable.append(name)
+            except Exception:  # noqa: BLE001 — a dead replica
+                continue
+        self.epoch = max(wal_epoch, max_fence) + 1
+        self._wal_append("epoch", epoch=self.epoch)
+        if not state.ring_ever:
+            # A fresh WAL: journal the boot membership so the first
+            # restart replays it instead of inferring it.
+            for name in self.ring.members():
+                self._wal_append("ring_add", name=name)
+        fenced = []
+        for name in reachable:
+            fence = getattr(self._replicas[name].transport, "fence",
+                            None)
+            if fence is None:
+                continue
+            try:
+                fence(self.epoch)
+                fenced.append(name)
+            except Exception:  # noqa: BLE001 — ratchets on first request
+                continue
+        self.recovery = {
+            "epoch": self.epoch, "wal_epoch": wal_epoch,
+            "max_replica_fence": max_fence, "jobs_restored": restored,
+            "finalized_restored": len(state.finalized),
+            "ring_removed": removed, "dropped_members": dropped_members,
+            "tenants_restored": sorted(state.debts),
+            "refunded_jobs": refunded,
+            "fenced": fenced, **report,
+        }
+        if dropped_members:
+            import warnings
+
+            warnings.warn(
+                f"WAL recovery: ring members {dropped_members} have no "
+                "transport in this pool — dropped from the recovered "
+                "ring (their keys remap to the surviving members)",
+                RuntimeWarning, stacklevel=3)
+        if obs_metrics.enabled():
+            obs_metrics.counter(
+                "pctpu_wal_recoveries_total",
+                "router WAL recoveries performed at startup").inc()
+            obs_events.emit("wal", event="recovered", **{
+                k: v for k, v in self.recovery.items()
+                if k != "detail"})
 
     # -- health ---------------------------------------------------------------
     def start_health(self) -> None:
@@ -686,7 +938,16 @@ class ReplicaRouter:
         a pricer armed; 1.0 in the legacy request-count scheme)."""
         if self.quotas is None:
             return None
-        ok, retry_after = self.quotas.take(tenant, cost)
+        # The journal hook records the post-charge level UNDER the
+        # bucket's lock (a restarted router must not re-mint a drained
+        # tenant a full bucket, and a level read outside the lock
+        # could journal a stale balance under concurrency).
+        ok, retry_after = self.quotas.take(
+            tenant, cost,
+            journal=(None if self.wal is None else (
+                lambda lvl: self._wal_append(
+                    "debt", tenant=tenant, delta=round(cost, 9),
+                    level=round(lvl, 9)))))
         if ok:
             if self.pricer is not None and obs_metrics.enabled():
                 obs_metrics.counter(
@@ -820,7 +1081,7 @@ class ReplicaRouter:
             off = offset % len(order)
             order = order[off:] + order[:off]
         meta = {"home": home, "replica": "", "attempts": 0,
-                "failovers": 0, "spills": 0}
+                "failovers": 0, "spills": 0, "epoch": self.epoch}
         last_shed = last_fail = None
         tp = (obs_trace.format_traceparent(sp.context)
               if sp.context is not None else None)
@@ -891,6 +1152,10 @@ class ReplicaRouter:
         body["request_id"] = rid
         tenant = str(tenant or body.get("tenant") or "default")
         body["tenant"] = tenant
+        if self.epoch:
+            # The fencing stamp (round 19): replicas ratchet on it and
+            # reject anything older — a zombie router cannot write.
+            body["router_epoch"] = self.epoch
         self._bump("routed")
         cost = (self.pricer.price(body)
                 if self.pricer is not None else 1.0)
@@ -901,7 +1166,8 @@ class ReplicaRouter:
                 sp.set(outcome="tenant_quota")
                 status, wire = shed
                 wire["router"] = {"home": "", "replica": "", "attempts": 0,
-                                  "failovers": 0, "spills": 0}
+                                  "failovers": 0, "spills": 0,
+                                  "epoch": self.epoch}
                 return status, wire
             key = route_key(body)
             self._observe_config(key, body)
@@ -920,7 +1186,7 @@ class ReplicaRouter:
                   and wire.get("rejected") in _REFUND_REJECTS):
                 # Refund the SAME charge admission took: with a pricer
                 # armed that is the request's work units, not 1.
-                self.quotas.refund(tenant, cost)
+                self._refund(tenant, cost)
             wire.setdefault("router", meta)
             if self.pricer is not None:
                 wire["router"].setdefault("cost_units", round(cost, 6))
@@ -1105,6 +1371,8 @@ class ReplicaRouter:
         body["request_id"] = rid
         tenant = str(tenant or body.get("tenant") or "default")
         body["tenant"] = tenant
+        if self.epoch:
+            body["router_epoch"] = self.epoch
         self._bump("routed")
         self._bump("progressive")
         key = route_key(body)
@@ -1141,7 +1409,25 @@ class ReplicaRouter:
                 sp.set(outcome="tenant_quota")
                 status, wire = shed
                 wire["kind"] = "rejected"
+                wire.setdefault("router", {"replica": "",
+                                           "epoch": self.epoch})
                 return status, iter([wire])
+            if self.durable:
+                # Write-ahead admission — AFTER the quota gate (a shed
+                # job took no charge, so it must leave no charge
+                # identity for a recovery to "refund"): the job and,
+                # with a pricer armed, its charge identity are durable
+                # before any replica sees it; recovery refunds the
+                # UNEXECUTED fraction of crash-interrupted jobs from
+                # exactly these fields.
+                self._wal_append(
+                    "admit", lid=lid, key=key,
+                    **({"cost": round(cost, 9),
+                        "budget": float(body.get("max_iters", 500)
+                                        or 500),
+                        "wu_start": token_progress(body.get("resume"))}
+                       if (self.pricer is not None
+                           and self.quotas is not None) else {}))
             # NOT observed into the warm-placement observatory: a
             # converge job's warm state is its chunk/level programs,
             # which warmup() cannot reproduce from these fields (the
@@ -1153,18 +1439,34 @@ class ReplicaRouter:
                                                 tried)
             if verdict == "pass":
                 sp.set(outcome=b.get("rejected") or "rejected")
+                # The request's own terminal fault: the charge stays,
+                # but the WAL must record it SETTLED — a recovery has
+                # nothing to reconcile for this job.
+                self._wal_append("job_settled", lid=lid)
+                b.setdefault("router", {"replica": "",
+                                        "epoch": self.epoch})
                 return a, iter([b])
             if verdict == "reject":
                 sp.set(outcome=b.get("rejected") or "rejected")
                 # Same refund rule as `request`: the token comes back
                 # only when NO replica did work — a terminal `error`
                 # outcome executed on a device and stays charged.
+                # Either way the charge identity settles NOW, so a
+                # later recovery can't refund it (or refund it twice).
                 if (self.quotas is not None
                         and b.get("rejected") in _REFUND_REJECTS):
-                    self.quotas.refund(tenant, cost)
+                    self._refund(tenant, cost)
+                self._wal_append("job_settled", lid=lid)
+                b.setdefault("router", {"replica": "",
+                                        "epoch": self.epoch})
                 return a, iter([b])
             rep, rows = a, b
             sp.set(outcome="streaming", replica=rep.name)
+            if self.durable:
+                # Pin the job while its stream is live: capacity
+                # eviction must never take a MID-STREAM job's token
+                # (the ledger_evicted fix — unpinned in release()).
+                self.jobs.pin(lid)
             if ledger_seeded:
                 # A client retry resuming from the ledger is a resume
                 # too — counted and stamped like a mid-stream one ("the
@@ -1183,6 +1485,8 @@ class ReplicaRouter:
                     if not hold["released"]:
                         hold["released"] = True
                         hold["rep"].in_flight -= 1
+                if self.durable:
+                    self.jobs.unpin(lid)
 
             return 200, ReleasingStream(
                 self._stream_durable(key, body, timeout, tp, rid, lid,
@@ -1216,6 +1520,8 @@ class ReplicaRouter:
         shared by the mid-stream failover and client-retry paths so the
         stamp/metric vocabulary cannot drift between them."""
         n_res, _ = self.jobs.note_resume(lid, key, from_name)
+        self._wal_append("resume", lid=lid, key=key,
+                         from_replica=from_name)
         self._bump("resumes")
         with self._lock:
             to_rep.stats["resumes"] += 1
@@ -1291,19 +1597,31 @@ class ReplicaRouter:
                                          bool(row.get("corrupt")), row)
                                 break
                             # invalid / tenant-level mid-stream rows: the
-                            # request's own story — pass through and stop.
+                            # request's own story — pass through and
+                            # stop (charge stays; settle it so recovery
+                            # has nothing to reconcile).
+                            self._wal_append("job_settled", lid=lid)
                             row.setdefault("router",
-                                           {"replica": rep.name})
+                                           {"replica": rep.name,
+                                            "epoch": self.epoch})
                             yield row
                             return
                         if self.durable:
-                            self.jobs.observe(lid, key, row)
+                            tok = self.jobs.observe(lid, key, row)
+                            if tok is not None:
+                                # Write-ahead: the token is durable
+                                # BEFORE the row reaches the client, so
+                                # a router crash right after this yield
+                                # still resumes from this boundary.
+                                self._wal_append("token", lid=lid,
+                                                 key=key, token=tok)
                             row.pop("state_b64", None)
                             row.pop("state_shape", None)
                         wu_last = max(wu_last, float(
                             row.get("work_units", 0.0) or 0.0))
                         rows_flowed += 1
-                        stamp = {"replica": rep.name}
+                        stamp = {"replica": rep.name,
+                                 "epoch": self.epoch}
                         n_res, res_from = self.jobs.resume_info(lid)
                         if n_res:
                             stamp["resume_count"] = n_res
@@ -1332,8 +1650,10 @@ class ReplicaRouter:
                                               "already delivered to a "
                                               "concurrent stream for "
                                               "this id",
-                                    "router": {"replica": rep.name}}
+                                    "router": {"replica": rep.name,
+                                               "epoch": self.epoch}}
                                 return
+                            self._wal_append("final", lid=lid)
                             self._bump("completed")
                             with self._lock:
                                 rep.stats["completed"] += 1
@@ -1376,7 +1696,9 @@ class ReplicaRouter:
                                                 a, token)
                         continue
                     if verdict == "pass":
-                        b.setdefault("router", {"replica": ""})
+                        self._wal_append("job_settled", lid=lid)
+                        b.setdefault("router", {"replica": "",
+                                                "epoch": self.epoch})
                         yield b
                         return
                     # Walk exhausted.  A NON-retryable typed death (a
@@ -1418,12 +1740,17 @@ class ReplicaRouter:
                         frac = max(0.0, min(1.0,
                                             (budget - wu_last) / denom))
                         if frac > 0:
-                            self.quotas.refund(tenant, cost * frac)
+                            self._refund(tenant, cost * frac)
                     elif (rows_flowed == 0
                           and end_row.get("rejected") in _REFUND_REJECTS):
-                        self.quotas.refund(tenant, cost)
+                        self._refund(tenant, cost)
+                # This stream END settles the charge identity — the
+                # refund (if any) just happened, so a later recovery
+                # must not reconcile this job again.  The token itself
+                # survives: a client retry still resumes.
+                self._wal_append("job_settled", lid=lid)
                 n_res, res_from = self.jobs.resume_info(lid)
-                stamp = {"replica": ""}
+                stamp = {"replica": "", "epoch": self.epoch}
                 if n_res:
                     stamp["resume_count"] = n_res
                     stamp["resumed_from"] = res_from
@@ -1438,6 +1765,8 @@ class ReplicaRouter:
                 if not hold["released"]:
                     hold["released"] = True
                     hold["rep"].in_flight -= 1
+            if self.durable:
+                self.jobs.unpin(lid)
 
     # -- pool mutation (autoscaling) ------------------------------------------
     def add_replica(self, transport, join_ring: bool = True) -> None:
@@ -1480,6 +1809,7 @@ class ReplicaRouter:
         if name not in self._replicas:
             raise KeyError(f"unknown replica {name!r}")
         self.ring.add(name)
+        self._wal_append("ring_add", name=name)
         if obs_metrics.enabled():
             obs_events.emit("router", event="ring_join", replica=name)
 
@@ -1503,6 +1833,7 @@ class ReplicaRouter:
             if len(self._replicas) <= 1:
                 raise ValueError("cannot remove the last replica")
         self.ring.remove(name)
+        self._wal_append("ring_remove", name=name)
         deadline = time.monotonic() + max(0.0, float(drain_s))
         while rep.in_flight > 0 and time.monotonic() < deadline:
             time.sleep(0.01)
@@ -1578,8 +1909,14 @@ class ReplicaRouter:
             "ring": sorted(members),
             "observed_keys": len(self._key_configs),
             # Durable-job ledger (round 18): live tokens + total resumes
-            # — the chaos-drill operator surface.
+            # — the chaos-drill operator surface.  Round 19 adds the
+            # ledger_evicted counter inside.
             "jobs": self.jobs.snapshot(),
+            # Crash-safe control plane (round 19): the fencing epoch
+            # and the WAL's own health.
+            "epoch": self.epoch,
+            **({"wal": self.wal.snapshot()}
+               if self.wal is not None else {}),
             **({"tenants": self.quotas.snapshot()}
                if self.quotas is not None else {}),
         }
@@ -1593,6 +1930,8 @@ class ReplicaRouter:
         t = self._poll_thread
         if t is not None and t.is_alive():
             t.join(5.0)
+        if self.wal is not None:
+            self.wal.close()
         if close_replicas:
             for rep in self._replicas.values():
                 try:

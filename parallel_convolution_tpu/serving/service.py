@@ -308,7 +308,17 @@ class ConvolutionService:
             "rejected_invalid": 0, "rejected_error": 0,
             "rejected_resharding": 0, "client_timeouts": 0,
             "reshapes": 0, "deduped": 0, "progressive": 0,
+            "rejected_stale_epoch": 0,
         })
+        # Router-epoch fence (round 19): the highest epoch any router
+        # has ever stamped on a request to THIS replica.  A request
+        # carrying a LOWER epoch comes from a zombie — a router that
+        # lost a fenced takeover — and is rejected before any work, so
+        # a stale active can never double-deliver after the standby
+        # took over.  Process memory on purpose: a replica restart
+        # clears its dedup ledger too, and the fence re-ratchets on the
+        # first request from the live router.
+        self._fence_epoch = 0
 
     # -- admission -----------------------------------------------------------
     def _bump(self, counter: str, n: int = 1) -> None:
@@ -956,6 +966,54 @@ class ConvolutionService:
                           else str(c.get("col_mode")))))
         return self.engine.warmup(keys)
 
+    def fence(self, epoch: int) -> int:
+        """Ratchet the router-epoch fence to at least ``epoch`` (the
+        takeover propagation call — ``POST /v1/fence``); returns the
+        fence after ratcheting.  Never lowers it."""
+        e = int(epoch)
+        with self._lock:
+            if e > self._fence_epoch:
+                self._fence_epoch = e
+            return self._fence_epoch
+
+    def fence_epoch(self) -> int:
+        with self._lock:
+            return self._fence_epoch
+
+    def epoch_gate(self, epoch) -> tuple[bool, int]:
+        """Admission-time fencing: ``(admit, current_fence)``.
+
+        ``None`` (a direct client, no router in the path) always
+        admits.  A NEWER epoch ratchets the fence and admits — the
+        first request from a freshly taken-over router is itself the
+        fence propagation.  A STALE epoch is refused (counted,
+        evented): the caller sheds it typed non-retryable
+        ``stale_epoch`` before any queueing or device work.
+        """
+        if epoch is None:
+            with self._lock:
+                return True, self._fence_epoch
+        try:
+            e = int(epoch)
+        except (TypeError, ValueError):
+            with self._lock:
+                return True, self._fence_epoch
+        with self._lock:
+            if e > self._fence_epoch:
+                self._fence_epoch = e
+            ok = e >= self._fence_epoch
+            if not ok:
+                self.stats["rejected_stale_epoch"] += 1
+            cur = self._fence_epoch
+        if not ok and obs_metrics.enabled():
+            obs_metrics.counter(
+                "pctpu_stale_epoch_rejects_total",
+                "requests refused for carrying a fenced-out router "
+                "epoch (zombie active after a takeover)").inc()
+            obs_events.emit("router", event="stale_epoch",
+                            epoch=e, fence=cur)
+        return ok, cur
+
     def readiness(self) -> tuple[bool, dict]:
         """The ``/readyz`` verdict: can this service usefully take a NEW
         request right now?
@@ -986,6 +1044,10 @@ class ConvolutionService:
             "progressive_bound": self.max_progressive,
             "warm_keys": warm_keys,
             "degraded": degraded,
+            # The router-epoch fence (round 19): a recovering router
+            # reads this off every replica to place its own epoch ABOVE
+            # anything any previous active ever stamped.
+            "fence_epoch": self.fence_epoch(),
             "grid": "x".join(str(v) for v in self.engine.grid()),
         }
 
@@ -1007,6 +1069,7 @@ class ConvolutionService:
                                        self.engine.mesh.shape["y"])),
             "platform": dev.platform,
             "device_kind": getattr(dev, "device_kind", "") or "",
+            "fence_epoch": self.fence_epoch(),
             # Topology identity (ROADMAP item 1's keying, pulled forward
             # in r17): loadgen summaries and perf_gate.row_key consume
             # these so a future multi-host row never shares a baseline

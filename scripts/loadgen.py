@@ -30,7 +30,10 @@ exponential) up to ``--shed-retries`` attempts instead of counting as
 final outcomes; the summary row reports ``rejected_retried``.  Multiple
 ``--target`` URLs round-robin the request stream across a raw replica
 set, or point one ``--target`` at ``scripts/router.py`` — responses
-carrying a ``router`` stamp feed the row's ``failovers_observed``.
+carrying a ``router`` stamp feed the row's ``failovers_observed``, and
+(round 19) their fencing-epoch stamps feed ``router_restarts_observed``
+— the count of router restarts/takeovers this client watched happen
+while its run kept completing.
 """
 
 from __future__ import annotations
@@ -513,6 +516,13 @@ def main() -> int:
             and r["router"]["replica"] != r["router"]["home"]))
     replicas_seen = sorted({r.get("router", {}).get("replica", "")
                             for _, r in completed} - {""})
+    # Round 19: the router stamps its fencing epoch on every response;
+    # an epoch CHANGE mid-run means the control plane restarted (or a
+    # standby took over) underneath this client — and the run kept
+    # completing anyway.  distinct-epochs-minus-one is the restart
+    # count this client can prove.
+    epochs_seen = sorted({r.get("router", {}).get("epoch")
+                          for _, r in completed} - {None, 0})
 
     row = {
         "workload": (f"serve {args.filter_name} {args.rows}x{args.cols}"
@@ -549,6 +559,8 @@ def main() -> int:
         "rejected_retried": retried[0],
         "failovers_observed": failovers_observed,
         **({"replicas_seen": replicas_seen} if replicas_seen else {}),
+        **({"router_restarts_observed": len(epochs_seen) - 1,
+            "router_epochs_seen": epochs_seen} if epochs_seen else {}),
         "non_rejected_failures": non_rejected_failures,
         "wall_s": round(wall, 4),
         "p50_ms": round(1e3 * _percentile(lats, 0.50), 3) if lats else None,

@@ -90,6 +90,13 @@ def main() -> int:
                          "predicted device-seconds per request instead "
                          "of 1 token (--tenant-rate then means "
                          "device-seconds per second)")
+    ap.add_argument("--wal", default=None, metavar="PATH",
+                    help="arm the crash-safe control plane (round 19): "
+                         "journal admissions/tokens/finals/ring/debt "
+                         "to PATH and RECOVER from it at boot — "
+                         "restarting this script on the same PATH is a "
+                         "fenced takeover (the epoch bumps; a zombie "
+                         "predecessor gets typed stale_epoch rejects)")
     args = ap.parse_args()
 
     if bool(args.target) == bool(args.replicas):
@@ -150,7 +157,8 @@ def main() -> int:
         breaker_cooldown_s=args.breaker_cooldown_s,
         poll_interval_s=args.poll_interval_s,
         load_factor=args.load_factor,
-        hedge_s=args.hedge_ms / 1e3 if args.hedge_ms else None)
+        hedge_s=args.hedge_ms / 1e3 if args.hedge_ms else None,
+        wal=args.wal)
 
     scaler = None
     if args.autoscale_max:
@@ -177,7 +185,10 @@ def main() -> int:
                       "replicas": [r.name for r in replicas],
                       "tenant_quota": bool(quotas),
                       "priced_admission": bool(pricer),
-                      "autoscale_max": args.autoscale_max or None},
+                      "autoscale_max": args.autoscale_max or None,
+                      **({"wal": args.wal, "epoch": router.epoch,
+                          "recovery": router.recovery}
+                         if args.wal else {})},
                      ), flush=True)
 
     stopping = []

@@ -83,6 +83,38 @@
 #                                      (failures: 0) lands in
 #                                      evidence/chaos_smoke.json (the
 #                                      supervisor leg's done_file).
+#   scripts/run_t1.sh --wal-smoke      crash-safe control plane (round 19):
+#                                      3 in-process replicas behind the
+#                                      WAL-backed durable router.  A
+#                                      converge stream is interrupted by a
+#                                      seeded router_kill crash; a second
+#                                      router takes over the SAME WAL
+#                                      (fenced: the epoch bumps past every
+#                                      replica's own fence) and the
+#                                      client's retry RESUMES from the
+#                                      recovered token.  Gates: final row
+#                                      byte-identical to the uninterrupted
+#                                      oracle, exactly one final row per
+#                                      request_id across both router
+#                                      lives, the zombie router's writes
+#                                      rejected typed stale_epoch,
+#                                      wal_write faults degrade durability
+#                                      loudly but never serving, torn-tail
+#                                      WAL damage tolerated while mid-log
+#                                      corruption quarantines typed, and
+#                                      the die-takeover-resume saga
+#                                      charged exactly one uninterrupted
+#                                      job (frozen quota clock).  Row
+#                                      (failures: 0) lands in
+#                                      evidence/wal_smoke.json (the
+#                                      supervisor leg's done_file).
+#   scripts/run_t1.sh --static         fast static gate (no jax): every
+#                                      .py byte-compiles, no bare
+#                                      'except:', and every mutation of a
+#                                      shared stats dict under serving/
+#                                      sits inside a lock-holding 'with'.
+#                                      Row (failures: 0) lands in
+#                                      evidence/static_check.json.
 #   scripts/run_t1.sh --serving-smoke  boot the in-process serving stack on
 #                                      the 8-virtual-device CPU mesh, push
 #                                      50 loadgen requests, exit nonzero on
@@ -222,6 +254,19 @@ if [ "${1:-}" = "--scale-smoke" ]; then
     PCTPU_OBS=1 \
     python scripts/scale_smoke.py --rows 48 --cols 64 --mesh 1x2 \
       --out evidence/scale_smoke.json
+fi
+
+if [ "${1:-}" = "--wal-smoke" ]; then
+  exec timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    PCTPU_OBS=1 \
+    python scripts/wal_smoke.py --n 12 --rows 40 --cols 56 \
+      --mesh 1x2 --out evidence/wal_smoke.json
+fi
+
+if [ "${1:-}" = "--static" ]; then
+  exec timeout -k 10 120 \
+    python scripts/static_check.py --out evidence/static_check.json
 fi
 
 if [ "${1:-}" = "--chaos-smoke" ]; then

@@ -67,6 +67,19 @@ byte-identical to the uninterrupted oracle, >= 1 mid-stream resume
 observed, exactly one final row per request_id:
 
   python scripts/soak.py --chaos 4 --seed 0
+
+``--router-restart N`` is the CRASH-SAFE CONTROL-PLANE drill (round
+19): one WAL lineage, N router lives.  Each cycle constructs a fresh
+``ReplicaRouter`` over the SAME WAL (a fenced takeover: the epoch must
+strictly increase), finishes the PREVIOUS life's crash-interrupted
+converge job via a client retry — which must RESUME from the recovered
+ledger token and end byte-identical to the uninterrupted oracle with
+exactly one final row per request_id — then starts a new converge job,
+crashes the router mid-stream at a seeded ``router_kill`` row, and
+verifies the dead life's object is rejected typed ``stale_epoch`` as a
+zombie.  A closing extra life drains the last pending job:
+
+  python scripts/soak.py --router-restart 3 --seed 0
 """
 
 from __future__ import annotations
@@ -649,6 +662,178 @@ def run_chaos_drill(args) -> int:
     return 1 if failures else 0
 
 
+def run_router_restart(args) -> int:
+    """Crash-safe control-plane drill (round 19): N router lives over
+    one WAL lineage; see module docstring for the gates."""
+    import base64
+
+    import numpy as np
+
+    from _chaos_common import (
+        converge_body, oracle_converge_final, request_with_backoff,
+    )
+    from parallel_convolution_tpu.ops import filters, oracle
+    from parallel_convolution_tpu.parallel.mesh import mesh_from_spec
+    from parallel_convolution_tpu.resilience import faults
+    from parallel_convolution_tpu.serving.chaos import router_kill_due
+    from parallel_convolution_tpu.serving.pricing import WorkPricer
+    from parallel_convolution_tpu.serving.router import (
+        InProcessReplica, ReplicaRouter, TenantQuotas,
+    )
+    from parallel_convolution_tpu.serving.service import ConvolutionService
+    from parallel_convolution_tpu.utils import imageio
+
+    rng = random.Random(args.seed)
+    img = imageio.generate_test_image(40, 56, "grey", seed=args.seed)
+    b64 = base64.b64encode(np.ascontiguousarray(img).tobytes()).decode()
+    want1 = oracle.run_serial_u8(img, filters.get_filter("blur3"), 1)
+
+    def factory():
+        return ConvolutionService(mesh_from_spec("1x2"),
+                                  max_delay_s=0.002, max_queue=256)
+
+    def cbody(rid: str) -> dict:
+        return converge_body(b64, 40, 56, rid, tenant="drill")
+
+    try:
+        oracle_final = oracle_converge_final(factory, cbody("oracle"))
+    except RuntimeError as e:
+        print(json.dumps({"summary": "router-restart", "failures": 1,
+                          "detail": str(e)}))
+        return 1
+
+    reps = [InProcessReplica(factory, name=f"rr{i}") for i in range(3)]
+    state_dir = Path(args.state_dir or tempfile.mkdtemp(
+        prefix="pctpu-router-restart-"))
+    wal_path = state_dir / "router.wal"
+
+    def mk_router():
+        return ReplicaRouter(
+            reps, wal=str(wal_path),
+            quotas=TenantQuotas(rate=1.0, burst=1e6),
+            pricer=WorkPricer(min_units=1e-9),
+            breaker_threshold=3, breaker_cooldown_s=0.2,
+            poll_interval_s=0.05, start_health=False)
+
+    failures: list[str] = []
+    finals_per_rid: dict[str, int] = {}
+    resumes = 0
+    epochs: list[int] = []
+    t0 = time.time()
+    prev_router = None
+    pending: str | None = None
+    lives = args.router_restart + 1   # the extra life drains the tail
+    for life in range(lives):
+        router = mk_router()
+        epochs.append(router.epoch)
+        if len(epochs) >= 2 and epochs[-1] <= epochs[-2]:
+            failures.append(
+                f"life {life}: epoch {epochs[-1]} did not bump past "
+                f"{epochs[-2]}")
+        if prev_router is not None:
+            # The dead life's object is now a zombie: fenced everywhere.
+            _, wz = prev_router.request({
+                "image_b64": b64, "rows": 40, "cols": 56,
+                "mode": "grey", "filter": "blur3", "iters": 1,
+                "request_id": f"z{life}", "tenant": "drill"})
+            if wz.get("rejected") != "stale_epoch" or wz.get(
+                    "retryable"):
+                failures.append(
+                    f"life {life}: zombie not fenced "
+                    f"({wz.get('rejected')!r})")
+            prev_router.close(close_replicas=False)
+        if pending is not None:
+            # Client retry of the crash-interrupted job: must RESUME
+            # from the WAL-recovered token and finish byte-identical.
+            st, rows = router.converge(cbody(pending))
+            drained = list(rows) if st == 200 else []
+            for r in drained:
+                if r.get("kind") == "final":
+                    finals_per_rid[pending] = finals_per_rid.get(
+                        pending, 0) + 1
+            final = drained[-1] if drained else {}
+            if final.get("kind") != "final":
+                failures.append(
+                    f"life {life}: retry of {pending!r} did not finish")
+            else:
+                if final.get("router", {}).get("resume_count", 0) >= 1:
+                    resumes += 1
+                else:
+                    failures.append(
+                        f"life {life}: {pending!r} restarted instead "
+                        f"of resuming ({final.get('router')})")
+                if final.get("image_b64") != oracle_final["image_b64"]:
+                    failures.append(
+                        f"life {life}: resumed final not "
+                        "byte-identical to oracle")
+            pending = None
+        # Batch sanity through this life (epoch stamps observed).
+        wire = request_with_backoff(router, {
+            "image_b64": b64, "rows": 40, "cols": 56, "mode": "grey",
+            "filter": "blur3", "iters": 1,
+            "request_id": f"b{life}", "tenant": "drill"})
+        if wire.get("ok"):
+            got = np.frombuffer(base64.b64decode(wire["image_b64"]),
+                                np.uint8).reshape(40, 56)
+            if not np.array_equal(got, want1):
+                failures.append(f"life {life}: batch byte mismatch")
+            if wire.get("router", {}).get("epoch") != router.epoch:
+                failures.append(f"life {life}: missing epoch stamp")
+        elif not wire.get("retryable"):
+            failures.append(
+                f"life {life}: non-rejected batch failure "
+                f"{wire.get('rejected')}")
+        if life == lives - 1:
+            router.close(close_replicas=False)
+            break
+        # Start a job and CRASH this router mid-stream at a seeded row.
+        rid = f"rr-job{life}"
+        kill_at = rng.randint(1, 3)
+        with faults.injected(f"router_kill:{kill_at}",
+                             seed=args.seed + life):
+            st, rows = router.converge(cbody(rid))
+            if st != 200:
+                failures.append(
+                    f"life {life}: job admission failed ({st})")
+            else:
+                killed = False
+                for row in rows:
+                    if row.get("kind") == "final":
+                        finals_per_rid[rid] = finals_per_rid.get(
+                            rid, 0) + 1
+                    if router_kill_due():
+                        killed = True
+                        break   # abandon un-closed: the crash
+                if killed:
+                    pending = rid
+                else:
+                    failures.append(
+                        f"life {life}: router_kill never fired")
+        prev_router = router
+
+    dup = {r: n for r, n in finals_per_rid.items() if n != 1}
+    if dup:
+        failures.append(f"exactly-once final rows violated: {dup}")
+    if args.router_restart >= 1 and resumes < 1:
+        failures.append("no cross-restart resume observed")
+    summary = {
+        "summary": "router-restart", "lives": lives, "seed": args.seed,
+        "epochs": epochs,
+        "resumes_observed": resumes,
+        "finals_per_request": finals_per_rid,
+        "wal": str(wal_path),
+        "wall_s": round(time.time() - t0, 1),
+        "failures": len(failures),
+        "failure_detail": failures[:8],
+    }
+    if args.summary_out:
+        p = Path(args.summary_out)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(json.dumps(summary) + "\n")
+    print(json.dumps(summary), flush=True)
+    return 1 if failures else 0
+
+
 def run_autoscale_drill(args) -> int:
     """Sustained-load autoscale drill: N grow/shrink cycles (round 17).
 
@@ -1026,6 +1211,15 @@ def main() -> int:
                          "failures, byte-identical completions incl. "
                          "resumed converge finals, >= 1 mid-stream "
                          "resume, exactly one final row per request_id")
+    ap.add_argument("--router-restart", type=int, default=0, metavar="N",
+                    help="crash-safe control-plane drill: N router "
+                         "lives over one WAL lineage; each life "
+                         "resumes the previous life's crash-"
+                         "interrupted converge job from the recovered "
+                         "token (byte-identical, exactly-once finals), "
+                         "crashes mid-stream at a seeded router_kill "
+                         "row, and proves the dead life is fenced "
+                         "typed stale_epoch")
     ap.add_argument("--summary-out", default=None, metavar="FILE",
                     help="also write the final summary row to FILE "
                          "(the tier-1 --elastic-smoke leg's done_file)")
@@ -1059,6 +1253,8 @@ def main() -> int:
         ap.error("--reshape and --faults are separate modes")
     if args.router_kill:
         return run_router_kill(args)
+    if args.router_restart:
+        return run_router_restart(args)
     if args.autoscale:
         return run_autoscale_drill(args)
     if args.chaos:

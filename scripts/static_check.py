@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Fast static gate: the ``run_t1.sh --static`` leg (round 19).
+
+Three checks, all stdlib, no jax import, a few seconds total:
+
+1. **compileall** — every ``.py`` under ``parallel_convolution_tpu/``,
+   ``scripts/``, and ``tests/`` byte-compiles (``py_compile`` to a
+   throwaway cache file; a syntax error anywhere fails the leg even if
+   no test imports that module).
+2. **no bare ``except:``** — a bare except swallows KeyboardInterrupt
+   and SystemExit; every handler in this tree names its exceptions (the
+   broad ones carry a ``# noqa: BLE001`` justification).  Regex over
+   source lines.
+3. **no unlocked mutation of shared ``stats`` dicts under
+   ``serving/``** — the serving plane's counters are shared across
+   handler/poll/batcher threads; every ``X.stats[...] = / += ...``
+   must sit lexically inside a ``with`` block whose context expression
+   names a lock (``_lock`` / ``_cv`` / ``lock``), or carry an explicit
+   ``# stats-lock: held`` pragma naming where the lock is taken.
+   AST-based (string matching can't see block structure).
+
+Exit 0 and ``{"failures": 0}`` in ``--out`` iff all three hold.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import py_compile
+import re
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+if __name__ == "__main__":
+    import _path  # noqa: F401  (repo root on sys.path)
+
+ROOT = Path(__file__).resolve().parent.parent
+CHECK_DIRS = ("parallel_convolution_tpu", "scripts", "tests")
+_BARE_EXCEPT = re.compile(r"^\s*except\s*:")
+
+
+def _rel(p: Path) -> str:
+    try:
+        return str(p.relative_to(ROOT))
+    except ValueError:
+        return str(p)
+
+
+_PRAGMA = "# stats-lock: held"
+
+
+def py_files() -> list[Path]:
+    out = []
+    for d in CHECK_DIRS:
+        out.extend(sorted((ROOT / d).rglob("*.py")))
+    return [p for p in out if "__pycache__" not in p.parts]
+
+
+def check_compiles(files) -> list[str]:
+    problems = []
+    with tempfile.NamedTemporaryFile(suffix=".pyc") as tmp:
+        for f in files:
+            try:
+                py_compile.compile(str(f), cfile=tmp.name, doraise=True)
+            except py_compile.PyCompileError as e:
+                problems.append(
+                    f"{_rel(f)}: does not compile: "
+                    f"{e.msg.splitlines()[0][:200]}")
+    return problems
+
+
+def check_bare_except(files) -> list[str]:
+    problems = []
+    for f in files:
+        for n, line in enumerate(
+                f.read_text(encoding="utf-8").splitlines(), 1):
+            if _BARE_EXCEPT.match(line):
+                problems.append(
+                    f"{_rel(f)}:{n}: bare 'except:' "
+                    "(name the exceptions; bare swallows "
+                    "KeyboardInterrupt/SystemExit)")
+    return problems
+
+
+def _locked_context(expr_src: str) -> bool:
+    """Does a with-item's source look like a lock acquisition?"""
+    s = expr_src.lower()
+    return "lock" in s or "_cv" in s or ".cv" in s
+
+
+def check_stats_locking(files) -> list[str]:
+    """Every ``<obj>.stats[...]`` assignment/augassign under serving/
+    must be inside a lock-holding ``with`` (or pragma'd)."""
+    problems = []
+    serving = [f for f in files
+               if "serving" in f.parts and f.suffix == ".py"]
+    for f in serving:
+        src = f.read_text(encoding="utf-8")
+        lines = src.splitlines()
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue  # check 1 reports it
+        # Parent links so we can walk ancestors.
+        parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+
+        def is_stats_subscript(target) -> bool:
+            return (isinstance(target, ast.Subscript)
+                    and isinstance(target.value, ast.Attribute)
+                    and target.value.attr == "stats")
+
+        for node in ast.walk(tree):
+            targets = []
+            if isinstance(node, ast.AugAssign):
+                targets = [node.target]
+            elif isinstance(node, ast.Assign):
+                targets = node.targets
+            if not any(is_stats_subscript(t) for t in targets):
+                continue
+            line = lines[node.lineno - 1] if node.lineno <= len(
+                lines) else ""
+            if _PRAGMA in line:
+                continue
+            cur = node
+            locked = False
+            while cur in parents and not locked:
+                cur = parents[cur]
+                if isinstance(cur, ast.With):
+                    for item in cur.items:
+                        seg = ast.get_source_segment(
+                            src, item.context_expr) or ""
+                        if _locked_context(seg):
+                            locked = True
+                            break
+            if not locked:
+                problems.append(
+                    f"{_rel(f)}:{node.lineno}: mutation of "
+                    "a shared stats dict outside a lock-holding "
+                    "'with' block (take the owning lock, or annotate "
+                    f"'{_PRAGMA} <where>' if the caller holds it)")
+    return problems
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="evidence/static_check.json")
+    args = ap.parse_args()
+
+    t0 = time.time()
+    files = py_files()
+    failures: list[str] = []
+    failures += check_compiles(files)
+    failures += check_bare_except(files)
+    failures += check_stats_locking(files)
+
+    row = {
+        "workload": "static-check compileall+bare-except+stats-lock",
+        "files_checked": len(files),
+        "wall_s": round(time.time() - t0, 3),
+        "failures": len(failures),
+        "failure_detail": failures[:20],
+    }
+    out = Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(row, indent=2))
+    print(json.dumps(row), flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

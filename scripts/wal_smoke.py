@@ -1,0 +1,381 @@
+#!/usr/bin/env python
+"""Crash-safe control-plane smoke: the ``run_t1.sh --wal-smoke`` leg
+(round 19).
+
+Boot a WAL-backed durable router over three in-process replicas and
+prove the control plane itself can die and come back:
+
+1. **Batch sanity under the WAL** — every request completes (or sheds
+   typed retryable), byte-identical to the oracle, and every response
+   carries the router's fencing-epoch stamp.
+2. **The kill-the-router drill** — a converge stream is interrupted by
+   a seeded ``router_kill`` fault (``serving.chaos.router_kill_due``
+   polled per row: the stream is ABANDONED un-closed, exactly what a
+   crashed process leaves).  A second router constructed over the SAME
+   WAL is the fenced takeover: the client's retry of the same
+   ``request_id`` resumes from the newest durable token, the final row
+   is byte-identical to the uninterrupted oracle run, and exactly ONE
+   final row per request_id was delivered across both lives.
+3. **Zombie fencing** — the dead router's object (epoch E) submits a
+   request after the takeover (epoch E+1): every replica rejects it
+   typed, non-retryable ``stale_epoch`` — a zombie active can never
+   double-deliver.
+4. **Durability degrades loudly, never serving** — a converge run under
+   injected ``wal_write`` faults still completes byte-identical; the
+   router's ``wal_write_errors`` counter says durability was hit.
+5. **Torn tail vs corruption** — a half-written record appended to a
+   copy of the WAL replays losslessly (torn tail tolerated, reported);
+   a mid-log byte flip is a typed ``WALCorrupt`` quarantine — never a
+   silent partial replay.
+6. **Incremental charging across the restart** — with the pricer armed
+   and a frozen quota clock, the whole die-takeover-resume-complete
+   saga costs ONE uninterrupted job's units.
+
+The summary row lands in ``--out`` (``evidence/wal_smoke.json``) with
+``"failures": 0`` iff every gate held, then feeds ``perf_gate.py``
+against the smoke's OWN history file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import _path  # noqa: F401  (repo root + JAX_PLATFORMS re-apply)
+
+SCRIPTS = Path(__file__).resolve().parent
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n", type=int, default=12,
+                    help="batch requests in the sanity phase")
+    ap.add_argument("--rows", type=int, default=40)
+    ap.add_argument("--cols", type=int, default=56)
+    ap.add_argument("--mesh", default="1x2", help="grid per replica")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default="evidence/wal_smoke.json")
+    ap.add_argument("--history",
+                    default="evidence/wal_smoke_history.jsonl",
+                    help="the smoke's OWN perf history, seeded fresh "
+                         "each run; never the committed "
+                         "evidence/perf_history.jsonl")
+    args = ap.parse_args()
+
+    import tempfile
+
+    import numpy as np
+
+    from _chaos_common import (
+        converge_body as _cbody, oracle_converge_final,
+        request_with_backoff,
+    )
+    from parallel_convolution_tpu.obs import events as obs_events
+    from parallel_convolution_tpu.ops import filters, oracle
+    from parallel_convolution_tpu.parallel.mesh import mesh_from_spec
+    from parallel_convolution_tpu.resilience import faults
+    from parallel_convolution_tpu.serving.chaos import router_kill_due
+    from parallel_convolution_tpu.serving.pricing import WorkPricer
+    from parallel_convolution_tpu.serving.router import (
+        InProcessReplica, ReplicaRouter, TenantQuotas,
+    )
+    from parallel_convolution_tpu.serving.service import ConvolutionService
+    from parallel_convolution_tpu.serving.wal import (
+        RouterWAL, WALCorrupt, read_wal,
+    )
+    from parallel_convolution_tpu.utils import imageio
+
+    obs_events.install_from_env()
+    failures: list[str] = []
+    t0 = time.time()
+    img = imageio.generate_test_image(args.rows, args.cols, "grey",
+                                      seed=7)
+    b64 = base64.b64encode(np.ascontiguousarray(img).tobytes()).decode()
+    iters_pool = [1, 2, 3]
+    oracles = {it: oracle.run_serial_u8(
+        img, filters.get_filter("blur3"), it) for it in iters_pool}
+
+    def batch_body(i: int) -> dict:
+        return {"image_b64": b64, "rows": args.rows, "cols": args.cols,
+                "mode": "grey", "filter": "blur3",
+                "iters": iters_pool[i % len(iters_pool)],
+                "request_id": f"wb{i}", "tenant": "drill"}
+
+    def converge_body(rid: str) -> dict:
+        return _cbody(b64, args.rows, args.cols, rid, tenant="drill")
+
+    def factory():
+        return ConvolutionService(mesh_from_spec(args.mesh),
+                                  max_delay_s=0.002, max_queue=256)
+
+    # ---- the uninterrupted ORACLE converge run (clean router, no WAL)
+    try:
+        oracle_final = oracle_converge_final(factory,
+                                             converge_body("oracle"))
+    except RuntimeError as e:
+        failures.append(str(e))
+        oracle_final = {}
+
+    tmp = Path(tempfile.mkdtemp(prefix="pctpu-wal-smoke-"))
+    wal_path = tmp / "router.wal"
+    reps = [InProcessReplica(factory, name=f"w{i}") for i in range(3)]
+    clock = [0.0]   # frozen quota clock: exact charge arithmetic
+    one_job_pricer = WorkPricer(min_units=1e-9)
+
+    def mk_router():
+        return ReplicaRouter(
+            reps, wal=str(wal_path),
+            quotas=TenantQuotas(rate=1.0, burst=1e6,
+                                clock=lambda: clock[0]),
+            pricer=WorkPricer(min_units=1e-9),
+            breaker_threshold=3, breaker_cooldown_s=0.2,
+            poll_interval_s=0.05)
+
+    finals_per_rid: dict[str, int] = {}
+
+    def count_finals(rows) -> list[dict]:
+        out = []
+        for r in rows:
+            out.append(r)
+            if r.get("kind") == "final":
+                rid = r.get("request_id", "")
+                finals_per_rid[rid] = finals_per_rid.get(rid, 0) + 1
+        return out
+
+    # ---- phase 1: batch sanity + epoch stamps -----------------------------
+    router1 = mk_router()
+    epoch1 = router1.epoch
+    if epoch1 < 1:
+        failures.append(f"fresh WAL router booted with epoch {epoch1}")
+    completed = 0
+    for i in range(args.n):
+        wire = request_with_backoff(router1, batch_body(i))
+        if wire.get("ok"):
+            completed += 1
+            got = np.frombuffer(base64.b64decode(wire["image_b64"]),
+                                np.uint8).reshape(img.shape)
+            if not np.array_equal(
+                    got, oracles[iters_pool[i % len(iters_pool)]]):
+                failures.append(f"batch {i}: oracle byte mismatch")
+            if wire.get("router", {}).get("epoch") != epoch1:
+                failures.append(
+                    f"batch {i}: missing/wrong epoch stamp "
+                    f"{wire.get('router', {}).get('epoch')}")
+        elif not wire.get("retryable"):
+            failures.append(
+                f"batch {i}: non-rejected failure {wire.get('rejected')}")
+    if completed < args.n:
+        failures.append(f"only {completed}/{args.n} batch completed")
+
+    # ---- phase 2: kill the router mid-stream ------------------------------
+    # The seeded router_kill site picks the crash row: after 2 snapshot
+    # rows have reached the client, the stream is ABANDONED (no close —
+    # a crashed process closes nothing) and a new router takes over.
+    level0 = router1.quotas.bucket("drill").level()
+    rows_before_kill = 0
+    killed = False
+    with faults.injected("router_kill:3", seed=args.seed):
+        st, rows = router1.converge(converge_body("wal-kill"))
+        if st != 200:
+            failures.append(f"kill-drill admission failed: {st}")
+        else:
+            # Consume INCREMENTALLY (the crash happens mid-stream; a
+            # drained list would let the job finish first) and abandon
+            # the iterator un-closed — a crashed process closes nothing.
+            for row in rows:
+                count_finals([row])
+                rows_before_kill += 1
+                if router_kill_due():
+                    killed = True
+                    break   # the router "process" dies here
+            if not killed:
+                failures.append("router_kill never fired — the drill "
+                                "completed uninterrupted")
+    charged_life1 = level0 - router1.quotas.bucket("drill").level()
+
+    # ---- phase 3: fenced takeover -----------------------------------------
+    router2 = mk_router()
+    if router2.epoch <= epoch1:
+        failures.append(
+            f"takeover epoch {router2.epoch} did not bump past {epoch1}")
+    rec = router2.recovery
+    if rec.get("jobs_restored", 0) < 1:
+        failures.append(f"no jobs restored from the WAL: {rec}")
+    if rec.get("records", 0) < 1:
+        failures.append(f"takeover replayed no WAL records: {rec}")
+
+    # Zombie: the dead router's object still holds epoch1 — every
+    # replica must reject its writes typed, non-retryably.
+    stz, wz = router1.request(dict(batch_body(0), request_id="zombie"))
+    if wz.get("rejected") != "stale_epoch" or wz.get("retryable"):
+        failures.append(
+            f"zombie not fenced: status {stz}, rejected "
+            f"{wz.get('rejected')!r}, retryable {wz.get('retryable')}")
+    stz2, zrows = router1.converge(converge_body("zombie-cv"))
+    zfirst = next(iter(zrows), {})
+    if zfirst.get("rejected") != "stale_epoch":
+        failures.append(
+            f"zombie converge not fenced: {zfirst.get('rejected')!r}")
+    router1.close(close_replicas=False)
+
+    # The client retries the SAME request_id against the new router: it
+    # must resume from the WAL-recovered token, not iteration 0.
+    st, rows = router2.converge(converge_body("wal-kill"))
+    got = count_finals(rows) if st == 200 else []
+    final3 = got[-1] if got else {}
+    if final3.get("kind") != "final":
+        failures.append(f"takeover retry did not finish: "
+                        f"{ {k: v for k, v in final3.items() if k != 'image_b64'} }")
+    else:
+        if got[0].get("iters", 0) <= 10 * (rows_before_kill - 1):
+            failures.append(
+                f"retry restarted instead of resuming: first row at "
+                f"iters {got[0].get('iters')} after {rows_before_kill} "
+                "pre-crash rows")
+        if final3.get("router", {}).get("resume_count", 0) < 1:
+            failures.append("takeover final carries no resume stamp: "
+                            f"{final3.get('router')}")
+        if final3.get("router", {}).get("epoch") != router2.epoch:
+            failures.append("takeover rows not stamped with the new "
+                            f"epoch: {final3.get('router')}")
+        if final3.get("image_b64") != oracle_final.get("image_b64"):
+            failures.append("takeover final row is NOT byte-identical "
+                            "to the uninterrupted oracle run")
+    dup = {r: n for r, n in finals_per_rid.items() if n != 1}
+    if dup:
+        failures.append(f"exactly-once final rows violated: {dup}")
+
+    # Incremental charge across the restart: the WAL's debt records
+    # make the two routers' buckets ONE ledger (router2 restored to
+    # router1's journaled post-charge level, then recovery refunded
+    # the interrupted job's unexecuted fraction), so comparing levels
+    # ACROSS the routers prices the whole saga — which must cost one
+    # uninterrupted job (frozen clock: no refill slack).
+    level2 = router2.quotas.bucket("drill").level()
+    one_job = one_job_pricer.price(converge_body("price-ref"),
+                                   converge=True)
+    charged_total = level0 - level2
+    if not (0.85 * one_job <= charged_total <= 1.15 * one_job):
+        failures.append(
+            f"die-takeover-resume saga charged {charged_total:.4g} "
+            f"units, expected one job's {one_job:.4g} (incremental "
+            "rule across the restart)")
+
+    # ---- phase 4: wal_write faults degrade durability, never serving ------
+    wal_errs0 = router2.stats["wal_write_errors"]
+    with faults.injected("wal_write:2+", seed=args.seed):
+        st, rows = router2.converge(converge_body("wal-degraded"))
+        got = count_finals(rows) if st == 200 else []
+    final = got[-1] if got else {}
+    if final.get("kind") != "final":
+        failures.append("converge under wal_write faults did not finish")
+    elif final.get("image_b64") != oracle_final.get("image_b64"):
+        failures.append("wal_write-fault final not byte-identical")
+    if router2.stats["wal_write_errors"] <= wal_errs0:
+        failures.append("wal_write faults injected but "
+                        "wal_write_errors counter flat")
+
+    # ---- phase 5: torn tail vs mid-log corruption -------------------------
+    # Isolated copies of the LIVE file only (the real lineage has a
+    # rotated .1 generation next to it; a copy in a fresh dir replays
+    # standalone — its head is the takeover's compaction snapshot).
+    clean_dir = tmp / "clean"
+    clean_dir.mkdir()
+    clean_copy = clean_dir / "w.wal"
+    clean_copy.write_bytes(wal_path.read_bytes())
+    torn_dir = tmp / "torn"
+    torn_dir.mkdir()
+    torn_copy = torn_dir / "w.wal"
+    torn_copy.write_bytes(wal_path.read_bytes())
+    with open(torn_copy, "a", encoding="utf-8") as fh:
+        fh.write('deadbeef {"seq": 99999, "kind": "final", "lid"')
+    try:
+        recs_ok, _ = read_wal(clean_copy)
+        recs_torn, torn = read_wal(torn_copy)
+    except WALCorrupt as e:
+        failures.append(f"torn tail mis-classified as corruption: {e}")
+    else:
+        if torn is None:
+            failures.append("torn tail not reported")
+        if len(recs_torn) != len(recs_ok):
+            failures.append(
+                f"torn-tail replay lost records: {len(recs_torn)} != "
+                f"{len(recs_ok)}")
+    corrupt_dir = tmp / "corrupt"
+    corrupt_dir.mkdir()
+    corrupt_copy = corrupt_dir / "w.wal"
+    data = clean_copy.read_bytes()
+    mid = len(data) // 2
+    corrupt_copy.write_bytes(
+        data[:mid] + bytes([data[mid] ^ 0xFF]) + data[mid + 1:])
+    try:
+        read_wal(corrupt_copy)
+        failures.append("mid-log byte flip replayed silently")
+    except WALCorrupt as e:
+        if e.cause not in ("crc", "json", "format", "seq_gap",
+                           "unknown_kind"):
+            failures.append(f"corruption cause untyped: {e.cause!r}")
+
+    snap = router2.snapshot()
+    router2.close()
+
+    wall = time.time() - t0
+    px = args.rows * args.cols * (
+        sum(iters_pool[i % len(iters_pool)] for i in range(args.n))
+        + 2 * 40)   # two 40-iteration converge jobs
+    row = {
+        "workload": f"wal-smoke blur3+jacobi3 {args.rows}x{args.cols} "
+                    "3 replicas router-kill takeover zombie-fence",
+        "n": args.n + 2,
+        "batch_completed": completed,
+        "epoch_life1": epoch1,
+        "epoch_life2": snap["epoch"],
+        "rows_before_kill": rows_before_kill,
+        "jobs_restored": rec.get("jobs_restored"),
+        "wal_records_replayed": rec.get("records"),
+        "resume_count": (final3.get("router", {}).get("resume_count")
+                         if final3 else None),
+        "finals_per_request": dict(finals_per_rid),
+        "charged_units": round(charged_total, 6),
+        "charged_life1": round(charged_life1, 6),
+        "one_job_units": round(one_job, 6),
+        "wal_write_errors": snap["router"]["wal_write_errors"],
+        "ledger_evicted": snap["jobs"].get("ledger_evicted"),
+        "stale_epoch_rejected": wz.get("rejected") == "stale_epoch",
+        "effective_backend": "shifted",
+        "mesh": args.mesh,
+        "wall_s": round(wall, 3),
+        "gpixels_per_s": round(px / wall / 1e9, 6) if wall else None,
+        "failures": len(failures),
+        "failure_detail": failures[:8],
+    }
+
+    # ---- perf sentry feed: seed the smoke's own history, then re-gate.
+    out = Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(row, indent=2))
+    hist = Path(args.history)
+    hist.parent.mkdir(parents=True, exist_ok=True)
+    hist.write_text("")   # the smoke's OWN history: truncate per run
+    gate = [sys.executable, str(SCRIPTS / "perf_gate.py"),
+            "--history", str(hist), "--row", str(out), "--quiet"]
+    rc_seed = subprocess.run([*gate, "--update"], check=False).returncode
+    rc_pass = subprocess.run(gate, check=False).returncode
+    if rc_seed != 0:
+        failures.append(f"perf_gate seed run exited {rc_seed}")
+    if rc_pass != 0:
+        failures.append(f"perf_gate re-gate exited {rc_pass}")
+    row["failures"] = len(failures)
+    row["failure_detail"] = failures[:10]
+    out.write_text(json.dumps(row, indent=2))
+    print(json.dumps(row), flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

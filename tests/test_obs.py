@@ -232,7 +232,14 @@ def test_event_log_multithread_rotation_stress(tmp_path):
     in."""
     import os
 
-    log = events.EventLog(tmp_path / "ev.jsonl", max_bytes=4096, keep=50)
+    # keep must exceed the WORST-CASE rotation count or the test races
+    # its own mover thread: 6x200 lines x ~200 B / 4096 B/segment is up
+    # to ~60 rotations, and with keep=50 a starved mover let the
+    # writer's own (correct) rotation delete generation 51+ — a flaky
+    # false failure on loaded machines.  120 gives 2x headroom while
+    # still forcing dozens of rotations.
+    log = events.EventLog(tmp_path / "ev.jsonl", max_bytes=4096,
+                          keep=120)
     n_threads, n_lines = 6, 200
     stop = threading.Event()
     errors: list[BaseException] = []

@@ -1,0 +1,814 @@
+"""Crash-safe control plane (round 19, ISSUE 15).
+
+The acceptance properties, all on the 8-virtual-device CPU mesh:
+
+* the WAL round-trips: every record appended is replayed into the SAME
+  folded state by a fresh reader, across segment rotation (the fresh
+  live file's compaction-snapshot head makes dropped generations
+  lossless);
+* replay is never silently partial (property-tested): random
+  truncations of the live file replay a clean PREFIX (at most one torn
+  tail record, reported); random byte flips anywhere else raise
+  ``WALCorrupt`` with a typed cause; a damaged lineage QUARANTINES
+  loudly and the router still boots;
+* constructing a router over an existing WAL is a FENCED takeover: the
+  epoch bumps past the WAL's and every replica's own fence, a converge
+  stream interrupted by a router crash resumes from its newest durable
+  token with a byte-identical final and exactly one final row per
+  request_id ACROSS the restart, and the zombie predecessor's writes
+  are rejected typed, non-retryable ``stale_epoch`` — including its
+  own WAL appends (``WALFenced`` lineage check);
+* the incremental-charge rule survives the restart: recovery refunds
+  the interrupted job's unexecuted fraction (journaled, so a second
+  recovery cannot refund twice) and the retry pays only the remainder;
+* ``JobLedger`` capacity eviction skips PINNED (mid-stream) jobs and
+  counts what it does evict (``ledger_evicted`` in ``/stats``);
+* the DESIGN.md fault-site table matches ``faults.SITE_TABLE`` exactly
+  (keys AND descriptions — the doc can never silently rot);
+* the ``--static`` leg's lint actually detects what it claims to
+  forbid (bare ``except:``, unlocked stats mutation under serving/).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import re
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from parallel_convolution_tpu.ops import filters, oracle
+from parallel_convolution_tpu.parallel import mesh as mesh_lib
+from parallel_convolution_tpu.resilience import degrade, faults
+from parallel_convolution_tpu.serving.chaos import router_kill_due
+from parallel_convolution_tpu.serving.jobs import JobLedger
+from parallel_convolution_tpu.serving.pricing import WorkPricer
+from parallel_convolution_tpu.serving.router import (
+    InProcessReplica, ReplicaRouter, TenantQuotas,
+)
+from parallel_convolution_tpu.serving.service import ConvolutionService
+from parallel_convolution_tpu.serving.wal import (
+    RouterWAL, WALCorrupt, WALFenced, WALState, encode_record,
+    parse_line, read_wal,
+)
+from parallel_convolution_tpu.utils import imageio
+
+_TYPED_CAUSES = {"crc", "json", "format", "seq_gap", "unknown_kind"}
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_state():
+    yield
+    faults.uninstall_plan()
+    degrade.clear_probe_cache()
+
+
+def _mesh(shape=(1, 2)):
+    return mesh_lib.make_grid_mesh(jax.devices()[: shape[0] * shape[1]],
+                                   shape)
+
+
+def _factory(shape=(1, 2), **kw):
+    kw.setdefault("max_delay_s", 0.002)
+
+    def make():
+        return ConvolutionService(_mesh(shape), **kw)
+
+    return make
+
+
+def _img(rows=32, cols=48, seed=5):
+    return imageio.generate_test_image(rows, cols, "grey", seed=seed)
+
+
+def _converge_body(img, **kw):
+    body = {"image_b64": base64.b64encode(
+        np.ascontiguousarray(img).tobytes()).decode("ascii"),
+        "rows": img.shape[0], "cols": img.shape[1], "mode": "grey",
+        "filter": "jacobi3", "backend": "shifted", "quantize": False,
+        "tol": 0.0, "max_iters": 40, "check_every": 10}
+    body.update(kw)
+    return body
+
+
+def _fill_wal(path, n_jobs=6, max_bytes=4096, fsync=False) -> RouterWAL:
+    """A WAL with enough records to rotate at least once (tiny
+    max_bytes), exercising the compaction-snapshot head."""
+    w = RouterWAL(path, max_bytes=max_bytes, fsync=fsync)
+    w.append("epoch", epoch=3)
+    w.append("ring_add", name="r0")
+    w.append("ring_add", name="r1")
+    for i in range(n_jobs):
+        lid = f"t\x1fjob{i}"
+        w.append("admit", lid=lid, key=f"k{i}", cost=0.5, budget=40.0,
+                 wu_start=0.0)
+        w.append("token", lid=lid, key=f"k{i}", token={
+            "iters": 10 * (i + 1), "diff": 0.5, "work_units":
+            10.0 * (i + 1), "solver": "jacobi",
+            # big enough that 6 tokens overflow the 4096-byte segment
+            # floor — the fill must rotate at least once
+            "state_b64": base64.b64encode(b"\x00" * 600).decode(),
+            "state_shape": [1, 10, 15]})
+        w.append("debt", tenant="t", delta=0.5, level=10.0 - 0.5 * i)
+    w.append("final", lid="t\x1fjob0")
+    w.append("ring_remove", name="r1")
+    return w
+
+
+# ------------------------------------------------------- codec + replay
+
+
+def test_record_roundtrip_and_typed_parse_failures():
+    rec = {"seq": 7, "kind": "epoch", "epoch": 4}
+    line = encode_record(rec).rstrip("\n")
+    assert parse_line(line) == rec
+    with pytest.raises(ValueError, match="^format"):
+        parse_line("nope")
+    with pytest.raises(ValueError, match="^format"):
+        parse_line("zzzzzzzz " + line[9:])
+    # flip one payload byte: crc catches it
+    bad = line[:-2] + ("X" if line[-2] != "X" else "Y") + line[-1]
+    with pytest.raises(ValueError, match="^crc"):
+        parse_line(bad)
+
+
+def test_replay_matches_writer_state_across_rotation(tmp_path):
+    p = tmp_path / "w.wal"
+    w = _fill_wal(p)
+    live_state = w.state.to_wire()
+    w.close()
+    # Rotation actually happened (tiny max_bytes) ...
+    assert (tmp_path / "w.wal.1").exists()
+    # ... and a fresh reader folds the identical state.
+    records, torn = read_wal(p)
+    assert torn is None
+    st = WALState()
+    for rec in records:
+        st.apply(rec)
+    assert st.to_wire() == live_state
+    # seq strictly contiguous across the stitched generations
+    seqs = [r["seq"] for r in records]
+    assert seqs == list(range(seqs[0], seqs[0] + len(seqs)))
+    # the folded state saw the final: job0 gone, exactly-once mark kept
+    assert "t\x1fjob0" not in st.jobs
+    assert "t\x1fjob0" in st.finalized
+    assert st.ring == {"r0"}
+    assert st.ring_ever == {"r0", "r1"}
+
+
+def test_reopen_is_takeover_rotation_and_fences_old_writer(tmp_path):
+    p = tmp_path / "w.wal"
+    w1 = _fill_wal(p)
+    state1 = w1.state.to_wire()
+    w2 = RouterWAL(p, fsync=False)
+    assert w2.recovery_report["records"] > 0
+    assert w2.state.to_wire() == state1
+    # the takeover rotated the live file: the old writer is fenced
+    with pytest.raises(WALFenced):
+        w1.append("epoch", epoch=99)
+    # and the new lineage still appends fine
+    w2.append("epoch", epoch=4)
+    assert w2.state.epoch == 4
+    w1.close()
+    w2.close()
+
+
+# --------------------------------- never-a-silent-partial-replay property
+
+
+def _pristine(tmp_path, name="w"):
+    d = tmp_path / name
+    d.mkdir()
+    p = d / "w.wal"
+    _fill_wal(p).close()
+    records, torn = read_wal(p)
+    assert torn is None
+    return p, records
+
+
+def test_truncation_property_prefix_or_torn_tail(tmp_path):
+    """Random truncations of the LIVE file: replay always succeeds and
+    always yields a clean PREFIX of the pristine record stream (the
+    line containing the cut is the one tolerated torn tail)."""
+    p, pristine = _pristine(tmp_path)
+    data = p.read_bytes()
+    rng = np.random.RandomState(0)
+    for cut in sorted(rng.choice(len(data) - 1, size=12,
+                                 replace=False)):
+        p.write_bytes(data[:int(cut)])
+        records, torn = read_wal(p)
+        assert records == pristine[:len(records)], (
+            f"cut@{cut}: replay is not a prefix")
+        # nothing silently dropped: everything after the prefix is
+        # explained by the cut (lines at/after the cut vanished whole,
+        # plus at most one torn record reported)
+        assert len(records) <= len(pristine)
+    p.write_bytes(data)   # restore
+
+
+def test_byte_flip_property_typed_corruption_or_torn_tail(tmp_path):
+    """Random byte flips: damage in the newest file's LAST line is the
+    tolerated torn tail (prefix replay); damage anywhere else raises
+    WALCorrupt with a typed cause.  Never a silent partial replay."""
+    p, pristine = _pristine(tmp_path, "flip")
+    gen1 = p.with_name(p.name + ".1")
+    rng = np.random.RandomState(1)
+    for target in (p, gen1):
+        data = target.read_bytes()
+        last_line_start = data.rstrip(b"\n").rfind(b"\n") + 1
+        for off in sorted(rng.choice(len(data) - 1, size=10,
+                                     replace=False)):
+            off = int(off)
+            flipped = (data[:off] + bytes([data[off] ^ 0x55])
+                       + data[off + 1:])
+            target.write_bytes(flipped)
+            try:
+                records, torn = read_wal(p)
+            except WALCorrupt as e:
+                assert e.cause in _TYPED_CAUSES
+            else:
+                # Only legal on the newest file's last line.
+                assert target == p and off >= last_line_start, (
+                    f"flip@{target.name}:{off} replayed silently")
+                assert torn is not None
+                assert records == pristine[:len(records)]
+                assert len(records) >= len(pristine) - 1
+            finally:
+                target.write_bytes(data)
+
+
+def test_truncated_older_generation_is_corruption(tmp_path):
+    """Cutting records out of a ROTATED generation is mid-log damage
+    (its tail is not the live tail): typed quarantine, not tolerance."""
+    p, _ = _pristine(tmp_path, "gen")
+    gen1 = p.with_name(p.name + ".1")
+    data = gen1.read_bytes()
+    gen1.write_bytes(data[: len(data) // 2])
+    with pytest.raises(WALCorrupt) as ei:
+        read_wal(p)
+    assert ei.value.cause in _TYPED_CAUSES
+
+
+def test_closed_writer_cannot_reacquire_a_taken_over_lineage(tmp_path):
+    """Review regression: the fencing identity is the OWNED inode, not
+    the live fd — a writer that close()d (fh gone) used to reopen the
+    successor's fresh live file and pass the vacuous fd-inode check,
+    interleaving stale-seq records that quarantine the next replay."""
+    p = tmp_path / "w.wal"
+    w1 = RouterWAL(p, fsync=False)
+    w1.append("epoch", epoch=1)
+    w1.close()                      # fh gone; ownership remembered
+    w2 = RouterWAL(p, fsync=False)  # the takeover rotation
+    with pytest.raises(WALFenced):
+        w1.append("debt", tenant="t", delta=1.0, level=2.0)
+    w2.append("epoch", epoch=2)
+    w2.close()
+    # the lineage replays clean — no stale-seq pollution
+    w3 = RouterWAL(p, fsync=False)
+    assert w3.recovery_report["quarantined"] is None
+    assert w3.state.epoch == 2
+    w3.close()
+
+
+def test_recovery_never_boots_an_empty_ring(tmp_path):
+    """Review regression: ring replay removing EVERY provided replica
+    (the pool is exactly the members the WAL saw scale-removed) must
+    re-seat the pool loudly, not boot an unroutable router."""
+    reps = [InProcessReplica(_factory(), name=f"g{i}") for i in range(2)]
+    wal_path = tmp_path / "r.wal"
+    r1 = ReplicaRouter(reps, wal=str(wal_path), start_health=False)
+    r1.remove_replica("g1", drain_s=0.1, close=False)
+    r1.close(close_replicas=False)
+    with pytest.warns(RuntimeWarning, match="re-seating"):
+        r2 = ReplicaRouter(reps[1:], wal=str(wal_path),
+                           start_health=False)
+    assert r2.ring.members() == ["g1"]
+    r2.close(close_replicas=False)
+    for r in reps:
+        r.close()
+
+
+def test_wal_state_job_cap_evicts_by_recency_not_admission_order():
+    """Review regression: an active long-runner whose token records
+    keep arriving must never be evicted from the WAL state's job cap
+    ahead of older abandoned entries."""
+    from parallel_convolution_tpu.serving import wal as wal_mod
+
+    st = WALState()
+    st.apply({"kind": "admit", "lid": "long", "key": "k",
+              "cost": 1.0, "budget": 40.0, "wu_start": 0.0})
+    for i in range(wal_mod._JOBS_CAP + 10):
+        st.apply({"kind": "admit", "lid": f"idle{i}", "key": "k"})
+        # the long-runner keeps streaming: every token is a touch
+        st.apply({"kind": "token", "lid": "long", "key": "k",
+                  "token": {"iters": i, "work_units": float(i)}})
+    assert "long" in st.jobs
+    assert st.jobs["long"]["cost"] == 1.0   # charge identity intact
+
+
+def test_zombie_append_racing_takeover_never_corrupts(tmp_path):
+    """Review regression (TOCTOU): a zombie appending in a tight loop
+    while a successor takes over must either land its record BEFORE
+    the rotation (still the legitimate writer) or fence — never
+    interleave a stale-seq record into the rotated generation (which
+    the next replay would quarantine as mid-log corruption)."""
+    import threading
+
+    p = tmp_path / "w.wal"
+    for round_ in range(4):
+        w = RouterWAL(p, fsync=False)
+        w.append("epoch", epoch=round_ + 1)
+        fenced = threading.Event()
+
+        def hammer(wal=w):
+            i = 0
+            while not fenced.is_set() and i < 5000:
+                i += 1
+                try:
+                    wal.append("debt", tenant="t", delta=1.0,
+                               level=float(i))
+                except WALFenced:
+                    fenced.set()
+
+        t = threading.Thread(target=hammer)
+        t.start()
+        w2 = RouterWAL(p, fsync=False)   # the racing takeover
+        fenced.set()
+        t.join()
+        w2.close()
+        w.close()
+        # the lineage must replay clean after every racing takeover
+        probe = RouterWAL(p, fsync=False)
+        assert probe.recovery_report["quarantined"] is None, (
+            f"round {round_}: {probe.recovery_report}")
+        probe.close()
+
+
+def test_debt_journal_is_atomic_with_the_balance():
+    """Review regression: the WAL debt journal hook runs UNDER the
+    bucket lock, so concurrent same-tenant charges/refunds record
+    levels that chain exactly (level_k = level_{k-1} - delta_k with a
+    frozen clock) — a level read outside the lock could journal a
+    stale balance that recovery would re-mint."""
+    import threading
+
+    from parallel_convolution_tpu.serving.router import TokenBucket
+
+    b = TokenBucket(rate=1.0, burst=1000.0, clock=lambda: 0.0)
+    journal: list[tuple[float, float]] = []   # appended under b's lock
+
+    def charge(n):
+        for _ in range(200):
+            b.try_take(n, journal=lambda lvl: journal.append((n, lvl)))
+
+    threads = [threading.Thread(target=charge, args=(amt,))
+               for amt in (0.5, 1.0, 1.5)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    level = 1000.0
+    for delta, recorded in journal:
+        level -= delta
+        assert recorded == pytest.approx(level), (
+            "journaled level drifted from the op order")
+    assert b.level() == pytest.approx(level)
+
+
+def test_torn_tail_survives_two_restarts(tmp_path):
+    """Review regression: the takeover rotation must AMPUTATE a
+    tolerated torn tail before renaming the live file to ``.1`` —
+    otherwise the next restart reads the torn bytes as MID-log
+    corruption and quarantines state the compaction snapshot had
+    perfectly preserved."""
+    p = tmp_path / "w.wal"
+    w = RouterWAL(p, fsync=False)
+    w.append("epoch", epoch=1)
+    w.append("ring_add", name="r0")
+    w.close()
+    data = p.read_bytes()
+    p.write_bytes(data[:-7])   # tear the last record mid-line
+    with pytest.warns(RuntimeWarning, match="torn tail"):
+        w2 = RouterWAL(p, fsync=False)
+    assert w2.recovery_report["torn_tail"] is not None
+    assert w2.state.epoch == 1
+    w2.append("debt", tenant="t", delta=1.0, level=2.0)
+    w2.close()
+    # restart #2: NO quarantine, nothing lost
+    w3 = RouterWAL(p, fsync=False)
+    assert w3.recovery_report["quarantined"] is None
+    assert w3.state.epoch == 1
+    assert w3.state.debts == {"t": 2.0}
+    assert not list(tmp_path.glob("*.quarantined*"))
+    w3.close()
+
+
+def test_torn_only_wal_reopens_cleanly(tmp_path):
+    """Review regression: a live file that is NOTHING but a torn line
+    (zero surviving records) must still rotate at open — appending in
+    'a' mode onto the stump used to merge the torn bytes with the new
+    record and reset seq, corrupting the lineage for the NEXT reader."""
+    p = tmp_path / "w.wal"
+    p.write_text('deadbeef {"seq": 1, "kind": "epo')   # torn only
+    with pytest.warns(RuntimeWarning, match="torn tail"):
+        w = RouterWAL(p, fsync=False)
+    assert w.recovery_report["records"] == 0
+    w.append("epoch", epoch=5)
+    w.append("ring_add", name="r0")
+    w.close()
+    w2 = RouterWAL(p, fsync=False)
+    assert w2.recovery_report["quarantined"] is None
+    assert w2.state.epoch == 5
+    assert w2.state.ring == {"r0"}
+    w2.close()
+
+
+def test_quota_shed_and_settled_jobs_leave_no_recovery_refund(tmp_path):
+    """Review regression: the admit record (charge identity) is
+    journaled only AFTER quota admission, and every deliberate stream
+    end settles it — recovery must never refund a charge that was
+    never taken, or one already reconciled."""
+    img = _img()
+    reps = [InProcessReplica(_factory(), name="s0")]
+    wal_path = tmp_path / "r.wal"
+    quotas = TenantQuotas(rate=1e-9, burst=1e-9, clock=lambda: 0.0)
+    # drain the bucket into debt first: a FULL tiny bucket would grant
+    # a bigger-than-burst job via the r17 debt rule, not shed it
+    assert quotas.take("poor", 1.0)[0]
+    r1 = ReplicaRouter(reps, wal=str(wal_path), quotas=quotas,
+                       pricer=WorkPricer(min_units=1e-9),
+                       start_health=False)
+    st, rows = r1.converge(_converge_body(img, request_id="shed-1",
+                                          tenant="poor"))
+    first = next(iter(rows))
+    assert first["rejected"] == "tenant_quota"
+    # no charge was taken -> no charge identity in the WAL
+    assert all(j.get("cost") is None
+               for j in r1.wal.state.jobs.values())
+    # a COMPLETED job (final row) leaves no job entry at all
+    st, rows = r1.converge(_converge_body(img, request_id="done-1",
+                                          tenant="default"))
+    assert list(rows)[-1]["kind"] == "final"
+    assert "default\x1fdone-1" not in r1.wal.state.jobs
+    r1.close(close_replicas=False)
+    # recovery over this WAL refunds NOTHING
+    r2 = ReplicaRouter(reps, wal=str(wal_path),
+                       quotas=TenantQuotas(rate=1.0, burst=1e6,
+                                           clock=lambda: 0.0),
+                       pricer=WorkPricer(min_units=1e-9),
+                       start_health=False)
+    assert r2.recovery["refunded_jobs"] == {}
+    r2.close(close_replicas=False)
+    for r in reps:
+        r.close()
+
+
+def test_quarantine_moves_lineage_aside_and_starts_empty(tmp_path):
+    p = tmp_path / "w.wal"
+    _fill_wal(p).close()
+    data = p.read_bytes()
+    mid = len(data) // 3
+    p.write_bytes(data[:mid] + bytes([data[mid] ^ 0xFF])
+                  + data[mid + 1:])
+    with pytest.warns(RuntimeWarning, match="quarantined"):
+        w = RouterWAL(p, fsync=False)
+    assert w.recovery_report["quarantined"] in _TYPED_CAUSES
+    assert w.state.to_wire() == WALState().to_wire()
+    assert list(tmp_path.glob("*.quarantined*"))
+    # the fresh lineage is writable
+    w.append("epoch", epoch=1)
+    w.close()
+
+
+# ------------------------------------------------ router recovery (e2e)
+
+
+def _wal_router(reps, wal_path, clock=None, **kw):
+    kw.setdefault("start_health", False)
+    kw.setdefault("breaker_cooldown_s", 0.2)
+    quotas = TenantQuotas(rate=1.0, burst=1e6,
+                          clock=clock or (lambda: 0.0))
+    return ReplicaRouter(reps, wal=str(wal_path), quotas=quotas,
+                         pricer=WorkPricer(min_units=1e-9), **kw)
+
+
+def test_router_crash_takeover_resume_exactly_once_and_zombie(tmp_path):
+    """THE acceptance drill: kill the router mid-stream, take over the
+    WAL, the client retry resumes byte-identically, exactly one final
+    row per request_id across both lives, the zombie is fenced."""
+    img = _img()
+    reps = [InProcessReplica(_factory(), name=f"w{i}") for i in range(2)]
+    # uninterrupted oracle
+    clean = ReplicaRouter([InProcessReplica(_factory(), name="clean")],
+                          start_health=False)
+    _, rows = clean.converge(_converge_body(img, request_id="oracle"))
+    oracle_final = list(rows)[-1]
+    clean.close()
+    assert oracle_final["kind"] == "final"
+
+    wal_path = tmp_path / "r.wal"
+    r1 = _wal_router(reps, wal_path)
+    assert r1.epoch == 1
+    finals = 0
+    with faults.injected("router_kill:2"):
+        st, rows = r1.converge(_converge_body(img, request_id="j1",
+                                              tenant="t"))
+        assert st == 200
+        consumed = []
+        for row in rows:
+            consumed.append(row)
+            finals += row.get("kind") == "final"
+            if router_kill_due():
+                break   # the crash: stream abandoned un-closed
+    assert len(consumed) == 2 and finals == 0
+    assert consumed[-1]["router"]["epoch"] == 1
+
+    r2 = _wal_router(reps, wal_path)
+    assert r2.epoch == 2
+    assert r2.recovery["jobs_restored"] == 1
+    # zombie: replica-side fence + WAL lineage fence
+    stz, wz = r1.request({"image_b64": _converge_body(img)["image_b64"],
+                          "rows": img.shape[0], "cols": img.shape[1],
+                          "mode": "grey", "filter": "blur3", "iters": 1,
+                          "request_id": "z", "tenant": "t"})
+    assert stz == 409
+    assert wz["rejected"] == "stale_epoch" and wz["retryable"] is False
+    stz2, zrows = r1.converge(_converge_body(img, request_id="zc",
+                                             tenant="t"))
+    assert next(iter(zrows))["rejected"] == "stale_epoch"
+    r1.close(close_replicas=False)
+
+    st, rows = r2.converge(_converge_body(img, request_id="j1",
+                                          tenant="t"))
+    got = list(rows)
+    final = got[-1]
+    assert final["kind"] == "final"
+    # resumed, not restarted: first retry row continues past the crash
+    assert got[0]["iters"] > consumed[-1]["iters"]
+    assert final["router"]["resume_count"] >= 1
+    assert final["router"]["epoch"] == 2
+    assert final["image_b64"] == oracle_final["image_b64"]
+    finals += sum(r.get("kind") == "final" for r in got)
+    assert finals == 1
+    r2.close(close_replicas=False)
+    for r in reps:
+        r.close()
+
+
+def test_incremental_charge_across_restart(tmp_path):
+    """Recovery refunds the interrupted job's unexecuted fraction (and
+    journals the consumption), so die-takeover-resume-complete costs
+    one uninterrupted job under a frozen clock — and a THIRD recovery
+    of the same WAL refunds nothing more."""
+    img = _img()
+    reps = [InProcessReplica(_factory(), name=f"q{i}") for i in range(2)]
+    wal_path = tmp_path / "r.wal"
+    r1 = _wal_router(reps, wal_path)
+    one_job = WorkPricer(min_units=1e-9).price(
+        _converge_body(img), converge=True)
+    level0 = r1.quotas.bucket("t").level()
+    with faults.injected("router_kill:2"):
+        st, rows = r1.converge(_converge_body(img, request_id="c1",
+                                              tenant="t"))
+        for row in rows:
+            if router_kill_due():
+                break
+    r1.close(close_replicas=False)
+
+    r2 = _wal_router(reps, wal_path)
+    assert r2.recovery["refunded_jobs"], "no recovery refund recorded"
+    st, rows = r2.converge(_converge_body(img, request_id="c1",
+                                          tenant="t"))
+    assert list(rows)[-1]["kind"] == "final"
+    charged = level0 - r2.quotas.bucket("t").level()
+    assert charged == pytest.approx(one_job, rel=0.15)
+    r2.close(close_replicas=False)
+    # a third life must NOT refund the consumed charge again
+    r3 = _wal_router(reps, wal_path)
+    assert not r3.recovery["refunded_jobs"]
+    r3.close(close_replicas=False)
+    for r in reps:
+        r.close()
+
+
+def test_ring_membership_replays_across_restart(tmp_path):
+    reps = [InProcessReplica(_factory(), name=f"m{i}") for i in range(3)]
+    wal_path = tmp_path / "r.wal"
+    r1 = ReplicaRouter(reps, wal=str(wal_path), start_health=False)
+    r1.remove_replica("m2", drain_s=0.1, close=False)
+    assert r1.ring.members() == ["m0", "m1"]
+    r1.close(close_replicas=False)
+    # same pool provided again: the WAL remembers m2 left
+    r2 = ReplicaRouter(reps, wal=str(wal_path), start_health=False)
+    assert r2.ring.members() == ["m0", "m1"]
+    assert "m2" in r2.recovery["ring_removed"]
+    # a recovered member with NO transport is dropped loudly
+    r2.close(close_replicas=False)
+    with pytest.warns(RuntimeWarning, match="no transport"):
+        r3 = ReplicaRouter(reps[:1], wal=str(wal_path),
+                           start_health=False)
+    assert r3.ring.members() == ["m0"]
+    assert "m1" in r3.recovery["dropped_members"]
+    r3.close(close_replicas=False)
+    for r in reps:
+        r.close()
+
+
+def test_epoch_reconciles_past_replica_fences(tmp_path):
+    """Even with the WAL lost/quarantined, the new epoch lands above
+    every replica's own fence — a zombie cannot win via WAL loss."""
+    reps = [InProcessReplica(_factory(), name="f0")]
+    reps[0].service.fence(7)
+    r = ReplicaRouter(reps, wal=str(tmp_path / "fresh.wal"),
+                      start_health=False)
+    assert r.epoch == 8
+    assert r.recovery["max_replica_fence"] == 7
+    r.close()
+
+
+def test_wal_append_failure_degrades_durability_not_serving(tmp_path):
+    img = _img()
+    reps = [InProcessReplica(_factory(), name="d0")]
+    r = _wal_router(reps, tmp_path / "r.wal")
+    with faults.injected("wal_write:1+"):
+        st, rows = r.converge(_converge_body(img, request_id="d1",
+                                             tenant="t"))
+        got = list(rows)
+    assert got[-1]["kind"] == "final"
+    assert r.stats["wal_write_errors"] > 0
+    r.close()
+
+
+def test_epoch_stamped_on_batch_responses(tmp_path):
+    img = _img()
+    reps = [InProcessReplica(_factory(), name="e0")]
+    r = _wal_router(reps, tmp_path / "r.wal")
+    st, wire = r.request({
+        "image_b64": base64.b64encode(
+            np.ascontiguousarray(img).tobytes()).decode(),
+        "rows": img.shape[0], "cols": img.shape[1], "mode": "grey",
+        "filter": "blur3", "iters": 1, "request_id": "e", "tenant": "t"})
+    assert wire["ok"] and wire["router"]["epoch"] == r.epoch
+    want = oracle.run_serial_u8(img, filters.get_filter("blur3"), 1)
+    got = np.frombuffer(base64.b64decode(wire["image_b64"]),
+                        np.uint8).reshape(img.shape)
+    assert np.array_equal(got, want)
+    r.close()
+
+
+# ------------------------------------------------ service-side fencing
+
+
+def test_epoch_gate_ratchets_and_rejects():
+    svc = ConvolutionService(_mesh(), start=False)
+    ok, cur = svc.epoch_gate(None)
+    assert ok and cur == 0
+    ok, cur = svc.epoch_gate(3)
+    assert ok and cur == 3
+    ok, cur = svc.epoch_gate(3)          # equal epoch stays admitted
+    assert ok
+    ok, cur = svc.epoch_gate(2)          # stale: rejected, fence kept
+    assert not ok and cur == 3
+    assert svc.stats["rejected_stale_epoch"] == 1
+    assert svc.fence(10) == 10
+    assert svc.fence(4) == 10            # never lowers
+    assert svc.snapshot()["fence_epoch"] == 10
+    assert svc.readiness()[1]["fence_epoch"] == 10
+    svc.close()
+
+
+def test_router_kill_due_consults_the_seeded_plan():
+    with faults.injected("router_kill:3"):
+        assert [router_kill_due() for _ in range(4)] == [
+            False, False, True, False]
+
+
+# ------------------------------------------------ ledger eviction fix
+
+
+def test_ledger_eviction_skips_pinned_jobs_and_counts():
+    """Regression (ISSUE 15 satellite): a capacity-evicted MID-STREAM
+    job used to silently lose its resume token."""
+    led = JobLedger(capacity=3)
+    row = {"ok": True, "iters": 10, "work_units": 10.0,
+           "state_b64": "AA==", "state_shape": [1, 1, 1]}
+    led.observe("live", "k", dict(row))
+    led.pin("live")
+    for i in range(6):
+        led.observe(f"idle{i}", "k", dict(row))
+    # the pinned mid-stream job survived the churn ...
+    assert led.token("live", "k") is not None
+    # ... idle entries were the victims, and the counter says so
+    snap = led.snapshot()
+    assert snap["ledger_evicted"] == 4
+    assert snap["pinned"] == 1
+    led.unpin("live")
+    # unpinned, it becomes ordinary FIFO prey again
+    for i in range(6, 10):
+        led.observe(f"idle{i}", "k", dict(row))
+    assert led.token("live", "k") is None
+    # soft bound: all-pinned overflow never evicts a live job
+    led2 = JobLedger(capacity=2)
+    for i in range(4):
+        rid = f"p{i}"
+        led2.observe(rid, "k", dict(row))
+        led2.pin(rid)
+    assert len(led2) == 4
+    assert all(led2.token(f"p{i}", "k") is not None for i in range(4))
+
+
+def test_ledger_restore_rebounds_and_keeps_finalized():
+    led = JobLedger(capacity=2)
+    jobs = {f"j{i}": {"key": "k", "token": {"iters": i},
+                      "resume_count": i, "resumed_from": ["a"] * i}
+            for i in range(4)}
+    led.restore(jobs, finalized=["done1", "done2"])
+    assert len(led) == 2               # re-bounded to capacity
+    assert led.finalize("done1") is False   # exactly-once survives
+    assert led.finalize("fresh") is True
+
+
+# ------------------------------------- DESIGN.md site-table drift guard
+
+
+def test_design_fault_site_table_matches_code_exactly():
+    """The DESIGN.md fault-site table (between the HTML markers) is
+    faults.SITE_TABLE verbatim — keys AND descriptions."""
+    design = (Path(faults.__file__).resolve().parents[2]
+              / "DESIGN.md").read_text()
+    m = re.search(r"<!-- fault-site-table:begin -->\n(.*?)"
+                  r"<!-- fault-site-table:end -->", design, re.S)
+    assert m, "fault-site table markers missing from DESIGN.md"
+    documented = {}
+    for line in m.group(1).splitlines():
+        row = re.match(r"\|\s*`([a-z_]+)`\s*\|\s*(.*?)\s*\|\s*$", line)
+        if row:
+            documented[row.group(1)] = row.group(2)
+    code = {site: " ".join(desc.split())
+            for site, desc in faults.SITE_TABLE.items()}
+    assert documented == code, (
+        "DESIGN.md fault-site table drifted from faults.SITE_TABLE: "
+        f"doc-only {sorted(set(documented) - set(code))}, "
+        f"code-only {sorted(set(code) - set(documented))}, "
+        f"description diffs "
+        f"{[k for k in set(code) & set(documented) if code[k] != documented[k]]}")
+
+
+# --------------------------------------------- the --static leg's lint
+
+
+def test_static_lint_detects_what_it_forbids(tmp_path):
+    import importlib.util as ilu
+
+    spec = ilu.spec_from_file_location(
+        "static_check", Path(faults.__file__).resolve().parents[2]
+        / "scripts" / "static_check.py")
+    mod = ilu.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    bad = tmp_path / "serving" / "bad.py"
+    bad.parent.mkdir()
+    bad.write_text(
+        "class S:\n"
+        "    def f(self):\n"
+        "        try:\n"
+        "            pass\n"
+        "        except:\n"
+        "            pass\n"
+        "        self.stats['x'] += 1\n"
+        "    def g(self):\n"
+        "        with self._lock:\n"
+        "            self.stats['x'] += 1\n")
+    assert len(mod.check_bare_except([bad])) == 1
+    lock_problems = mod.check_stats_locking([bad])
+    assert len(lock_problems) == 1 and ":7:" in lock_problems[0]
+    # and the real serving/ tree passes both
+    serving = [p for p in mod.py_files() if "serving" in p.parts]
+    assert mod.check_stats_locking(serving) == []
+    assert mod.check_bare_except(mod.py_files()) == []
+
+
+def test_wal_records_are_wire_shaped():
+    """Every record kind the router writes must JSON-roundtrip through
+    the codec (torn-tail classification depends on per-line parse)."""
+    st = WALState()
+    for i, (kind, fields) in enumerate([
+            ("epoch", {"epoch": 2}),
+            ("admit", {"lid": "t\x1fa", "key": "k", "cost": 0.5,
+                       "budget": 40.0, "wu_start": 0.0}),
+            ("token", {"lid": "t\x1fa", "key": "k",
+                       "token": {"iters": 10, "work_units": 10.0}}),
+            ("resume", {"lid": "t\x1fa", "key": "k",
+                        "from_replica": "r0"}),
+            ("job_settled", {"lid": "t\x1fa"}),
+            ("final", {"lid": "t\x1fa"}),
+            ("ring_add", {"name": "r0"}),
+            ("ring_remove", {"name": "r0"}),
+            ("debt", {"tenant": "t", "delta": 1.0, "level": 3.0}),
+            ("snapshot", {"state": WALState().to_wire()})]):
+        rec = {"seq": i + 1, "kind": kind, **fields}
+        assert parse_line(encode_record(rec).rstrip("\n")) == rec
+        st.apply(rec)
