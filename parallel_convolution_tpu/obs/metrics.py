@@ -97,6 +97,16 @@ class _Metric:
         with self._lock:
             return self._series.get(k, 0)
 
+    def remove(self, **labels) -> None:
+        """Drop one labeled series from the exposition (no-op when it
+        never existed).  The retirement surface for label values with a
+        bounded lifetime — a shape-bucketed batcher lane that drained,
+        a replica that left the ring — so ``/metrics`` cardinality
+        tracks LIVE objects, not every label value ever seen."""
+        k = _label_key(self.labelnames, labels)
+        with self._lock:
+            self._series.pop(k, None)
+
 
 class Counter(_Metric):
     kind = "counter"
@@ -440,6 +450,10 @@ class MirroredStats(MutableMapping):
 
     def __delitem__(self, key: str) -> None:
         del self._data[key]
+        # Retire the mirrored series too: a deleted stats key (a drained
+        # batcher lane) must leave the exposition, not linger at its
+        # last value forever.
+        self._gauge.remove(key=key, **self._fixed)
 
     def __iter__(self):
         return iter(self._data)

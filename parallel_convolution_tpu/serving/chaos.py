@@ -44,8 +44,30 @@ from parallel_convolution_tpu.resilience.faults import (
     InjectedFault, fault_point,
 )
 
-__all__ = ["ChaosTransport", "DEFAULT_MODES", "modes_from_spec",
-           "router_kill_due"]
+__all__ = ["ChaosTransport", "DEFAULT_MODES", "corrupt_frame_bytes",
+           "modes_from_spec", "router_kill_due"]
+
+
+def corrupt_frame_bytes(raw, *, seed: int = 0) -> bytes:
+    """Deterministically flip one bit inside the LAST byte region of a
+    framed payload — the corrupt-body mode for the binary wire.
+
+    Flipping near the END of the buffer lands inside the final frame's
+    PAYLOAD (headers and CRC fields sit ahead of it), so the decoder's
+    structural checks all pass and the CRC is what must catch it — the
+    exact in-transit corruption the checksum exists for.  ``seed``
+    varies which bit, so a sweep can prove detection isn't positional
+    luck."""
+    data = bytearray(raw)
+    if not data:
+        return bytes(data)
+    # Offset from the end, staying inside the last 64 bytes (or the
+    # whole buffer when shorter); never the terminal byte alone — vary
+    # by seed so repeated injections corrupt different payload bits.
+    span = min(64, len(data))
+    pos = len(data) - 1 - (seed % span)
+    data[pos] ^= 1 << ((seed // span) % 8 or 1)
+    return bytes(data)
 
 
 def router_kill_due() -> bool:

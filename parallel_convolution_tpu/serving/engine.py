@@ -57,7 +57,7 @@ from parallel_convolution_tpu.utils.config import (
 )
 from parallel_convolution_tpu.utils.tracing import PhaseTimer
 
-__all__ = ["EngineKey", "WarmEngine"]
+__all__ = ["EngineKey", "WarmEngine", "bucket_extent", "bucket_key"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -144,6 +144,52 @@ class EngineKey:
             if self.storage != "f32":
                 raise ValueError("solver='multigrid' requires "
                                  "storage='f32'")
+
+
+# Shape-bucket extent ladder for lane co-batching: dense at thumbnail
+# sizes (where request mixes cluster), sparse above, capped pad waste
+# (~1.33x worst-case per dim between rungs).
+_BUCKET_LADDER = (8, 16, 32, 48, 64, 96, 128, 192, 256, 384, 512, 768,
+                  1024, 1280, 1536, 1920, 2048, 2560, 3072, 4096)
+
+
+def bucket_extent(v: int) -> int:
+    """Round one spatial extent UP to its lane bucket rung.
+
+    Rounding up (never down) keeps every geometry-derived validity
+    check (block >= radius*fuse, halo fits) at least as satisfied for
+    the bucket as for the original extent.  Above the ladder, round up
+    to the next multiple of the top rung spacing."""
+    v = int(v)
+    for rung in _BUCKET_LADDER:
+        if v <= rung:
+            return rung
+    step = 1024
+    return ((v + step - 1) // step) * step
+
+
+def bucket_key(key):
+    """The LANE a key batches under — ``key`` itself when pad-to-bucket
+    co-batching cannot be proven byte-identical.
+
+    Zero-padding the (H, W) margin is results-invariant ONLY for one
+    Jacobi iteration under zero boundaries: the padded region is zero,
+    one pointwise stencil application over a zero-margin image writes
+    the same interior bytes as the unpadded program (per-pixel
+    shifted-add summation order does not depend on extent), and the
+    crop discards the rest.  Reflect/edge boundaries read the margin,
+    and iters > 1 propagates it inward — those keys get a degenerate
+    exact-key lane (same behavior as before this round).
+    """
+    if not isinstance(key, EngineKey):
+        return key
+    if key.iters != 1 or key.boundary != "zero" or key.solver != "jacobi":
+        return key
+    c, h, w = key.shape
+    bh, bw = bucket_extent(h), bucket_extent(w)
+    if (bh, bw) == (h, w):
+        return key
+    return dataclasses.replace(key, shape=(c, bh, bw))
 
 
 class _Entry:

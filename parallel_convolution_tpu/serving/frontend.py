@@ -53,24 +53,51 @@ from __future__ import annotations
 
 import base64
 import json
+import struct
+import time
 
 import numpy as np
 
 from parallel_convolution_tpu.obs import (
     metrics as obs_metrics, trace as obs_trace,
 )
+from parallel_convolution_tpu.serving import frames as frames_mod
 from parallel_convolution_tpu.serving.service import (
     RETRYABLE_REJECTS, ConvolutionService, Rejected, Request, Response,
     Snapshot,
 )
 
 __all__ = ["InProcessClient", "decode_converge", "decode_request",
-           "drain_body", "encode_response", "encode_stream_row",
-           "make_http_server", "metrics_text", "retry_after_header",
+           "drain_body", "encode_response", "encode_response_frames",
+           "encode_stream_row", "encode_stream_row_frames",
+           "iter_framed_rows", "make_http_server", "metrics_text",
+           "retry_after_header", "send_frames", "send_frames_stream",
            "send_json", "send_ndjson_stream"]
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 NDJSON_CONTENT_TYPE = "application/x-ndjson"
+
+# Framed streaming (the binary twin of NDJSON): each row is one
+# length-prefixed envelope — u32 LE byte count, then the envelope —
+# flushed per row, so progressive delivery latency matches the NDJSON
+# arm (a row is actionable the moment its bytes land).
+_ROW_PREFIX = struct.Struct("<I")
+
+
+def _codec_obs(codec: str, op: str, dt: float, nbytes: int) -> None:
+    """Per-wire codec accounting: the observable the crossover curve
+    (scripts/wire_ab.py) and capacity math read — how much wall time and
+    how many bytes each wire arm's encode/decode actually costs."""
+    if not obs_metrics.enabled():
+        return
+    obs_metrics.counter(
+        "pctpu_codec_seconds_total",
+        "wall seconds spent encoding/decoding wire payloads",
+        ("codec", "op")).inc(dt, codec=codec, op=op)
+    obs_metrics.counter(
+        "pctpu_codec_bytes_total",
+        "payload bytes through each wire codec",
+        ("codec", "op")).inc(nbytes, codec=codec, op=op)
 
 
 def metrics_text() -> str:
@@ -94,7 +121,11 @@ _REJECT_STATUS = {"invalid": 400, "queue_full": 429, "deadline": 429,
                   # ZOMBIE router (an epoch older than the fence a
                   # takeover ratcheted).  409 Conflict, retryable:false
                   # — the zombie must stand down, not back off.
-                  "stale_epoch": 409}
+                  "stale_epoch": 409,
+                  # A malformed binary envelope/frame (truncation, CRC
+                  # mismatch, unknown dtype code): a contract error, the
+                  # binary twin of bad-JSON 400.
+                  "bad_frame": 400}
 
 
 def _stale_epoch_wire(body: dict, fence: int, trace_id: str) -> dict:
@@ -132,16 +163,31 @@ def decode_request(body: dict) -> Request:
     try:
         rows, cols = int(body["rows"]), int(body["cols"])
         mode = body.get("mode", "grey")
-        raw = base64.b64decode(body["image_b64"])
-        channels = 3 if mode == "rgb" else 1
         if rows < 1 or cols < 1:
             raise ValueError(f"bad image extent {rows}x{cols}")
-        if len(raw) != rows * cols * channels:
-            raise ValueError(
-                f"image_b64 carries {len(raw)} bytes, expected "
-                f"{rows * cols * channels} for {rows}x{cols} {mode}")
-        img = np.frombuffer(raw, np.uint8).reshape(
-            (rows, cols, 3) if mode == "rgb" else (rows, cols))
+        want = (rows, cols, 3) if mode == "rgb" else (rows, cols)
+        framed = body.get("_frames") or {}
+        if "image" in framed:
+            # Binary wire arm: the image arrived as a tensor frame — a
+            # zero-copy view over the request buffer (no base64, no
+            # bytes copy in the codec).  Geometry/dtype checks mirror
+            # the JSON arm exactly so the two wires reject identically.
+            img = framed["image"]
+            if img.dtype != np.uint8:
+                raise ValueError(
+                    f"image frame must be uint8, got {img.dtype}")
+            if img.shape != want:
+                raise ValueError(
+                    f"image frame is {img.shape}, expected {want} for "
+                    f"{rows}x{cols} {mode}")
+        else:
+            raw = base64.b64decode(body["image_b64"])
+            channels = 3 if mode == "rgb" else 1
+            if len(raw) != rows * cols * channels:
+                raise ValueError(
+                    f"image_b64 carries {len(raw)} bytes, expected "
+                    f"{rows * cols * channels} for {rows}x{cols} {mode}")
+            img = np.frombuffer(raw, np.uint8).reshape(want)
         deadline_ms = body.get("deadline_ms")
         return Request(
             image=img,
@@ -177,8 +223,10 @@ def decode_request(body: dict) -> Request:
         raise ValueError(f"malformed request body: {e}") from e
 
 
-def encode_response(result) -> tuple[int, dict]:
-    """:class:`Response`/:class:`Rejected` → (http_status, wire dict)."""
+def _response_parts(result) -> tuple[int, dict, dict]:
+    """:class:`Response`/:class:`Rejected` → (status, control header,
+    tensor fields) — the wire-agnostic split both encoders share, so the
+    JSON and frames arms cannot drift on anything but tensor carriage."""
     if isinstance(result, Rejected):
         wire = {
             "ok": False, "rejected": result.reason,
@@ -188,12 +236,10 @@ def encode_response(result) -> tuple[int, dict]:
         }
         if wire["retryable"] and result.retry_after_s is not None:
             wire["retry_after_s"] = round(float(result.retry_after_s), 4)
-        return _REJECT_STATUS.get(result.reason, 429), wire
+        return _REJECT_STATUS.get(result.reason, 429), wire, {}
     assert isinstance(result, Response)
     return 200, {
         "ok": True,
-        "image_b64": base64.b64encode(
-            np.ascontiguousarray(result.image).tobytes()).decode("ascii"),
         "effective_backend": result.effective_backend,
         "effective_grid": result.effective_grid,
         "backend": result.backend,
@@ -208,7 +254,35 @@ def encode_response(result) -> tuple[int, dict]:
         "batch_size": result.batch_size,
         "phases": result.phases,
         "trace_id": result.trace_id,
-    }
+    }, {"image": result.image}
+
+
+def encode_response(result) -> tuple[int, dict]:
+    """:class:`Response`/:class:`Rejected` → (http_status, wire dict)."""
+    status, wire, tensors = _response_parts(result)
+    wire["wire"] = "json"
+    if "image" in tensors:
+        t0 = time.perf_counter()
+        wire["image_b64"] = base64.b64encode(
+            np.ascontiguousarray(tensors["image"]).tobytes()).decode("ascii")
+        _codec_obs("json", "encode", time.perf_counter() - t0,
+                   tensors["image"].nbytes)
+    return status, wire
+
+
+def encode_response_frames(result) -> tuple[int, bytes]:
+    """The binary twin of :func:`encode_response`: (http_status,
+    envelope bytes).  Control fields ride the envelope's JSON header
+    (``wire: "frames"`` stamped; retry hints included — framed clients
+    read the header, not HTTP headers); the image rides as a tensor
+    frame.  Rejections are header-only envelopes."""
+    status, wire, tensors = _response_parts(result)
+    wire["wire"] = "frames"
+    t0 = time.perf_counter()
+    data = frames_mod.encode_envelope(wire, tensors)
+    _codec_obs("frames", "encode", time.perf_counter() - t0,
+               sum(a.nbytes for a in tensors.values()))
+    return status, data
 
 
 def decode_converge(body: dict) -> tuple[Request, dict]:
@@ -237,13 +311,25 @@ def decode_converge(body: dict) -> tuple[Request, dict]:
 
             if not isinstance(token, dict):
                 raise ValueError("resume must be a token object")
+            framed_state = (body.get("_frames") or {}).get("resume_state")
+            if framed_state is not None:
+                # state_b64's framed twin: the f32 carries arrive as a
+                # tensor frame; same shape/dtype contract, no base64.
+                state = np.asarray(framed_state)
+                if state.ndim != 3 or state.dtype != np.float32:
+                    raise ValueError(
+                        f"resume_state frame must be float32 (C, H, W), "
+                        f"got {state.dtype} {state.shape}")
+                state = np.ascontiguousarray(state)
+            else:
+                state = jobs.state_from_wire(
+                    token.get("state_b64") or "",
+                    token.get("state_shape") or ())
             params["resume"] = {
                 "iters": int(token.get("iters", 0)),
                 "diff": float(token.get("diff", float("inf"))),
                 "work_units": float(token.get("work_units", 0.0)),
-                "state": jobs.state_from_wire(
-                    token.get("state_b64") or "",
-                    token.get("state_shape") or ()),
+                "state": state,
             }
     except (TypeError, ValueError) as e:
         raise ValueError(f"malformed request body: {e}") from e
@@ -254,12 +340,13 @@ def decode_converge(body: dict) -> tuple[Request, dict]:
     return decode_request(b), params
 
 
-def encode_stream_row(row) -> dict:
-    """:class:`Snapshot`/:class:`Rejected` → one NDJSON stream line."""
+def _stream_row_parts(row) -> tuple[dict, dict]:
+    """Stream row → (control header, tensor fields): the shared split
+    behind the NDJSON and framed stream encoders."""
     if isinstance(row, Rejected):
-        _, wire = encode_response(row)
+        _, wire, _ = _response_parts(row)
         wire["kind"] = "rejected"
-        return wire
+        return wire, {}
     assert isinstance(row, Snapshot)
     out = {
         "kind": "final" if row.final else "snapshot",
@@ -275,22 +362,54 @@ def encode_stream_row(row) -> dict:
         "work_units": round(float(row.work_units), 3),
         "mg_levels": row.mg_levels,
         "col_mode": row.col_mode,
-        "image_b64": base64.b64encode(
-            np.ascontiguousarray(row.image).tobytes()).decode("ascii"),
         "request_id": row.request_id,
         "effective_backend": row.effective_backend,
         "effective_grid": row.effective_grid,
         "plan_key": row.plan_key,
         "trace_id": row.trace_id,
     }
+    tensors = {"image": row.image}
     if row.state is not None:
         # The resume-token payload (round 18): exact f32 carries, only
         # when the job asked for durability (resume_state on the wire).
-        from parallel_convolution_tpu.serving import jobs
+        tensors["state"] = row.state
+    return out, tensors
 
-        out["state_b64"], out["state_shape"] = jobs.state_to_wire(
-            row.state)
+
+def encode_stream_row(row) -> dict:
+    """:class:`Snapshot`/:class:`Rejected` → one NDJSON stream line."""
+    out, tensors = _stream_row_parts(row)
+    out["wire"] = "json"
+    if "image" in tensors:
+        t0 = time.perf_counter()
+        # Geometry rides the row so an edge re-framing the stream into
+        # tensor frames (the router's framed converge) needs no
+        # request-side context.
+        out["image_shape"] = list(tensors["image"].shape)
+        out["image_b64"] = base64.b64encode(
+            np.ascontiguousarray(tensors["image"]).tobytes()).decode("ascii")
+        if "state" in tensors:
+            from parallel_convolution_tpu.serving import jobs
+
+            out["state_b64"], out["state_shape"] = jobs.state_to_wire(
+                tensors["state"])
+        _codec_obs("json", "encode", time.perf_counter() - t0,
+                   sum(a.nbytes for a in tensors.values()))
     return out
+
+
+def encode_stream_row_frames(row) -> bytes:
+    """The binary twin of :func:`encode_stream_row`: one envelope per
+    stream row.  The image rides as a u8 frame; when the job asked for
+    durability, ``state_b64``'s framed twin ``state`` rides as an f32
+    frame (``state_shape`` is the frame's own shape header)."""
+    out, tensors = _stream_row_parts(row)
+    out["wire"] = "frames"
+    t0 = time.perf_counter()
+    data = frames_mod.encode_envelope(out, tensors)
+    _codec_obs("frames", "encode", time.perf_counter() - t0,
+               sum(a.nbytes for a in tensors.values()))
+    return data
 
 
 def drain_body(handler) -> None:
@@ -349,6 +468,61 @@ def send_ndjson_stream(handler, rows) -> None:
             pass
 
 
+def send_frames(handler, status: int, data: bytes) -> None:
+    """One framed response body (Content-Length framing).  No
+    Retry-After header: framed clients read retry hints from the
+    envelope header JSON, which always carries them."""
+    handler.send_response(status)
+    handler.send_header("Content-Type", frames_mod.FRAMES_CONTENT_TYPE)
+    handler.send_header("Content-Length", str(len(data)))
+    handler.end_headers()
+    handler.wfile.write(data)
+
+
+def send_frames_stream(handler, rows) -> None:
+    """Chunked framed streaming: one length-prefixed envelope per row,
+    FLUSHED per row exactly like the NDJSON arm — progressive delivery
+    latency is a property of the stream, not of the negotiated wire
+    (a buffered framed stream would un-ship the progressive story for
+    binary clients).  ``rows`` yields envelope ``bytes``."""
+    handler.send_response(200)
+    handler.send_header("Content-Type", frames_mod.FRAMES_CONTENT_TYPE)
+    handler.send_header("Transfer-Encoding", "chunked")
+    handler.end_headers()
+    try:
+        for data in rows:
+            line = _ROW_PREFIX.pack(len(data)) + data
+            handler.wfile.write(b"%x\r\n" % len(line))
+            handler.wfile.write(line + b"\r\n")
+            handler.wfile.flush()
+    finally:
+        try:
+            handler.wfile.write(b"0\r\n\r\n")
+        except OSError:
+            pass
+
+
+def iter_framed_rows(stream) -> "object":
+    """Parse a framed row stream (client side): yields envelope bytes
+    per length-prefixed row from a file-like ``stream``.  Raises
+    :class:`frames.BadFrame` on a truncated prefix/row."""
+    while True:
+        prefix = stream.read(_ROW_PREFIX.size)
+        if not prefix:
+            return
+        if len(prefix) < _ROW_PREFIX.size:
+            raise frames_mod.BadFrame("truncated stream row prefix")
+        (n,) = _ROW_PREFIX.unpack(prefix)
+        data = b""
+        while len(data) < n:
+            chunk = stream.read(n - len(data))
+            if not chunk:
+                raise frames_mod.BadFrame(
+                    f"truncated stream row: {len(data)}/{n} bytes")
+            data += chunk
+        yield data
+
+
 class InProcessClient:
     """The socket-free transport: same codec, direct service calls."""
 
@@ -357,7 +531,8 @@ class InProcessClient:
 
     def request(self, body: dict, timeout: float | None = None,
                 traceparent: str | None = None,
-                transport: str = "in_process") -> tuple[int, dict]:
+                transport: str = "in_process",
+                wire: str = "json") -> tuple[int, dict]:
         """One wire-format request → (status, wire-format response).
 
         The request runs under a ``request`` root span; ``traceparent``
@@ -366,12 +541,19 @@ class InProcessClient:
         the HTTP header.  Every response dict carries ``trace_id``
         ("" with obs disabled).  ``transport`` labels the root span —
         the HTTP handler delegates here and passes ``"http"``.
+
+        ``wire`` is the NEGOTIATED response encoding: ``"json"``
+        returns a dict; ``"frames"`` returns envelope ``bytes``
+        (:func:`request_frames` is the full binary round trip).  A body
+        carrying decoded tensor frames (``_frames``) is accepted either
+        way — request and response wires negotiate independently in
+        principle, though the transports pair them.
         """
         tp = traceparent if traceparent is not None else body.get(
             "traceparent")
         pctx = obs_trace.parse_traceparent(tp)
         with obs_trace.span(
-                "request", parent=pctx, transport=transport,
+                "request", parent=pctx, transport=transport, wire=wire,
                 request_id=str(body.get("request_id") or ""),
                 # The parent span (if any) lives in the CALLER's process:
                 # reconstruction must treat this span as a local root, not
@@ -383,24 +565,58 @@ class InProcessClient:
                 body.get("router_epoch"))
             if not admit:
                 sp.set(outcome="stale_epoch")
-                return 409, _stale_epoch_wire(body, fence, tid)
+                stale = _stale_epoch_wire(body, fence, tid)
+                if wire == "frames":
+                    return 409, frames_mod.encode_envelope(stale, {})
+                return 409, stale
             try:
                 req = decode_request(body)
             except ValueError as e:
                 sp.set(outcome="invalid")
-                return 400, {"ok": False, "rejected": "invalid",
-                             "request_id": body.get("request_id") or "",
-                             "detail": str(e), "trace_id": tid}
-            status, wire = encode_response(
-                self.service.submit(req, timeout=timeout))
-            if not wire.get("trace_id"):
-                wire["trace_id"] = tid
+                bad = {"ok": False, "rejected": "invalid",
+                       "request_id": body.get("request_id") or "",
+                       "detail": str(e), "trace_id": tid}
+                if wire == "frames":
+                    return 400, frames_mod.encode_envelope(bad, {})
+                return 400, bad
+            result = self.service.submit(req, timeout=timeout)
+            if wire == "frames":
+                status, data = encode_response_frames(result)
+                sp.set(status=status)
+                return status, data
+            status, wired = encode_response(result)
+            if not wired.get("trace_id"):
+                wired["trace_id"] = tid
             sp.set(status=status)
-            return status, wire
+            return status, wired
+
+    def request_frames(self, raw, timeout: float | None = None,
+                       traceparent: str | None = None,
+                       transport: str = "in_process",
+                       tenant: str | None = None) -> tuple[int, bytes]:
+        """The full binary round trip: envelope bytes in, envelope bytes
+        out.  A malformed envelope is the typed ``bad_frame`` 400 —
+        returned as a header-only envelope, so a frames client never has
+        to switch codecs to read its own rejection."""
+        t0 = time.perf_counter()
+        try:
+            header, arrays = frames_mod.decode_envelope(raw)
+        except frames_mod.BadFrame as e:
+            status, data = encode_response_frames(
+                Rejected("bad_frame", "", detail=str(e)))
+            return status, data
+        _codec_obs("frames", "decode", time.perf_counter() - t0,
+                   sum(a.nbytes for a in arrays.values()))
+        header["_frames"] = arrays
+        if tenant:
+            header["tenant"] = tenant
+        return self.request(header, timeout=timeout,
+                            traceparent=traceparent, transport=transport,
+                            wire="frames")
 
     def converge(self, body: dict, timeout: float | None = None,
                  traceparent: str | None = None,
-                 transport: str = "in_process"):
+                 transport: str = "in_process", wire: str = "json"):
         """One progressive convergence request → (status, row iterator).
 
         An immediate rejection returns its status with a one-row
@@ -408,13 +624,20 @@ class InProcessClient:
         yields NDJSON-shaped dicts (``kind: snapshot`` per chunk, then
         ``kind: final`` — or ``kind: rejected`` if the job died
         mid-stream, after the best-so-far rows).  The HTTP transport
-        streams exactly these lines chunked.
+        streams exactly these lines chunked.  With ``wire="frames"``
+        every row (rejections included) is envelope ``bytes`` instead.
         """
         tp = traceparent if traceparent is not None else body.get(
             "traceparent")
         pctx = obs_trace.parse_traceparent(tp)
+
+        def row_out(d: dict):
+            d["wire"] = wire
+            return (frames_mod.encode_envelope(d, {})
+                    if wire == "frames" else d)
+
         with obs_trace.span(
-                "request", parent=pctx, transport=transport,
+                "request", parent=pctx, transport=transport, wire=wire,
                 progressive=True,
                 request_id=str(body.get("request_id") or ""),
                 **({"remote_parent": True} if pctx is not None
@@ -424,28 +647,53 @@ class InProcessClient:
                 body.get("router_epoch"))
             if not admit:
                 sp.set(outcome="stale_epoch")
-                wire = _stale_epoch_wire(body, fence, tid)
-                wire["kind"] = "rejected"
-                return 409, iter([wire])
+                stale = _stale_epoch_wire(body, fence, tid)
+                stale["kind"] = "rejected"
+                return 409, iter([row_out(stale)])
             try:
                 req, params = decode_converge(body)
             except ValueError as e:
                 sp.set(outcome="invalid")
-                return 400, iter([{
+                return 400, iter([row_out({
                     "kind": "rejected", "ok": False, "rejected": "invalid",
                     "retryable": False,
                     "request_id": body.get("request_id") or "",
-                    "detail": str(e), "trace_id": tid}])
+                    "detail": str(e), "trace_id": tid})])
             result = self.service.submit_progressive(req, **params)
             if isinstance(result, Rejected):
-                status, wire = encode_response(result)
-                wire["kind"] = "rejected"
-                if not wire.get("trace_id"):
-                    wire["trace_id"] = tid
+                status, wired = encode_response(result)
+                wired.pop("wire", None)
+                wired["kind"] = "rejected"
+                if not wired.get("trace_id"):
+                    wired["trace_id"] = tid
                 sp.set(outcome=result.reason)
-                return status, iter([wire])
+                return status, iter([row_out(wired)])
             sp.set(status=200)
+        if wire == "frames":
+            return 200, (encode_stream_row_frames(row) for row in result)
         return 200, (encode_stream_row(row) for row in result)
+
+    def converge_frames(self, raw, timeout: float | None = None,
+                        traceparent: str | None = None,
+                        transport: str = "in_process",
+                        tenant: str | None = None):
+        """Binary converge: envelope bytes in → (status, iterator of
+        envelope-bytes rows).  The framed twin of :meth:`converge`."""
+        t0 = time.perf_counter()
+        try:
+            header, arrays = frames_mod.decode_envelope(raw)
+        except frames_mod.BadFrame as e:
+            status, data = encode_response_frames(
+                Rejected("bad_frame", "", detail=str(e)))
+            return status, iter([data])
+        _codec_obs("frames", "decode", time.perf_counter() - t0,
+                   sum(a.nbytes for a in arrays.values()))
+        header["_frames"] = arrays
+        if tenant:
+            header["tenant"] = tenant
+        return self.converge(header, timeout=timeout,
+                             traceparent=traceparent, transport=transport,
+                             wire="frames")
 
     def warm(self, configs) -> tuple[int, dict]:
         """Pre-compile declared configs (the warm-placement surface: a
@@ -540,6 +788,30 @@ def make_http_server(service: ConvolutionService, host: str = "127.0.0.1",
                 # unread body would be parsed as the NEXT request line.
                 drain_body(self)
                 self._send(404, {"ok": False, "detail": "unknown path"})
+                return
+            ctype = (self.headers.get("Content-Type") or "").split(
+                ";")[0].strip().lower()
+            if (ctype == frames_mod.FRAMES_CONTENT_TYPE
+                    and self.path in ("/v1/convolve", "/v1/converge")):
+                # Negotiated binary wire: the raw body IS the envelope;
+                # the response comes back framed too.  Decode (and the
+                # one CRC walk) happens in the client surface — a
+                # malformed envelope is its typed bad_frame 400.
+                n = int(self.headers.get("Content-Length", "0") or 0)
+                raw = self.rfile.read(n)
+                tp = self.headers.get("traceparent")
+                ten = self.headers.get("x-tenant")
+                if self.path == "/v1/convolve":
+                    status, data = client.request_frames(
+                        raw, traceparent=tp, transport="http", tenant=ten)
+                    send_frames(self, status, data)
+                else:
+                    status, rows = client.converge_frames(
+                        raw, traceparent=tp, transport="http", tenant=ten)
+                    if status != 200:
+                        send_frames(self, status, next(iter(rows)))
+                    else:
+                        send_frames_stream(self, rows)
                 return
             try:
                 n = int(self.headers.get("Content-Length", "0"))

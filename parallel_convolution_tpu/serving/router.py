@@ -75,8 +75,10 @@ from parallel_convolution_tpu.obs import (
 from parallel_convolution_tpu.resilience.breaker import (
     OPEN, CircuitBreaker,
 )
+from parallel_convolution_tpu.serving import frames as frames_mod
 from parallel_convolution_tpu.serving.frontend import (
-    InProcessClient, drain_body, send_json, send_ndjson_stream,
+    InProcessClient, drain_body, send_frames, send_frames_stream,
+    send_json, send_ndjson_stream,
 )
 from parallel_convolution_tpu.serving.jobs import JobLedger, token_progress
 from parallel_convolution_tpu.serving.service import ReleasingStream
@@ -363,6 +365,20 @@ class InProcessReplica:
 
     def request(self, body: dict, timeout: float | None = None,
                 traceparent: str | None = None):
+        raw = body.get("_frames_raw")
+        if raw is not None:
+            # The router forwarded the client's frame bytes OPAQUELY;
+            # the in-process boundary is where "the replica decodes"
+            # happens (the one CRC walk).  The response comes back
+            # framed and is split so the router can stamp its header
+            # without touching the tensor bytes.
+            header = {k: v for k, v in body.items() if k != "_frames_raw"}
+            status, data = self._live().request_frames(
+                frames_mod.join_envelope(header, raw), timeout=timeout,
+                traceparent=traceparent)
+            wire, out_raw = frames_mod.split_envelope(data)
+            wire["_frames_raw"] = bytes(out_raw)
+            return status, wire
         return self._live().request(body, timeout=timeout,
                                     traceparent=traceparent)
 
@@ -445,12 +461,22 @@ class HTTPReplica:
         import urllib.error
         import urllib.request
 
-        headers = {"Content-Type": "application/json"}
+        raw = body.get("_frames_raw")
+        if raw is not None:
+            # Opaque binary forwarding: re-wrap the router-stamped
+            # header around the client's UNTOUCHED frame bytes (no
+            # decode, no CRC walk — integrity is the replica's check).
+            header = {k: v for k, v in body.items() if k != "_frames_raw"}
+            data = frames_mod.join_envelope(header, raw)
+            ctype = frames_mod.FRAMES_CONTENT_TYPE
+        else:
+            data = json.dumps(body).encode()
+            ctype = "application/json"
+        headers = {"Content-Type": ctype}
         if traceparent:
             headers["traceparent"] = traceparent
         req = urllib.request.Request(
-            f"{self.base}{path}", data=json.dumps(body).encode(),
-            headers=headers)
+            f"{self.base}{path}", data=data, headers=headers)
         try:
             return urllib.request.urlopen(
                 req, timeout=timeout or self.timeout)
@@ -465,8 +491,20 @@ class HTTPReplica:
         resp = self._post("/v1/convolve", body, timeout, traceparent)
         with resp if hasattr(resp, "__enter__") else _closing(resp) as r:
             status = getattr(r, "status", None) or r.code
+            ctype = (r.headers.get("Content-Type") or "").split(
+                ";")[0].strip().lower()
+            payload = r.read()
+            if ctype == frames_mod.FRAMES_CONTENT_TYPE:
+                try:
+                    wire, out_raw = frames_mod.split_envelope(payload)
+                except frames_mod.BadFrame as e:
+                    raise CorruptReplicaBody(
+                        f"replica {self.name} sent unparseable envelope "
+                        f"(http {status}): {e}") from e
+                wire["_frames_raw"] = bytes(out_raw)
+                return status, wire
             try:
-                return status, json.loads(r.read())
+                return status, json.loads(payload)
             except ValueError as e:
                 raise CorruptReplicaBody(
                     f"replica {self.name} sent unparseable body "
@@ -1159,12 +1197,18 @@ class ReplicaRouter:
         self._bump("routed")
         cost = (self.pricer.price(body)
                 if self.pricer is not None else 1.0)
-        with obs_trace.span("route", request_id=rid, tenant=tenant) as sp:
+        # Which wire arm this request rides — stamped on the route span
+        # and the response, so a trace/loadgen run can segment its
+        # latency curves by codec.
+        wire_arm = "frames" if "_frames_raw" in body else "json"
+        with obs_trace.span("route", request_id=rid, tenant=tenant,
+                            wire=wire_arm) as sp:
             tid = sp.context.trace_id if sp.context is not None else ""
             shed = self._tenant_admit(tenant, rid, tid, cost)
             if shed is not None:
                 sp.set(outcome="tenant_quota")
                 status, wire = shed
+                wire["wire"] = wire_arm
                 wire["router"] = {"home": "", "replica": "", "attempts": 0,
                                   "failovers": 0, "spills": 0,
                                   "epoch": self.epoch}
@@ -1187,6 +1231,7 @@ class ReplicaRouter:
                 # Refund the SAME charge admission took: with a pricer
                 # armed that is the request's work units, not 1.
                 self._refund(tenant, cost)
+            wire.setdefault("wire", wire_arm)
             wire.setdefault("router", meta)
             if self.pricer is not None:
                 wire["router"].setdefault("cost_units", round(cost, 6))
@@ -1983,12 +2028,65 @@ def make_router_http_server(router: ReplicaRouter, host: str = "127.0.0.1",
             else:
                 self._send(404, {"ok": False, "detail": "unknown path"})
 
+        def _do_post_frames(self):
+            """The negotiated binary wire at the ROUTER tier.
+
+            ``/v1/convolve`` forwards the tensor bytes OPAQUELY: only
+            the envelope header is parsed (everything routing, pricing,
+            and QoS read lives there); the frames pass to the replica
+            and back byte-untouched, CRC-verified once at the replica.
+            ``/v1/converge`` is the exception — mid-stream failover
+            needs the router to READ rows (resume tokens), so the job
+            runs JSON router↔replica and rows re-frame at this edge;
+            a converge stream amortizes that cost over its whole run.
+            """
+            n = int(self.headers.get("Content-Length", "0") or 0)
+            raw = self.rfile.read(n)
+            try:
+                body, frames_raw = frames_mod.split_envelope(raw)
+            except frames_mod.BadFrame as e:
+                send_frames(self, 400, frames_mod.encode_envelope(
+                    {"ok": False, "rejected": "bad_frame",
+                     "retryable": False, "wire": "frames",
+                     "detail": str(e)[:300]}, {}))
+                return
+            tenant = self.headers.get("x-tenant")
+            if self.path == "/v1/convolve":
+                body["_frames_raw"] = bytes(frames_raw)
+                status, wire = router.request(body, tenant=tenant)
+                out_raw = wire.pop("_frames_raw", b"")
+                send_frames(self, status,
+                            frames_mod.join_envelope(wire, out_raw))
+                return
+            # converge: decode fully, run the JSON machinery, re-frame.
+            try:
+                _, arrays = frames_mod.decode_envelope(raw)
+            except frames_mod.BadFrame as e:
+                send_frames(self, 400, frames_mod.encode_envelope(
+                    {"ok": False, "rejected": "bad_frame", "kind":
+                     "rejected", "retryable": False, "wire": "frames",
+                     "detail": str(e)[:300]}, {}))
+                return
+            body.pop("_frame_fields", None)
+            jbody = _frames_converge_to_json(body, arrays)
+            status, rows = router.converge(jbody, tenant=tenant)
+            if status != 200:
+                row = next(iter(rows))
+                send_frames(self, status, _reframe_row(row))
+                return
+            send_frames_stream(self, (_reframe_row(r) for r in rows))
+
         def do_POST(self):  # noqa: N802 — http.server API
             if self.path not in ("/v1/convolve", "/v1/converge"):
                 # Drain the body first: under HTTP/1.1 keep-alive an
                 # unread body would be parsed as the NEXT request line.
                 drain_body(self)
                 self._send(404, {"ok": False, "detail": "unknown path"})
+                return
+            ctype = (self.headers.get("Content-Type") or "").split(
+                ";")[0].strip().lower()
+            if ctype == frames_mod.FRAMES_CONTENT_TYPE:
+                self._do_post_frames()
                 return
             try:
                 n = int(self.headers.get("Content-Length", "0"))
@@ -2010,3 +2108,52 @@ def make_router_http_server(router: ReplicaRouter, host: str = "127.0.0.1",
             self._send(*router.request(body, tenant=tenant))
 
     return ThreadingHTTPServer((host, port), Handler)
+
+
+def _frames_converge_to_json(header: dict, arrays: dict) -> dict:
+    """A framed converge request → its JSON-wire twin (the router's
+    converge machinery — failover walk, resume tokens — reads row and
+    body DICTS, so framed converge transcodes at the router edge)."""
+    import base64
+
+    import numpy as np
+
+    body = dict(header)
+    img = arrays.get("image")
+    if img is not None:
+        body["image_b64"] = base64.b64encode(
+            np.ascontiguousarray(img).tobytes()).decode("ascii")
+    state = arrays.get("resume_state")
+    if state is not None:
+        token = dict(body.get("resume") or {})
+        token["state_b64"] = base64.b64encode(
+            np.ascontiguousarray(state).tobytes()).decode("ascii")
+        token["state_shape"] = list(state.shape)
+        body["resume"] = token
+    return body
+
+
+def _reframe_row(row: dict) -> bytes:
+    """One JSON stream row → its framed twin (``image_b64`` and the
+    resume-token ``state_b64`` become tensor frames; geometry comes
+    from the row's own wire fields)."""
+    import base64
+
+    import numpy as np
+
+    out = dict(row)
+    out["wire"] = "frames"
+    arrays = {}
+    b64 = out.pop("image_b64", None)
+    shape = out.pop("image_shape", None)
+    if b64 is not None:
+        flat = np.frombuffer(base64.b64decode(b64), np.uint8)
+        arrays["image"] = (flat.reshape([int(v) for v in shape])
+                           if shape else flat)
+    s64 = out.pop("state_b64", None)
+    sshape = out.pop("state_shape", None)
+    if s64 is not None:
+        sflat = np.frombuffer(base64.b64decode(s64), np.float32)
+        arrays["state"] = (sflat.reshape([int(v) for v in sshape])
+                           if sshape else sflat)
+    return frames_mod.encode_envelope(out, arrays)

@@ -32,8 +32,10 @@ import numpy as np
 from parallel_convolution_tpu.obs import (
     events as obs_events, metrics as obs_metrics, trace as obs_trace,
 )
+from parallel_convolution_tpu.serving import engine as engine_mod
 from parallel_convolution_tpu.serving.batcher import MicroBatcher
 from parallel_convolution_tpu.serving.engine import EngineKey, WarmEngine
+from parallel_convolution_tpu.serving.pricing import WorkPricer
 from parallel_convolution_tpu.utils.tracing import PhaseTimer
 
 __all__ = ["ConvolutionService", "RETRYABLE_REJECTS", "Rejected",
@@ -271,9 +273,15 @@ class ConvolutionService:
                                  plans=plans)
         self.retry_policy = retry_policy or RetryPolicy(
             max_attempts=3, base_delay=0.05, max_delay=2.0)
-        self.batcher = MicroBatcher(
-            self._execute_batch, max_batch=max_batch,
-            max_delay_s=max_delay_s, max_queue=max_queue, start=start)
+        # Replica-side admission pricer: the same cost model the router
+        # uses, here feeding the batcher's lane priority so an expensive
+        # job never head-of-line-blocks a thumbnail (serving.pricing).
+        dev = self.engine.mesh.devices.flat[0]
+        self.pricer = WorkPricer(
+            self.engine.grid(), getattr(dev, "platform", "cpu"),
+            getattr(dev, "device_kind", ""))
+        self.batcher = self._make_batcher(max_batch, max_delay_s,
+                                          max_queue, start=start)
         self._ids = itertools.count(1)
         self._lock = threading.Lock()
         self._reshape_lock = threading.Lock()
@@ -319,6 +327,17 @@ class ConvolutionService:
         # clears its dedup ledger too, and the fence re-ratchets on the
         # first request from the live router.
         self._fence_epoch = 0
+
+    def _make_batcher(self, max_batch: int, max_delay_s: float,
+                      max_queue: int, start: bool = True) -> MicroBatcher:
+        """The one construction site for this service's batcher (used by
+        ``__init__`` AND ``reshape``, so the continuous-batching wiring
+        — shape-bucketed lanes via ``engine.bucket_key``, the collector's
+        host-side ``_prepare_batch`` — survives a mesh swap)."""
+        return MicroBatcher(
+            self._execute_batch, max_batch=max_batch,
+            max_delay_s=max_delay_s, max_queue=max_queue, start=start,
+            lane_of=engine_mod.bucket_key, prepare=self._prepare_batch)
 
     # -- admission -----------------------------------------------------------
     def _bump(self, counter: str, n: int = 1) -> None:
@@ -502,6 +521,16 @@ class ConvolutionService:
             payload = {"planar": planar, "rid": rid,
                        "rgb": req.image.ndim == 3,
                        "backend": req.backend, "plan_source": plan_source,
+                       # Predicted device-seconds: the batcher's lane-
+                       # priority input (cheap lanes flush first when
+                       # several are due — anti head-of-line-blocking).
+                       "cost_units": self.pricer.price({
+                           "rows": planar.shape[1], "cols": planar.shape[2],
+                           "mode": "rgb" if req.image.ndim == 3 else "grey",
+                           "filter": key.filter_name, "iters": key.iters,
+                           "backend": key.backend, "storage": key.storage,
+                           "fuse": key.fuse, "boundary": key.boundary,
+                           "quantize": key.quantize}),
                        # The context the worker thread re-enters: queue
                        # span parent, batch-span link, response trace_id.
                        "trace": root}
@@ -515,11 +544,20 @@ class ConvolutionService:
                     counter="rejected_queue_full", trace=root), root
         return out_slot, root
 
-    # -- execution (batcher worker thread) ------------------------------------
-    def _execute_batch(self, key: EngineKey, items) -> None:
-        from parallel_convolution_tpu.resilience.retry import with_retry
-        from parallel_convolution_tpu.utils import imageio
+    # -- execution (batcher collector + executor threads) ---------------------
+    def _prepare_batch(self, lane: EngineKey, items) -> dict:
+        """Host-side flush assembly, run on the batcher's COLLECTOR
+        thread while the executor still runs the previous flush — the
+        overlap that keeps the device full (continuous batching).
 
+        Deadline-expired items shed here (before any stacking work is
+        spent on them).  A UNIFORM flush (every item shares one original
+        key) executes at that exact key with a plain ``np.stack`` — zero
+        padding, byte-for-byte the pre-lane behavior.  A MIXED flush
+        executes at the lane's bucket key: each planar lands in the
+        top-left corner of a zeroed (C, bH, bW) slab, which
+        ``engine.bucket_key`` already proved results-invariant for the
+        keys it co-batches (iters==1, zero boundary, jacobi)."""
         start = time.monotonic()
         live = []
         for it in items:
@@ -532,6 +570,32 @@ class ConvolutionService:
                     trace=it.payload.get("trace")))
             else:
                 live.append(it)
+        if not live:
+            return {"live": live, "stacked": None, "exec_key": lane,
+                    "start": start}
+        if all(it.key == live[0].key for it in live):
+            exec_key = live[0].key
+            stacked = np.stack([it.payload["planar"] for it in live])
+        else:
+            exec_key = lane
+            c, bh, bw = exec_key.shape
+            stacked = np.zeros((len(live), c, bh, bw), np.float32)
+            for i, it in enumerate(live):
+                p = it.payload["planar"]
+                stacked[i, :, :p.shape[1], :p.shape[2]] = p
+        return {"live": live, "stacked": stacked, "exec_key": exec_key,
+                "start": start}
+
+    def _execute_batch(self, lane: EngineKey, items,
+                       prepared: dict | None = None) -> None:
+        from parallel_convolution_tpu.resilience.retry import with_retry
+        from parallel_convolution_tpu.utils import imageio
+
+        if prepared is None:  # direct callers (no collector stage)
+            prepared = self._prepare_batch(lane, items)
+        live = prepared["live"]
+        start = prepared["start"]
+        key = prepared["exec_key"]
         if not live:
             return
         if key.grid != self.engine.grid():
@@ -547,7 +611,7 @@ class ConvolutionService:
                     counter="rejected_resharding",
                     trace=it.payload.get("trace")))
             return
-        stacked = np.stack([it.payload["planar"] for it in live])
+        stacked = prepared["stacked"]
         timer = PhaseTimer()
 
         def attempt():
@@ -596,7 +660,11 @@ class ConvolutionService:
             phases = dict(info["phases"])
             u8 = np.clip(np.rint(out), 0.0, 255.0).astype(np.uint8)
             for i, it in enumerate(live):
-                plane = u8[i]
+                # Crop back to the item's own geometry: a mixed-lane
+                # flush executed at the bucket extent; the pad margin is
+                # throwaway by the bucket_key invariant.
+                h0, w0 = it.payload["planar"].shape[1:]
+                plane = u8[i][:, :h0, :w0]
                 image = (imageio.planar_to_interleaved(plane)
                          if it.payload["rgb"] else plane[0])
                 queue_s = start - it.enqueued_at
@@ -915,10 +983,13 @@ class ConvolutionService:
                     # up (per-key re-warm failures are absorbed inside
                     # reshape; anything else must not wedge the service
                     # behind a closed batcher forever).
-                    self.batcher = MicroBatcher(
-                        self._execute_batch, max_batch=old.max_batch,
-                        max_delay_s=old.max_delay_s,
-                        max_queue=old.max_queue, start=True)
+                    self.batcher = self._make_batcher(
+                        old.max_batch, old.max_delay_s, old.max_queue,
+                        start=True)
+                    dev = self.engine.mesh.devices.flat[0]
+                    self.pricer = WorkPricer(
+                        self.engine.grid(), getattr(dev, "platform", "cpu"),
+                        getattr(dev, "device_kind", ""))
                 self._bump("reshapes")
             finally:
                 self._reshaping = False

@@ -94,6 +94,38 @@ def poisson_arrivals(rps: float, fire, *, duration_s: float | None = None,
     return i, threads
 
 
+def _frames_profile(body: dict, img) -> tuple[dict, bytes]:
+    """Split a JSON request body into ``(header, raw_frame_bytes)`` for
+    the binary wire: the image crosses as a typed frame, everything else
+    stays in the envelope's JSON header.  The split is done ONCE per
+    profile — per request the (tiny) header is restamped with its
+    request_id and re-joined around the same frame bytes
+    (``join_envelope``), which is exactly the zero-copy path the wire
+    exists for."""
+    from parallel_convolution_tpu.serving import frames as frames_mod
+
+    header = {k: v for k, v in body.items() if k != "image_b64"}
+    env = frames_mod.encode_envelope(dict(header), {"image": img})
+    fheader, raw = frames_mod.split_envelope(env)
+    return fheader, bytes(raw)
+
+
+def _frames_resp_dict(data: bytes) -> dict:
+    """Decode a framed response/row envelope into the JSON-shaped dict
+    the summary accounting already understands (the image frame folds
+    back into ``image_b64`` so byte checks stay codec-agnostic)."""
+    from parallel_convolution_tpu.serving import frames as frames_mod
+
+    header, arrays = frames_mod.decode_envelope(data)
+    img = arrays.get("image")
+    if img is not None:
+        import numpy as np
+
+        header["image_b64"] = base64.b64encode(
+            np.ascontiguousarray(img).tobytes()).decode("ascii")
+    return header
+
+
 def _drain_rows(rows) -> dict:
     """Drain a converge NDJSON stream to its FINAL row (or the typed
     rejection), folding the row count in as ``rows_streamed`` — the one
@@ -152,6 +184,56 @@ class _HTTPTransport:
             # RETRYABLE outcome, not a client crash — the retry loop
             # re-submits the job and a durable router resumes it from
             # its ledger token instead of iteration 0.
+            return 200, {"ok": False, "kind": "rejected",
+                         "rejected": "replica_unavailable",
+                         "retryable": True,
+                         "detail": f"stream broke: {e}"[:300]}
+
+    def request_frames(self, raw: bytes) -> tuple[int, dict]:
+        """Binary-wire convolve: envelope bytes up, framed response
+        decoded back into the JSON-shaped summary dict."""
+        import urllib.error
+        import urllib.request
+
+        from parallel_convolution_tpu.serving import frames as frames_mod
+
+        req = urllib.request.Request(
+            f"{self.base}/v1/convolve", data=raw,
+            headers={"Content-Type": frames_mod.FRAMES_CONTENT_TYPE})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.status, _frames_resp_dict(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, _frames_resp_dict(e.read())
+            except Exception:  # noqa: BLE001
+                return e.code, {"ok": False, "detail": f"http {e.code}"}
+
+    def converge_frames(self, raw: bytes) -> tuple[int, dict]:
+        """Binary-wire converge: drain the length-prefixed framed row
+        stream to its final row (the frames twin of :meth:`converge`)."""
+        import urllib.error
+        import urllib.request
+
+        from parallel_convolution_tpu.serving import frames as frames_mod
+        from parallel_convolution_tpu.serving.frontend import (
+            iter_framed_rows,
+        )
+
+        req = urllib.request.Request(
+            f"{self.base}/v1/converge", data=raw,
+            headers={"Content-Type": frames_mod.FRAMES_CONTENT_TYPE})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.status, _drain_rows(
+                    _frames_resp_dict(r) for r in iter_framed_rows(resp))
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, _frames_resp_dict(e.read())
+            except Exception:  # noqa: BLE001
+                return e.code, {"ok": False, "detail": f"http {e.code}"}
+        except (OSError, ValueError) as e:
+            # Same retryable shape as the JSON stream-break path.
             return 200, {"ok": False, "kind": "rejected",
                          "rejected": "replica_unavailable",
                          "retryable": True,
@@ -219,6 +301,17 @@ def main() -> int:
                     help="convergence strategy (--converge only)")
     ap.add_argument("--mg-levels", type=int, default=None,
                     help="multigrid level-count cap (--converge only)")
+    ap.add_argument("--wire", default="json",
+                    choices=["json", "frames", "mixed"],
+                    help="wire codec: 'json' (base64-in-JSON, the "
+                         "control arm), 'frames' (the binary tensor-"
+                         "frame envelope), or 'mixed' (alternate arms "
+                         "per request — the A/B shape)")
+    ap.add_argument("--mixed-sizes", action="store_true",
+                    help="interleave the --rows/--cols thumbnail with "
+                         "full 1920x2520 frames — the mixed-size "
+                         "workload the shape-bucketed batcher lanes "
+                         "exist for")
     ap.add_argument("--deadline-ms", type=float, default=None,
                     help="per-request latency budget (missed -> typed shed)")
     ap.add_argument("--tenant", default=None,
@@ -283,6 +376,23 @@ def main() -> int:
         if args.mg_levels is not None:
             body["mg_levels"] = args.mg_levels
 
+    # Request profiles: one fixed config, or (--mixed-sizes) the
+    # thumbnail interleaved with a full 1920x2520 frame — near-miss
+    # shapes that land in DIFFERENT batcher lanes, the continuous-
+    # batching stress shape.  Requests round-robin profiles by index.
+    profiles = [(body, img)]
+    if args.mixed_sizes:
+        big_img = imageio.generate_test_image(1920, 2520, args.mode,
+                                              seed=args.seed + 1)
+        profiles.append((dict(body, rows=1920, cols=2520,
+                              image_b64=base64.b64encode(
+                                  np.ascontiguousarray(big_img).tobytes()
+                              ).decode("ascii")), big_img))
+    # Binary-wire profiles: header/frames split once, request_id
+    # restamped per request around the SAME frame bytes.
+    fprofiles = ([_frames_profile(b, im) for b, im in profiles]
+                 if args.wire != "json" else [])
+
     targets = args.target or ([args.url] if args.url else None)
     service = None
     if args.in_process:
@@ -309,27 +419,46 @@ def main() -> int:
                 status, rows = client.converge(b, timeout=args.timeout)
                 return status, _drain_rows(rows)
 
+            def _converge_frames_inproc(raw):
+                status, rows = client.converge_frames(
+                    raw, timeout=args.timeout)
+                return status, _drain_rows(
+                    _frames_resp_dict(r) for r in rows)
+
             transports = [_converge_inproc]
+            ftransports = [_converge_frames_inproc]
         else:
+            def _request_frames_inproc(raw):
+                status, data = client.request_frames(
+                    raw, timeout=args.timeout)
+                return status, _frames_resp_dict(data)
+
             transports = [lambda b: client.request(b, timeout=args.timeout)]
+            ftransports = [_request_frames_inproc]
         transport_snapshot = service.snapshot
     else:
         https = [_HTTPTransport(url, args.timeout) for url in targets]
         transports = [(h.converge if args.converge is not None
                        else h.request) for h in https]
+        ftransports = [(h.converge_frames if args.converge is not None
+                        else h.request_frames) for h in https]
         transport_snapshot = https[0].snapshot
 
     if args.warm and service is not None:
-        service.warmup([{"rows": args.rows, "cols": args.cols,
+        service.warmup([{"rows": b["rows"], "cols": b["cols"],
                          "mode": args.mode, "filter": args.filter_name,
                          "iters": args.iters, "backend": args.backend,
                          "storage": args.storage, "fuse": args.fuse,
-                         "boundary": args.boundary}])
+                         "boundary": args.boundary}
+                        for b, _ in profiles])
 
     want = None
     if args.check and args.converge is not None:
         ap.error("--check byte-compares the fixed-count oracle; it does "
                  "not apply to --converge jobs")
+    if args.check and args.mixed_sizes:
+        ap.error("--check byte-compares the single fixed-size oracle; "
+                 "use scripts/wire_ab.py for mixed-size identity proof")
     if args.check:
         from parallel_convolution_tpu.ops import oracle
         from parallel_convolution_tpu.ops.filters import get_filter
@@ -342,11 +471,27 @@ def main() -> int:
     retried = [0]                     # capped-backoff shed retries issued
 
     def one_request(i: int) -> None:
-        # Round-robin across targets; request_id is stable across shed
-        # retries ON PURPOSE (it is the idempotency key — a retry that
-        # races a late completion dedups at the replica).
-        request = transports[i % len(transports)]
-        b = dict(body, request_id=f"lg{i}")
+        # Round-robin across targets AND profiles; request_id is stable
+        # across shed retries ON PURPOSE (it is the idempotency key — a
+        # retry that races a late completion dedups at the replica).
+        # --wire mixed alternates codec arms on a stride DECOUPLED from
+        # the profile stride, so each size sees both codecs.
+        pbody, _ = profiles[i % len(profiles)]
+        framed = (args.wire == "frames"
+                  or (args.wire == "mixed"
+                      and (i // len(profiles)) % 2 == 1))
+        if framed:
+            from parallel_convolution_tpu.serving import (
+                frames as frames_mod,
+            )
+
+            fheader, fraw = fprofiles[i % len(profiles)]
+            request = ftransports[i % len(ftransports)]
+            b = frames_mod.join_envelope(
+                {**fheader, "request_id": f"lg{i}"}, fraw)
+        else:
+            request = transports[i % len(transports)]
+            b = dict(pbody, request_id=f"lg{i}")
         t0 = time.perf_counter()
         ts = time.time()
         attempt = 0
@@ -474,6 +619,12 @@ def main() -> int:
             # unresponsive service, which is a failure, not load shedding.
             failures.append({"status": s,
                              "detail": r.get("detail", "") or reason or ""})
+    channels = 3 if args.mode == "rgb" else 1
+    # Per-profile pixel areas: mixed-size runs account each completion
+    # at ITS profile's size (selection is deterministic by index).
+    area_of = [b["rows"] * b["cols"] for b, _ in profiles]
+    ok_rows = [(i, r) for i, _, _, s, r in results
+               if s == 200 and r.get("ok")]
     mismatches = 0
     if want is not None:
         raw = want.tobytes()
@@ -481,20 +632,22 @@ def main() -> int:
             if base64.b64decode(r["image_b64"]) != raw:
                 mismatches += 1
     bad_bytes = sum(
-        1 for _, r in completed
-        if len(base64.b64decode(r["image_b64"])) != img.size)
+        1 for i, r in ok_rows
+        if len(base64.b64decode(r["image_b64"]))
+        != area_of[i % len(profiles)] * channels)
     non_rejected_failures = len(failures) + mismatches + bad_bytes
 
     lats = sorted(lat for lat, _ in completed)
-    channels = 3 if args.mode == "rgb" else 1
     if args.converge is not None:
         # Convergence jobs: pixels iterated = the solver-comparable
         # fine-grid work units each final row stamps (iterations for
         # jacobi, the pixel-weighted per-level sum for multigrid).
-        px = int(args.rows * args.cols * channels
-                 * sum(r.get("work_units", 0.0) for _, r in completed))
+        px = int(channels * sum(
+            area_of[i % len(profiles)] * r.get("work_units", 0.0)
+            for i, r in ok_rows))
     else:
-        px = args.rows * args.cols * channels * args.iters * len(completed)
+        px = channels * args.iters * sum(
+            area_of[i % len(profiles)] for i, _ in ok_rows)
     phase_names = ("queue", "compile", "device", "copy_in", "copy_out")
     phases_ms = {
         p: round(1e3 * statistics.mean(
@@ -524,12 +677,18 @@ def main() -> int:
     epochs_seen = sorted({r.get("router", {}).get("epoch")
                           for _, r in completed} - {None, 0})
 
+    # Which codec arm(s) the SERVER says actually answered — the
+    # client-observable proof the negotiated wire was honored.
+    wires_seen = sorted({r.get("wire", "") for _, r in completed} - {""})
     row = {
         "workload": (f"serve {args.filter_name} {args.rows}x{args.cols}"
-                     f"x{channels} "
+                     + ("+1920x2520" if args.mixed_sizes else "")
+                     + f"x{channels} "
                      + (f"converge tol={args.converge}"
                         if args.converge is not None
                         else f"{args.iters} iters")),
+        "wire": args.wire,
+        **({"wires_seen": wires_seen} if wires_seen else {}),
         "loop": ("open-poisson" if args.rps
                  else ("open" if args.rate else "closed")),
         "n": n_issued,

@@ -244,11 +244,69 @@ def drift_flags(snapshot: dict, bound: float) -> list[dict]:
     return out
 
 
+def wire_ab_flags(rows: list[dict], *, min_bytes: int,
+                  knee_ratio: float) -> list[dict]:
+    """Gate the wire/batching A/B evidence (``scripts/wire_ab.py`` →
+    ``evidence/wire_ab.jsonl``).  Three holds, each a flag on failure:
+
+    * every ``identity`` row must be identical (the binary wire is an
+      encoding, never a different answer);
+    * every ``codec`` row at ``payload_bytes >= min_bytes`` must show
+      frames beating JSON (the crossover must sit BELOW the serving
+      payload regime — tiny payloads may tie, big ones may not);
+    * the ``batch_ab_summary`` knee ratio (refill/drain) must reach
+      ``knee_ratio``, with a nonzero refill counter proving the overlap
+      structurally happened.
+
+    Missing evidence is itself a flag: an empty file must not pass.
+    """
+    out = []
+    kinds = {r.get("kind") for r in rows}
+    for want in ("codec", "identity", "batch_ab_summary"):
+        if want not in kinds:
+            out.append({"check": "wire_ab", "why": f"no {want} rows"})
+    for r in rows:
+        kind = r.get("kind")
+        if kind == "identity" and not r.get("identical"):
+            out.append({"check": "identity",
+                        "endpoint": r.get("endpoint", ""),
+                        "why": "arms not byte-identical"})
+        elif kind == "codec":
+            try:
+                pb = float(r.get("payload_bytes", 0))
+                jms, fms = float(r["json_ms"]), float(r["frames_ms"])
+            except (KeyError, TypeError, ValueError):
+                out.append({"check": "codec", "why": f"malformed row {r}"})
+                continue
+            if pb >= min_bytes and fms >= jms:
+                out.append({"check": "codec",
+                            "payload_bytes": int(pb),
+                            "json_ms": jms, "frames_ms": fms,
+                            "why": f"frames not faster at >= {min_bytes}B"})
+        elif kind == "batch_ab_summary":
+            ratio = r.get("knee_ratio")
+            try:
+                ok = float(ratio) >= knee_ratio
+            except (TypeError, ValueError):
+                ok = False
+            if not ok:
+                out.append({"check": "batch_knee", "knee_ratio": ratio,
+                            "required": knee_ratio,
+                            "why": "continuous batching did not raise "
+                                   "the scale-curve knee"})
+            if not r.get("refill_refills"):
+                out.append({"check": "batch_refills",
+                            "why": "refill arm reported zero mid-flight "
+                                   "refills"})
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--history", required=True,
+    ap.add_argument("--history", default=None,
                     help="the committed JSONL history "
-                         "(evidence/perf_history.jsonl)")
+                         "(evidence/perf_history.jsonl; required "
+                         "with --row)")
     ap.add_argument("--row", action="append", default=[], metavar="JSON",
                     help="bench/loadgen row file to gate (repeatable; "
                          "JSON object, list, or JSONL)")
@@ -272,16 +330,30 @@ def main() -> int:
                          "plan-drift ratios from the 5a series")
     ap.add_argument("--drift-bound", type=float, default=10.0,
                     help="flag drift ratios outside [1/bound, bound]")
+    ap.add_argument("--wire-ab", default=None, metavar="JSONL",
+                    help="wire/batching A/B evidence to gate "
+                         "(evidence/wire_ab.jsonl from scripts/"
+                         "wire_ab.py): identity must hold, frames must "
+                         "beat JSON at >= --wire-min-bytes, the refill "
+                         "knee must clear --wire-knee-ratio")
+    ap.add_argument("--wire-min-bytes", type=int, default=65536,
+                    help="payload size from which frames must beat JSON")
+    ap.add_argument("--wire-knee-ratio", type=float, default=1.2,
+                    help="required refill/drain scale-curve knee ratio")
     ap.add_argument("--out", default=None,
                     help="also write the JSON report to this path")
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args()
-    if not args.row and not args.drift_metrics:
-        print("need --row and/or --drift-metrics", file=sys.stderr)
+    if not args.row and not args.drift_metrics and not args.wire_ab:
+        print("need --row, --drift-metrics, and/or --wire-ab",
+              file=sys.stderr)
+        return 2
+    if args.row and not args.history:
+        print("--row needs --history", file=sys.stderr)
         return 2
 
-    hist_path = Path(args.history)
-    history = load_history(hist_path)
+    hist_path = Path(args.history) if args.history else None
+    history = load_history(hist_path) if hist_path else []
     try:
         rows = load_rows(args.row)
     except (OSError, ValueError) as e:
@@ -304,8 +376,19 @@ def main() -> int:
             return 2
         flags = drift_flags(snap, args.drift_bound)
 
+    wflags = []
+    if args.wire_ab:
+        try:
+            wrows = load_rows([args.wire_ab])
+        except (OSError, ValueError) as e:
+            print(f"perf_gate: unreadable wire-ab file: {e}",
+                  file=sys.stderr)
+            return 2
+        wflags = wire_ab_flags(wrows, min_bytes=args.wire_min_bytes,
+                               knee_ratio=args.wire_knee_ratio)
+
     regressions = [v for v in verdicts if v["status"] == "regression"]
-    if args.update:
+    if args.update and hist_path:
         # Append-only, one line per gated row — regressions too: a real
         # slowdown becomes the new reality after it ships; the gate's
         # job is to make it LOUD once, not to pin the baseline forever.
@@ -333,6 +416,7 @@ def main() -> int:
         "verdicts": verdicts,
         "regressions": len(regressions),
         "drift_flags": flags,
+        "wire_ab_flags": wflags,
         "updated": bool(args.update),
     }
     if not args.quiet:
@@ -347,13 +431,15 @@ def main() -> int:
             print(f"drift      {fl['key']}|{fl['backend']}  "
                   f"ratio={fl['drift_ratio']} outside "
                   f"[1/{fl['bound']}, {fl['bound']}]")
+        for fl in wflags:
+            print(f"wire_ab    {fl['check']}: {fl['why']}")
     if args.out:
         p = Path(args.out)
         p.parent.mkdir(parents=True, exist_ok=True)
         p.write_text(json.dumps(report, indent=2))
     else:
         print(json.dumps(report))
-    return 1 if regressions or flags else 0
+    return 1 if regressions or flags or wflags else 0
 
 
 if __name__ == "__main__":

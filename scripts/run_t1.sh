@@ -108,6 +108,23 @@
 #                                      (failures: 0) lands in
 #                                      evidence/wal_smoke.json (the
 #                                      supervisor leg's done_file).
+#   scripts/run_t1.sh --wire-smoke     binary data plane + continuous
+#                                      batching A/B (round 20): the codec
+#                                      crossover curve (JSON vs tensor-
+#                                      frame envelope encode+decode),
+#                                      byte-identity of both arms on
+#                                      /v1/convolve and a /v1/converge
+#                                      stream, and the drain-vs-refill
+#                                      batcher scale curve (same
+#                                      synthetic host/device load) land
+#                                      in evidence/wire_ab.jsonl; then
+#                                      perf_gate.py --wire-ab holds
+#                                      identity, frames-beats-JSON at
+#                                      >= 64 KB, and the >= 1.2x refill
+#                                      knee.  Gate report (wire_ab_flags:
+#                                      []) lands in
+#                                      evidence/wire_gate.json (the
+#                                      supervisor leg's done_file).
 #   scripts/run_t1.sh --static         fast static gate (no jax): every
 #                                      .py byte-compiles, no bare
 #                                      'except:', and every mutation of a
@@ -262,6 +279,16 @@ if [ "${1:-}" = "--wal-smoke" ]; then
     PCTPU_OBS=1 \
     python scripts/wal_smoke.py --n 12 --rows 40 --cols 56 \
       --mesh 1x2 --out evidence/wal_smoke.json
+fi
+
+if [ "${1:-}" = "--wire-smoke" ]; then
+  timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python scripts/wire_ab.py --quick --out evidence/wire_ab.jsonl \
+    || exit 1
+  exec timeout -k 10 120 \
+    python scripts/perf_gate.py --wire-ab evidence/wire_ab.jsonl \
+      --out evidence/wire_gate.json
 fi
 
 if [ "${1:-}" = "--static" ]; then

@@ -1,0 +1,291 @@
+#!/usr/bin/env python
+"""Wire-format + continuous-batching A/B evidence generator.
+
+Produces ``evidence/wire_ab.jsonl`` — the committed proof behind the
+binary data plane (round 20), three row kinds:
+
+* ``codec`` — the crossover curve: encode+decode wall time of the SAME
+  u8 image through the JSON arm (base64 + json.dumps/loads) vs the
+  frames arm (``serving.frames`` envelope), swept across payload sizes.
+  This is the pure wire tax, no device work — the curve the README
+  plots and ``perf_gate.py --wire-ab`` holds (frames must beat JSON at
+  >= 64 KB).
+
+* ``identity`` — byte-identity of the two arms end to end: one
+  in-process service, each endpoint (``/v1/convolve`` one-shot,
+  ``/v1/converge`` streamed) driven through BOTH codecs with the same
+  input; every tensor crossing the wire must match byte-for-byte and
+  every control field must agree.  A non-identical row is a hard
+  failure (exit 1) — the binary wire is an encoding, never a different
+  answer.
+
+* ``batch_ab`` — drain vs refill: the same synthetic host/device load
+  (``prepare`` burns host milliseconds, ``execute`` burns device
+  milliseconds) through a ``pipeline_depth=0`` batcher (the old
+  drain-between-flushes barrier) and a ``pipeline_depth=1`` batcher
+  (continuous batching), swept across closed-loop worker counts.  Each
+  arm's KNEE is its best sustained throughput; the refill arm must
+  raise the knee (the flush barrier was the bottleneck) and its
+  ``refills`` counter must be nonzero (the overlap actually happened —
+  drain mode structurally cannot refill).
+
+stdlib + numpy + the serving package; runs on CPU in seconds
+(``--quick`` trims the sweeps for the tier-1 smoke leg).
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import sys
+import threading
+import time
+
+import _path  # noqa: F401
+
+# Codec sweep: square u8 images, side -> payload_bytes = side*side.
+_SIDES = (64, 128, 256, 512, 1024, 2048)
+_SIDES_QUICK = (64, 256, 512, 1024)
+
+
+def _codec_rows(sides, repeat: int):
+    """The crossover curve: best-of-``repeat`` encode+decode wall time
+    per arm at each payload size, same header shape both arms."""
+    import numpy as np
+
+    from parallel_convolution_tpu.serving import frames as frames_mod
+
+    rows = []
+    for side in sides:
+        img = np.arange(side * side, dtype=np.uint8).reshape(side, side)
+        header = {"rows": side, "cols": side, "mode": "grey",
+                  "filter": "blur3", "iters": 1}
+
+        def _json_arm():
+            doc = json.dumps(dict(header, image_b64=base64.b64encode(
+                img.tobytes()).decode("ascii")))
+            out = json.loads(doc)
+            return np.frombuffer(base64.b64decode(out["image_b64"]),
+                                 np.uint8)
+
+        def _frames_arm():
+            env = frames_mod.encode_envelope(header, {"image": img})
+            _, arrays = frames_mod.decode_envelope(env)
+            return arrays["image"]
+
+        # Identity of the round-tripped bytes is part of the curve's
+        # validity: a faster codec that loses bits is not a codec.
+        assert _json_arm().tobytes() == img.tobytes()
+        assert _frames_arm().tobytes() == img.tobytes()
+        timed = {}
+        for name, fn in (("json", _json_arm), ("frames", _frames_arm)):
+            best = float("inf")
+            for _ in range(repeat):
+                t0 = time.perf_counter()
+                fn()
+                best = min(best, time.perf_counter() - t0)
+            timed[name] = best
+        rows.append({
+            "kind": "codec",
+            "payload_bytes": side * side,
+            "json_ms": round(1e3 * timed["json"], 4),
+            "frames_ms": round(1e3 * timed["frames"], 4),
+            "speedup": round(timed["json"] / timed["frames"], 2)
+            if timed["frames"] else None,
+        })
+    return rows
+
+
+def _identity_rows(rows_px: int, cols_px: int, seed: int):
+    """Drive BOTH endpoints through both codec arms on one in-process
+    service; compare every crossing tensor byte-for-byte."""
+    import numpy as np
+
+    from parallel_convolution_tpu.serving import frames as frames_mod
+    from parallel_convolution_tpu.serving.frontend import InProcessClient
+    from parallel_convolution_tpu.serving.service import ConvolutionService
+    from parallel_convolution_tpu.utils import imageio
+
+    img = imageio.generate_test_image(rows_px, cols_px, "grey", seed=seed)
+    service = ConvolutionService(None, max_batch=4, max_delay_s=0.002,
+                                 max_queue=64)
+    client = InProcessClient(service)
+    out = []
+    try:
+        # -- /v1/convolve ---------------------------------------------------
+        base = {"rows": rows_px, "cols": cols_px, "mode": "grey",
+                "filter": "blur3", "iters": 2, "backend": "shifted",
+                "storage": "f32", "fuse": 1, "boundary": "zero"}
+        jbody = dict(base, image_b64=base64.b64encode(
+            np.ascontiguousarray(img).tobytes()).decode("ascii"),
+            request_id="ab-json")
+        js, jresp = client.request(jbody, timeout=60.0)
+        env = frames_mod.encode_envelope(dict(base, request_id="ab-frames"),
+                                         {"image": img})
+        fs, fraw = client.request_frames(env, timeout=60.0)
+        fheader, farrays = frames_mod.decode_envelope(fraw)
+        identical = (js == fs == 200 and jresp.get("ok")
+                     and fheader.get("ok")
+                     and base64.b64decode(jresp["image_b64"])
+                     == farrays["image"].tobytes()
+                     and jresp.get("effective_backend")
+                     == fheader.get("effective_backend"))
+        out.append({"kind": "identity", "endpoint": "convolve",
+                    "identical": bool(identical),
+                    "bytes_compared": int(img.size),
+                    "wire_json": jresp.get("wire"),
+                    "wire_frames": fheader.get("wire")})
+
+        # -- /v1/converge ---------------------------------------------------
+        cbase = {"rows": rows_px, "cols": cols_px, "mode": "grey",
+                 "filter": "blur3", "backend": "shifted", "storage": "f32",
+                 "fuse": 1, "boundary": "zero", "tol": 5e-3,
+                 "max_iters": 40, "check_every": 10, "quantize": False,
+                 "solver": "jacobi"}
+        jbody = dict(cbase, image_b64=base64.b64encode(
+            np.ascontiguousarray(img).tobytes()).decode("ascii"),
+            request_id="abc-json")
+        js, jrows = client.converge(jbody, timeout=60.0)
+        jrows = list(jrows)
+        env = frames_mod.encode_envelope(
+            dict(cbase, request_id="abc-frames"), {"image": img})
+        fs, frows = client.converge_frames(env, timeout=60.0)
+        frows = [frames_mod.decode_envelope(r) for r in frows]
+        identical = js == fs == 200 and len(jrows) == len(frows)
+        compared = 0
+        if identical:
+            for jr, (fh, fa) in zip(jrows, frows):
+                jimg = base64.b64decode(jr.get("image_b64", ""))
+                fimg = fa["image"].tobytes() if "image" in fa else b""
+                if (jr.get("kind") != fh.get("kind") or jimg != fimg
+                        or jr.get("iteration") != fh.get("iteration")
+                        or jr.get("converged") != fh.get("converged")):
+                    identical = False
+                    break
+                compared += 1
+        out.append({"kind": "identity", "endpoint": "converge",
+                    "identical": bool(identical),
+                    "rows_compared": compared,
+                    "rows_json": len(jrows), "rows_frames": len(frows)})
+    finally:
+        service.close()
+    return out
+
+
+def _batch_arm(pipeline_depth: int, *, host_ms: float, dev_ms: float,
+               max_batch: int, worker_steps, items_per_worker: int):
+    """One batching arm: synthetic prepare/execute, closed-loop workers,
+    throughput per step; the knee is the best sustained step."""
+    from parallel_convolution_tpu.serving.batcher import MicroBatcher
+
+    def prepare(lane, items):
+        time.sleep(host_ms / 1e3)     # host half: stack/shed/pad
+        return {"n": len(items)}
+
+    def execute(lane, items, prepared=None):
+        time.sleep(dev_ms / 1e3)      # device half: the dispatch
+        for it in items:
+            it.slot.set("ok")
+
+    curve = []
+    refills = 0
+    for workers in worker_steps:
+        mb = MicroBatcher(execute, max_batch=max_batch,
+                          max_delay_s=0.001, max_queue=256,
+                          prepare=prepare, pipeline_depth=pipeline_depth)
+        failures = []
+
+        def run():
+            for _ in range(items_per_worker):
+                slot = None
+                for _ in range(2000):           # bounded admission retry
+                    slot = mb.try_submit("lane", {"cost_units": 1.0})
+                    if slot is not None:
+                        break
+                    time.sleep(0.0005)
+                if slot is None or slot.result(timeout=30.0) != "ok":
+                    failures.append(1)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=run, daemon=True)
+                   for _ in range(workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60.0)
+        wall = time.perf_counter() - t0
+        done = workers * items_per_worker - len(failures)
+        refills = int(mb.stats["refills"])
+        mb.close()
+        curve.append({"workers": workers,
+                      "items_per_s": round(done / wall, 1) if wall else 0.0,
+                      "failures": len(failures)})
+    knee = max((p["items_per_s"] for p in curve), default=0.0)
+    return {"kind": "batch_ab",
+            "mode": "drain" if pipeline_depth == 0 else "refill",
+            "pipeline_depth": pipeline_depth,
+            "host_ms": host_ms, "dev_ms": dev_ms, "max_batch": max_batch,
+            "knee_items_per_s": knee, "refills": refills, "curve": curve}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="evidence/wire_ab.jsonl")
+    ap.add_argument("--quick", action="store_true",
+                    help="trimmed sweeps (the tier-1 smoke shape)")
+    ap.add_argument("--repeat", type=int, default=5,
+                    help="codec timing repeats (best-of)")
+    ap.add_argument("--rows", type=int, default=96)
+    ap.add_argument("--cols", type=int, default=120,
+                    help="identity-check image size (odd on purpose: "
+                         "exercises the pad-to-bucket path)")
+    ap.add_argument("--host-ms", type=float, default=4.0)
+    ap.add_argument("--dev-ms", type=float, default=4.0)
+    ap.add_argument("--max-batch", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    rows = []
+    sides = _SIDES_QUICK if args.quick else _SIDES
+    rows += _codec_rows(sides, max(1, args.repeat))
+    rows += _identity_rows(args.rows, args.cols, args.seed)
+    worker_steps = (1, 4, 8) if args.quick else (1, 2, 4, 8, 16)
+    items = 6 if args.quick else 10
+    drain = _batch_arm(0, host_ms=args.host_ms, dev_ms=args.dev_ms,
+                       max_batch=args.max_batch, worker_steps=worker_steps,
+                       items_per_worker=items)
+    refill = _batch_arm(1, host_ms=args.host_ms, dev_ms=args.dev_ms,
+                        max_batch=args.max_batch, worker_steps=worker_steps,
+                        items_per_worker=items)
+    rows += [drain, refill]
+    ratio = (refill["knee_items_per_s"] / drain["knee_items_per_s"]
+             if drain["knee_items_per_s"] else None)
+    rows.append({"kind": "batch_ab_summary",
+                 "drain_knee": drain["knee_items_per_s"],
+                 "refill_knee": refill["knee_items_per_s"],
+                 "knee_ratio": round(ratio, 3) if ratio else None,
+                 "refill_refills": refill["refills"],
+                 "drain_refills": drain["refills"]})
+
+    from pathlib import Path
+
+    p = Path(args.out)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    stamp = {"ts": round(time.time(), 3), "quick": bool(args.quick)}
+    with open(p, "w") as f:
+        for r in rows:
+            f.write(json.dumps({**r, **stamp}) + "\n")
+    for r in rows:
+        print(json.dumps(r), flush=True)
+
+    bad_identity = [r for r in rows
+                    if r["kind"] == "identity" and not r["identical"]]
+    if bad_identity:
+        print(f"IDENTITY FAILURE: {bad_identity}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
