@@ -86,6 +86,11 @@ KINDS = frozenset({
     #                        the crash-safe control plane's timeline)
     "span",                # one closed trace span (obs.trace): trace_id/
     #                        span_id/parent_id + start_ts/dur_s/links
+    "shard",               # sharded control plane (round 21): cross-
+    #                        shard fenced takeover, shard-map version
+    #                        bump, peer anti-entropy sync, peer-death
+    #                        suspicion — the multi-router membership
+    #                        timeline
 })
 
 _REQUIRED = ("seq", "ts", "perf", "kind")

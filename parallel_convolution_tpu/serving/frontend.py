@@ -122,6 +122,11 @@ _REJECT_STATUS = {"invalid": 400, "queue_full": 429, "deadline": 429,
                   # takeover ratcheted).  409 Conflict, retryable:false
                   # — the zombie must stand down, not back off.
                   "stale_epoch": 409,
+                  # Round 21 sharded control plane: the request's route
+                  # key hashes to a shard this router does not own.  421
+                  # Misdirected Request, retryable:true — the client
+                  # refreshes its shard map and retries at the owner.
+                  "wrong_shard": 421,
                   # A malformed binary envelope/frame (truncation, CRC
                   # mismatch, unknown dtype code): a contract error, the
                   # binary twin of bad-JSON 400.
@@ -130,14 +135,20 @@ _REJECT_STATUS = {"invalid": 400, "queue_full": 429, "deadline": 429,
 
 def _stale_epoch_wire(body: dict, fence: int, trace_id: str) -> dict:
     """The typed non-retryable rejection a fenced-out request gets."""
-    return {
+    shard = body.get("router_shard")
+    where = (f"shard {shard!r}" if shard
+             else "this replica set")
+    wire = {
         "ok": False, "rejected": "stale_epoch", "retryable": False,
         "request_id": body.get("request_id") or "",
         "fence_epoch": fence, "trace_id": trace_id,
         "detail": f"router epoch {body.get('router_epoch')!r} is stale "
                   f"(fence at {fence}): a newer router has taken over "
-                  "this replica set",
+                  f"{where}",
     }
+    if shard is not None:
+        wire["shard"] = str(shard)
+    return wire
 
 
 def retry_after_header(wire: dict) -> str | None:
@@ -562,7 +573,7 @@ class InProcessClient:
                    else {})) as sp:
             tid = sp.context.trace_id if sp.context is not None else ""
             admit, fence = self.service.epoch_gate(
-                body.get("router_epoch"))
+                body.get("router_epoch"), shard=body.get("router_shard"))
             if not admit:
                 sp.set(outcome="stale_epoch")
                 stale = _stale_epoch_wire(body, fence, tid)
@@ -644,7 +655,7 @@ class InProcessClient:
                    else {})) as sp:
             tid = sp.context.trace_id if sp.context is not None else ""
             admit, fence = self.service.epoch_gate(
-                body.get("router_epoch"))
+                body.get("router_epoch"), shard=body.get("router_shard"))
             if not admit:
                 sp.set(outcome="stale_epoch")
                 stale = _stale_epoch_wire(body, fence, tid)
@@ -708,17 +719,24 @@ class InProcessClient:
         return 200, {"ok": True, "warmed": len(effective),
                      "effective_backends": effective}
 
-    def fence(self, epoch) -> tuple[int, dict]:
+    def fence(self, epoch, shard=None) -> tuple[int, dict]:
         """Ratchet the router-epoch fence (``POST /v1/fence`` twin) —
         the explicit propagation call a taking-over router makes so a
         zombie is rejected EVERYWHERE at once, not just on replicas the
-        new router happened to talk to first."""
+        new router happened to talk to first.  ``shard`` scopes the
+        sweep to one lineage's ratchet (round 21): fencing shard A's
+        zombie must not reject the same process's live shard-B owner."""
         try:
             e = int(epoch)
         except (TypeError, ValueError):
             return 400, {"ok": False, "rejected": "invalid",
                          "detail": f"bad fence epoch {epoch!r}"}
-        return 200, {"ok": True, "fence_epoch": self.service.fence(e)}
+        s = None if shard is None else str(shard)
+        out = {"ok": True,
+               "fence_epoch": self.service.fence(e, shard=s)}
+        if s is not None:
+            out["shard"] = s
+        return 200, out
 
     def healthz(self) -> tuple[int, dict]:
         return 200, {"ok": True, **self.service.snapshot()}
@@ -826,7 +844,8 @@ def make_http_server(service: ConvolutionService, host: str = "127.0.0.1",
                 self._send(*client.warm(body.get("configs") or []))
                 return
             if self.path == "/v1/fence":
-                self._send(*client.fence(body.get("epoch")))
+                self._send(*client.fence(body.get("epoch"),
+                                         shard=body.get("shard")))
                 return
             # Tenant identity: the transport header wins over the body
             # field (the router's QoS key rides either).

@@ -128,8 +128,12 @@ class JobLedger:
     ``dedup_capacity`` rule on the service side.
     """
 
-    def __init__(self, capacity: int = 64):
+    def __init__(self, capacity: int = 64, shard: str | None = None):
         self.capacity = max(1, int(capacity))
+        # Round 21: the shard lineage this ledger serves (None when the
+        # router is unsharded) — snapshot attribution only; the ledger
+        # itself is per-sub-router and therefore per-shard already.
+        self.shard = None if shard is None else str(shard)
         self._jobs: "OrderedDict[str, _Job]" = OrderedDict()
         # rids whose final row already went out (FIFO-bounded, cheap
         # strings): the exactly-once gate outlives the job entry, which
@@ -287,6 +291,8 @@ class JobLedger:
             return {
                 "jobs": len(self._jobs),
                 "capacity": self.capacity,
+                **({"shard": self.shard}
+                   if self.shard is not None else {}),
                 "pinned": len(self._pinned),
                 # Live (un-finalized) jobs evicted at capacity — should
                 # stay 0 under healthy load; a rising count means the

@@ -254,6 +254,19 @@ class TokenBucket:
             if journal is not None:
                 journal(self._tokens)
 
+    def absorb(self, delta: float) -> None:
+        """Apply a PEER's replicated charge (+) or refund (−) to this
+        bucket (round 21 fleet-wide quotas): the local balance moves by
+        ``delta`` with the usual burst ceiling, but no journal hook runs
+        — a replicated delta must never be re-journaled or re-replicated
+        (echo), and debt below zero is legal exactly as in
+        :meth:`try_take`."""
+        if self.rate <= 0:
+            return
+        with self._lock:
+            self._refill(self._clock())
+            self._tokens = min(self.burst, self._tokens - float(delta))
+
     def level(self) -> float:
         with self._lock:
             self._refill(self._clock())
@@ -326,6 +339,11 @@ class TenantQuotas:
 
     def refund(self, tenant: str, n: float = 1.0, journal=None) -> None:
         self.bucket(tenant).refund(n, journal=journal)
+
+    def absorb(self, tenant: str, delta: float) -> None:
+        """Apply a peer's replicated debt delta (no journal, no echo —
+        see :meth:`TokenBucket.absorb`)."""
+        self.bucket(tenant).absorb(delta)
 
     def restore_level(self, tenant: str, level: float) -> None:
         """WAL-recovery seeding: set a tenant's balance to the level
@@ -411,10 +429,11 @@ class InProcessReplica:
         warm-placement surface the autoscaler drives BEFORE ring join)."""
         return self._live().warm(configs)
 
-    def fence(self, epoch: int) -> tuple[int, dict]:
+    def fence(self, epoch: int, shard=None) -> tuple[int, dict]:
         """Ratchet the replica's router-epoch fence (takeover
-        propagation — round 19)."""
-        return self._live().fence(epoch)
+        propagation — round 19; ``shard`` scopes the sweep to one
+        lineage's ratchet, round 21)."""
+        return self._live().fence(epoch, shard=shard)
 
     def snapshot(self) -> dict:
         return self._live().stats()[1]
@@ -599,12 +618,15 @@ class HTTPReplica:
         return self._post_json("/v1/warm",
                                {"configs": list(configs or ())}, None)
 
-    def fence(self, epoch: int) -> tuple[int, dict]:
+    def fence(self, epoch: int, shard=None) -> tuple[int, dict]:
         """POST /v1/fence — ratchet the replica's router-epoch fence
         (short probe budget: fencing is a takeover-path sweep and one
-        black-holing host must not stall it)."""
-        return self._post_json("/v1/fence", {"epoch": int(epoch)},
-                               self.probe_timeout)
+        black-holing host must not stall it).  ``shard`` scopes the
+        sweep to one lineage's ratchet (round 21)."""
+        body: dict = {"epoch": int(epoch)}
+        if shard is not None:
+            body["shard"] = str(shard)
+        return self._post_json("/v1/fence", body, self.probe_timeout)
 
     def snapshot(self) -> dict:
         return self._get("/stats")[1]
@@ -684,7 +706,8 @@ class ReplicaRouter:
                  poll_interval_s: float = 0.25, load_factor: float = 2.0,
                  hedge_s: float | None = None, start_health: bool = True,
                  durable: bool = True, job_capacity: int = 64,
-                 wal=None, clock=time.monotonic):
+                 wal=None, clock=time.monotonic,
+                 shard: str | None = None, on_debt=None):
         if not replicas:
             raise ValueError("at least one replica required")
         names = [r.name for r in replicas]
@@ -727,7 +750,9 @@ class ReplicaRouter:
         # the surviving ring candidates seeded from the newest token,
         # and the final row is exactly-once per request_id.
         self.durable = bool(durable)
-        self.jobs = JobLedger(capacity=job_capacity)
+        self.jobs = JobLedger(capacity=job_capacity,
+                              shard=None if shard is None
+                              else str(shard))
         self.stats = obs_metrics.MirroredStats(obs_metrics.gauge(
             "pctpu_router_stats", "replica-router admission/outcome counters",
             ("key",)), initial={
@@ -748,11 +773,24 @@ class ReplicaRouter:
         # property of the durable deployment).
         self.wal = None
         self.epoch = 0
+        # Sharded control plane (round 21): when this router owns one
+        # shard of a partitioned ring, ``shard`` is its label — stamped
+        # on every outbound body (``router_shard``) so replica-side
+        # fencing is per-shard, and on every ``router:`` block so
+        # traces attribute a request to the shard that served it.
+        # ``map_version`` is the owning ShardRouter's shard-map version
+        # (bumped on ownership change; 0 when unsharded).  ``on_debt``
+        # is the peer-replication hook: called (tenant, delta) after
+        # every quota charge/refund so a peer layer can replicate
+        # tenant debt fleet-wide.
+        self.shard = None if shard is None else str(shard)
+        self.map_version = 0
+        self.on_debt = on_debt
         if wal is not None:
             from parallel_convolution_tpu.serving.wal import RouterWAL
 
             self.wal = (wal if isinstance(wal, RouterWAL)
-                        else RouterWAL(wal))
+                        else RouterWAL(wal, shard=self.shard))
             self._recover()
         self._closed = threading.Event()
         self._poll_thread: threading.Thread | None = None
@@ -797,6 +835,7 @@ class ReplicaRouter:
                 lambda lvl: self._wal_append(
                     "debt", tenant=tenant, delta=round(-amount, 9),
                     level=round(lvl, 9)))))
+        self._debt_hook(tenant, -amount)
 
     def _recover(self) -> None:
         """Startup recovery: fold the WAL into live state, reconcile
@@ -887,8 +926,15 @@ class ReplicaRouter:
             try:
                 status, _ = rep.transport.readyz()
                 snap = rep.transport.snapshot()
-                max_fence = max(max_fence,
-                                int(snap.get("fence_epoch", 0) or 0))
+                if self.shard is not None:
+                    # Per-shard fences (round 21): read THIS shard's
+                    # ratchet; the scalar fence_epoch is the unsharded
+                    # lineage's and would under- or over-fence here.
+                    fences = snap.get("fence_epochs") or {}
+                    rep_fence = int(fences.get(self.shard, 0) or 0)
+                else:
+                    rep_fence = int(snap.get("fence_epoch", 0) or 0)
+                max_fence = max(max_fence, rep_fence)
                 reachable.append(name)
             except Exception:  # noqa: BLE001 — a dead replica
                 continue
@@ -906,11 +952,15 @@ class ReplicaRouter:
             if fence is None:
                 continue
             try:
-                fence(self.epoch)
+                if self.shard is not None:
+                    fence(self.epoch, shard=self.shard)
+                else:
+                    fence(self.epoch)
                 fenced.append(name)
             except Exception:  # noqa: BLE001 — ratchets on first request
                 continue
         self.recovery = {
+            **({"shard": self.shard} if self.shard is not None else {}),
             "epoch": self.epoch, "wal_epoch": wal_epoch,
             "max_replica_fence": max_fence, "jobs_restored": restored,
             "finalized_restored": len(state.finalized),
@@ -969,6 +1019,28 @@ class ReplicaRouter:
         with self._lock:
             self.stats[key] += n
 
+    def _stamp(self, **fields) -> dict:
+        """One ``router:`` response block: the given fields plus the
+        fencing epoch and (when sharded) the shard label + shard-map
+        version — the trace/attribution identity of the router life
+        that served the request."""
+        fields["epoch"] = self.epoch
+        if self.shard is not None:
+            fields["shard"] = self.shard
+            fields["map_version"] = self.map_version
+        return fields
+
+    def _debt_hook(self, tenant: str, delta: float) -> None:
+        """Peer-replication fan-out for one quota charge/refund (the
+        ``on_debt`` callback; errors are the peer layer's problem and
+        must never fail admission)."""
+        if self.on_debt is None:
+            return
+        try:
+            self.on_debt(tenant, float(delta))
+        except Exception:  # noqa: BLE001 — replication is best-effort
+            pass
+
     def _tenant_admit(self, tenant: str, rid: str, trace_id: str,
                       cost: float = 1.0):
         """None when admitted; the (status, wire) shed otherwise.
@@ -987,6 +1059,7 @@ class ReplicaRouter:
                     "debt", tenant=tenant, delta=round(cost, 9),
                     level=round(lvl, 9)))))
         if ok:
+            self._debt_hook(tenant, cost)
             if self.pricer is not None and obs_metrics.enabled():
                 obs_metrics.counter(
                     "pctpu_router_work_units_total",
@@ -1118,8 +1191,8 @@ class ReplicaRouter:
         if offset and order:
             off = offset % len(order)
             order = order[off:] + order[:off]
-        meta = {"home": home, "replica": "", "attempts": 0,
-                "failovers": 0, "spills": 0, "epoch": self.epoch}
+        meta = self._stamp(home=home, replica="", attempts=0,
+                           failovers=0, spills=0)
         last_shed = last_fail = None
         tp = (obs_trace.format_traceparent(sp.context)
               if sp.context is not None else None)
@@ -1194,6 +1267,11 @@ class ReplicaRouter:
             # The fencing stamp (round 19): replicas ratchet on it and
             # reject anything older — a zombie router cannot write.
             body["router_epoch"] = self.epoch
+        if self.shard is not None:
+            # Round 21: scope the replica-side fence to THIS shard's
+            # ratchet — fencing shard A's zombie must not reject the
+            # same process's live ownership of shard B.
+            body["router_shard"] = self.shard
         self._bump("routed")
         cost = (self.pricer.price(body)
                 if self.pricer is not None else 1.0)
@@ -1202,16 +1280,19 @@ class ReplicaRouter:
         # latency curves by codec.
         wire_arm = "frames" if "_frames_raw" in body else "json"
         with obs_trace.span("route", request_id=rid, tenant=tenant,
-                            wire=wire_arm) as sp:
+                            wire=wire_arm,
+                            **({"shard": self.shard,
+                                "map_version": self.map_version}
+                               if self.shard is not None else {})) as sp:
             tid = sp.context.trace_id if sp.context is not None else ""
             shed = self._tenant_admit(tenant, rid, tid, cost)
             if shed is not None:
                 sp.set(outcome="tenant_quota")
                 status, wire = shed
                 wire["wire"] = wire_arm
-                wire["router"] = {"home": "", "replica": "", "attempts": 0,
-                                  "failovers": 0, "spills": 0,
-                                  "epoch": self.epoch}
+                wire["router"] = self._stamp(
+                    home="", replica="", attempts=0, failovers=0,
+                    spills=0)
                 return status, wire
             key = route_key(body)
             self._observe_config(key, body)
@@ -1418,6 +1499,8 @@ class ReplicaRouter:
         body["tenant"] = tenant
         if self.epoch:
             body["router_epoch"] = self.epoch
+        if self.shard is not None:
+            body["router_shard"] = self.shard
         self._bump("routed")
         self._bump("progressive")
         key = route_key(body)
@@ -1447,15 +1530,17 @@ class ReplicaRouter:
                     ledger_seeded = True
         cost = self._converge_cost(body)
         with obs_trace.span("route", request_id=rid, tenant=tenant,
-                            progressive=True) as sp:
+                            progressive=True,
+                            **({"shard": self.shard,
+                                "map_version": self.map_version}
+                               if self.shard is not None else {})) as sp:
             tid = sp.context.trace_id if sp.context is not None else ""
             shed = self._tenant_admit(tenant, rid, tid, cost)
             if shed is not None:
                 sp.set(outcome="tenant_quota")
                 status, wire = shed
                 wire["kind"] = "rejected"
-                wire.setdefault("router", {"replica": "",
-                                           "epoch": self.epoch})
+                wire.setdefault("router", self._stamp(replica=""))
                 return status, iter([wire])
             if self.durable:
                 # Write-ahead admission — AFTER the quota gate (a shed
@@ -1488,8 +1573,7 @@ class ReplicaRouter:
                 # but the WAL must record it SETTLED — a recovery has
                 # nothing to reconcile for this job.
                 self._wal_append("job_settled", lid=lid)
-                b.setdefault("router", {"replica": "",
-                                        "epoch": self.epoch})
+                b.setdefault("router", self._stamp(replica=""))
                 return a, iter([b])
             if verdict == "reject":
                 sp.set(outcome=b.get("rejected") or "rejected")
@@ -1502,8 +1586,7 @@ class ReplicaRouter:
                         and b.get("rejected") in _REFUND_REJECTS):
                     self._refund(tenant, cost)
                 self._wal_append("job_settled", lid=lid)
-                b.setdefault("router", {"replica": "",
-                                        "epoch": self.epoch})
+                b.setdefault("router", self._stamp(replica=""))
                 return a, iter([b])
             rep, rows = a, b
             sp.set(outcome="streaming", replica=rep.name)
@@ -1646,9 +1729,8 @@ class ReplicaRouter:
                             # stop (charge stays; settle it so recovery
                             # has nothing to reconcile).
                             self._wal_append("job_settled", lid=lid)
-                            row.setdefault("router",
-                                           {"replica": rep.name,
-                                            "epoch": self.epoch})
+                            row.setdefault(
+                                "router", self._stamp(replica=rep.name))
                             yield row
                             return
                         if self.durable:
@@ -1665,8 +1747,7 @@ class ReplicaRouter:
                         wu_last = max(wu_last, float(
                             row.get("work_units", 0.0) or 0.0))
                         rows_flowed += 1
-                        stamp = {"replica": rep.name,
-                                 "epoch": self.epoch}
+                        stamp = self._stamp(replica=rep.name)
                         n_res, res_from = self.jobs.resume_info(lid)
                         if n_res:
                             stamp["resume_count"] = n_res
@@ -1695,8 +1776,8 @@ class ReplicaRouter:
                                               "already delivered to a "
                                               "concurrent stream for "
                                               "this id",
-                                    "router": {"replica": rep.name,
-                                               "epoch": self.epoch}}
+                                    "router": self._stamp(
+                                        replica=rep.name)}
                                 return
                             self._wal_append("final", lid=lid)
                             self._bump("completed")
@@ -1742,8 +1823,8 @@ class ReplicaRouter:
                         continue
                     if verdict == "pass":
                         self._wal_append("job_settled", lid=lid)
-                        b.setdefault("router", {"replica": "",
-                                                "epoch": self.epoch})
+                        b.setdefault("router",
+                                     self._stamp(replica=""))
                         yield b
                         return
                     # Walk exhausted.  A NON-retryable typed death (a
@@ -1795,7 +1876,7 @@ class ReplicaRouter:
                 # survives: a client retry still resumes.
                 self._wal_append("job_settled", lid=lid)
                 n_res, res_from = self.jobs.resume_info(lid)
-                stamp = {"replica": "", "epoch": self.epoch}
+                stamp = self._stamp(replica="")
                 if n_res:
                     stamp["resume_count"] = n_res
                     stamp["resumed_from"] = res_from
@@ -1958,8 +2039,11 @@ class ReplicaRouter:
             # ledger_evicted counter inside.
             "jobs": self.jobs.snapshot(),
             # Crash-safe control plane (round 19): the fencing epoch
-            # and the WAL's own health.
+            # and the WAL's own health.  Round 21: the shard this
+            # router owns (None when unsharded) + its map version.
             "epoch": self.epoch,
+            **({"shard": self.shard, "map_version": self.map_version}
+               if self.shard is not None else {}),
             **({"wal": self.wal.snapshot()}
                if self.wal is not None else {}),
             **({"tenants": self.quotas.snapshot()}
@@ -2012,6 +2096,16 @@ def make_router_http_server(router: ReplicaRouter, host: str = "127.0.0.1",
                 self._send(*router.readyz())
             elif self.path == "/stats":
                 self._send(200, router.snapshot())
+            elif self.path == "/v1/shardmap":
+                # Sharded control plane (round 21): any router serves
+                # the version-stamped shard map — clients fetch it from
+                # whichever peer answers and route directly to owners.
+                smw = getattr(router, "shardmap_wire", None)
+                if smw is None:
+                    self._send(404, {"ok": False,
+                                     "detail": "not a sharded router"})
+                else:
+                    self._send(200, smw())
             elif self.path == "/metrics":
                 from parallel_convolution_tpu.serving.frontend import (
                     metrics_text,
@@ -2077,11 +2171,32 @@ def make_router_http_server(router: ReplicaRouter, host: str = "127.0.0.1",
             send_frames_stream(self, (_reframe_row(r) for r in rows))
 
         def do_POST(self):  # noqa: N802 — http.server API
-            if self.path not in ("/v1/convolve", "/v1/converge"):
+            if self.path not in ("/v1/convolve", "/v1/converge",
+                                 "/v1/peersync"):
                 # Drain the body first: under HTTP/1.1 keep-alive an
                 # unread body would be parsed as the NEXT request line.
                 drain_body(self)
                 self._send(404, {"ok": False, "detail": "unknown path"})
+                return
+            if self.path == "/v1/peersync":
+                # Peer anti-entropy pull (round 21): the caller posts
+                # its sync cursor, the reply carries map + membership +
+                # debt deltas since then.
+                sync = getattr(router, "handle_peersync", None)
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    if not isinstance(body, dict):
+                        raise ValueError("body must be a JSON object")
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._send(400, {"ok": False, "rejected": "invalid",
+                                     "detail": f"bad JSON body: {e}"})
+                    return
+                if sync is None:
+                    self._send(404, {"ok": False,
+                                     "detail": "not a sharded router"})
+                else:
+                    self._send(200, sync(body))
                 return
             ctype = (self.headers.get("Content-Type") or "").split(
                 ";")[0].strip().lower()

@@ -326,7 +326,12 @@ class ConvolutionService:
         # took over.  Process memory on purpose: a replica restart
         # clears its dedup ledger too, and the fence re-ratchets on the
         # first request from the live router.
-        self._fence_epoch = 0
+        # Router-epoch fences, keyed by shard label (round 21).  The
+        # empty key "" is the unsharded/legacy lineage; a replica serving
+        # N shards holds N independent ratchets, so fencing shard A's
+        # zombie owner never rejects the same process's LIVE ownership
+        # of shard B.
+        self._fences: dict[str, int] = {}
 
     def _make_batcher(self, max_batch: int, max_delay_s: float,
                       max_queue: int, start: bool = True) -> MicroBatcher:
@@ -1037,52 +1042,64 @@ class ConvolutionService:
                           else str(c.get("col_mode")))))
         return self.engine.warmup(keys)
 
-    def fence(self, epoch: int) -> int:
-        """Ratchet the router-epoch fence to at least ``epoch`` (the
-        takeover propagation call — ``POST /v1/fence``); returns the
-        fence after ratcheting.  Never lowers it."""
+    def fence(self, epoch: int, shard=None) -> int:
+        """Ratchet the router-epoch fence for ``shard`` (the empty /
+        ``None`` label is the unsharded lineage) to at least ``epoch``
+        (the takeover propagation call — ``POST /v1/fence``); returns
+        the fence after ratcheting.  Never lowers it.  Fences are
+        PER-SHARD: sweeping shard A leaves shard B's ratchet alone."""
         e = int(epoch)
+        s = "" if shard is None else str(shard)
         with self._lock:
-            if e > self._fence_epoch:
-                self._fence_epoch = e
-            return self._fence_epoch
+            if e > self._fences.get(s, 0):
+                self._fences[s] = e
+            return self._fences.get(s, 0)
 
-    def fence_epoch(self) -> int:
+    def fence_epoch(self, shard=None) -> int:
+        s = "" if shard is None else str(shard)
         with self._lock:
-            return self._fence_epoch
+            return self._fences.get(s, 0)
 
-    def epoch_gate(self, epoch) -> tuple[bool, int]:
-        """Admission-time fencing: ``(admit, current_fence)``.
+    def fence_epochs(self) -> dict:
+        """Every shard's fence (the recovery read for a multi-lineage
+        takeover; key "" is the unsharded legacy ratchet)."""
+        with self._lock:
+            return dict(self._fences)
 
-        ``None`` (a direct client, no router in the path) always
+    def epoch_gate(self, epoch, shard=None) -> tuple[bool, int]:
+        """Admission-time fencing: ``(admit, current_fence)``, scoped
+        to ``shard``'s ratchet (``None``/"" = the unsharded lineage).
+
+        ``None`` epoch (a direct client, no router in the path) always
         admits.  A NEWER epoch ratchets the fence and admits — the
         first request from a freshly taken-over router is itself the
         fence propagation.  A STALE epoch is refused (counted,
         evented): the caller sheds it typed non-retryable
         ``stale_epoch`` before any queueing or device work.
         """
+        s = "" if shard is None else str(shard)
         if epoch is None:
             with self._lock:
-                return True, self._fence_epoch
+                return True, self._fences.get(s, 0)
         try:
             e = int(epoch)
         except (TypeError, ValueError):
             with self._lock:
-                return True, self._fence_epoch
+                return True, self._fences.get(s, 0)
         with self._lock:
-            if e > self._fence_epoch:
-                self._fence_epoch = e
-            ok = e >= self._fence_epoch
+            if e > self._fences.get(s, 0):
+                self._fences[s] = e
+            ok = e >= self._fences.get(s, 0)
             if not ok:
                 self.stats["rejected_stale_epoch"] += 1
-            cur = self._fence_epoch
+            cur = self._fences.get(s, 0)
         if not ok and obs_metrics.enabled():
             obs_metrics.counter(
                 "pctpu_stale_epoch_rejects_total",
                 "requests refused for carrying a fenced-out router "
                 "epoch (zombie active after a takeover)").inc()
             obs_events.emit("router", event="stale_epoch",
-                            epoch=e, fence=cur)
+                            epoch=e, fence=cur, shard=s)
         return ok, cur
 
     def readiness(self) -> tuple[bool, dict]:
@@ -1117,8 +1134,11 @@ class ConvolutionService:
             "degraded": degraded,
             # The router-epoch fence (round 19): a recovering router
             # reads this off every replica to place its own epoch ABOVE
-            # anything any previous active ever stamped.
+            # anything any previous active ever stamped.  Round 21 adds
+            # the full per-shard map; the scalar stays the unsharded
+            # lineage's ratchet for wire compatibility.
             "fence_epoch": self.fence_epoch(),
+            "fence_epochs": self.fence_epochs(),
             "grid": "x".join(str(v) for v in self.engine.grid()),
         }
 
@@ -1141,6 +1161,7 @@ class ConvolutionService:
             "platform": dev.platform,
             "device_kind": getattr(dev, "device_kind", "") or "",
             "fence_epoch": self.fence_epoch(),
+            "fence_epochs": self.fence_epochs(),
             # Topology identity (ROADMAP item 1's keying, pulled forward
             # in r17): loadgen summaries and perf_gate.row_key consume
             # these so a future multi-host row never shares a baseline

@@ -52,6 +52,14 @@ Record vocabulary (see DESIGN.md "Durable control plane"):
 ``debt``         a tenant bucket's post-charge/refund level (+ delta)
 ``snapshot``     full folded state (rotation compaction head)
 
+Round 21 generalizes the journal from epoch-per-router to
+epoch-per-SHARD: a :class:`RouterWAL` opened with ``shard="02"`` stamps
+``shard`` onto every record it appends, and replay refuses a record
+stamped for a different shard (crossed lineage files are loud
+corruption, not silent splice).  One router process may own several
+lineages — one file per shard, each with its own flock sidecar,
+generations, epoch, and quarantine namespace.
+
 stdlib-only, jax-free: the router must be able to recover on a host
 with no accelerator attached.
 """
@@ -353,13 +361,28 @@ class RouterWAL:
     """
 
     def __init__(self, path, *, max_bytes: int = 4 << 20, keep: int = 2,
-                 fsync: bool = True):
+                 fsync: bool = True, shard: str | None = None):
         if max_bytes < 4096:
             raise ValueError("max_bytes must be >= 4096")
         if keep < 1:
             raise ValueError("keep must be >= 1 (rotation relies on the "
                              "snapshot landing in a surviving file)")
         self.path = Path(path)
+        # Multi-lineage guard: rotation names generations by appending
+        # ``.1``, ``.2``, ... to the LIVE file's name, and
+        # ``_generations`` probes the same pattern.  A lineage whose own
+        # name ends in ``.<digits>`` (say ``ctl.wal.2`` living next to
+        # ``ctl.wal``) would be read as a rotated generation of its
+        # SIBLING — silently splicing one shard's records into
+        # another's replay.  Refuse the name up front.
+        stem, dot, suffix = self.path.name.rpartition(".")
+        if dot and stem and suffix.isdigit():
+            raise ValueError(
+                f"WAL lineage name {self.path.name!r} ends in "
+                f"'.{suffix}', which collides with rotated-generation "
+                "naming when sibling lineages share the directory; "
+                "pick a non-numeric suffix (e.g. 'shard-02.wal')")
+        self.shard = None if shard is None else str(shard)
         self.max_bytes = int(max_bytes)
         self.keep = int(keep)
         self.fsync = bool(fsync)
@@ -412,6 +435,20 @@ class RouterWAL:
             records, torn, live_valid_bytes = _read_wal_detail(
                 self.path)
             for rec in records:
+                # Per-shard lineage identity: every record this writer
+                # appends is stamped with its shard label, and replay
+                # refuses a record stamped for a DIFFERENT shard — the
+                # on-disk symptom of two lineages' files getting
+                # crossed (a mis-rotated generation, a copy/paste
+                # restore into the wrong directory).  Legacy records
+                # with no stamp are adoptable by any lineage.
+                rec_shard = rec.get("shard")
+                if (self.shard is not None and rec_shard is not None
+                        and str(rec_shard) != self.shard):
+                    raise WALCorrupt(
+                        "format", self.path, rec.get("seq", 0),
+                        f"record stamped for shard {rec_shard!r} in "
+                        f"lineage owned by shard {self.shard!r}")
                 try:
                     self.state.apply(rec)
                 except (KeyError, TypeError, ValueError) as e:
@@ -473,10 +510,20 @@ class RouterWAL:
 
     def _quarantine(self) -> list[Path]:
         """Move every generation aside as ``*.quarantined`` (atomic
-        renames; a vanished source means a sibling got there first)."""
+        renames; a vanished source means a sibling got there first).
+
+        Destinations are made UNIQUE (``.quarantined``,
+        ``.quarantined.2``, ...) instead of ``os.replace`` clobbering:
+        a second quarantine of the same lineage — or two shard
+        lineages sharing a directory after a botched rename — must
+        never destroy the forensic evidence of the first."""
         moved = []
         for fp in _generations(self.path):
             dst = fp.with_name(fp.name + ".quarantined")
+            n = 1
+            while dst.exists():
+                n += 1
+                dst = fp.with_name(f"{fp.name}.quarantined.{n}")
             try:
                 os.replace(fp, dst)
                 moved.append(dst)
@@ -580,7 +627,10 @@ class RouterWAL:
         self._ensure_open()
         # Compaction head: the fresh live file opens with the FULL
         # folded state, so generations dropped off the end lose nothing.
-        self._write_locked("snapshot", {"state": self.state.to_wire()})
+        snap: dict = {"state": self.state.to_wire()}
+        if self.shard is not None:
+            snap["shard"] = self.shard
+        self._write_locked("snapshot", snap)
 
     def append(self, kind: str, **fields) -> dict:
         """Append one record (write-ahead: call BEFORE acting on it).
@@ -591,6 +641,8 @@ class RouterWAL:
             raise ValueError(
                 f"unknown WAL record kind {kind!r}; known: "
                 f"{sorted(RECORD_KINDS)}")
+        if self.shard is not None:
+            fields.setdefault("shard", self.shard)
         with self._lock, self._file_lock():
             fault_point("wal_write")
             self._ensure_open()
@@ -616,6 +668,7 @@ class RouterWAL:
         with self._lock:
             return {
                 "path": str(self.path),
+                "shard": self.shard,
                 "seq": self._seq,
                 "records_written": self.records_written,
                 "size_bytes": self._size,

@@ -34,6 +34,14 @@ carrying a ``router`` stamp feed the row's ``failovers_observed``, and
 (round 19) their fencing-epoch stamps feed ``router_restarts_observed``
 — the count of router restarts/takeovers this client watched happen
 while its run kept completing.
+
+Round 21: ``--shardmap`` makes multiple ``--target`` URLs a SHARDED
+control-plane fleet (scripts/router.py --shards N): the client fetches
+the version-stamped map from ``GET /v1/shardmap``, routes every request
+straight to its key shard's owner, and on a typed ``wrong_shard`` /
+``stale_epoch`` reject refreshes the map and retries at the new owner —
+a mid-run takeover shows up in ``router_restarts_observed`` and
+``shardmap_refreshes``, never as a failure.
 """
 
 from __future__ import annotations
@@ -247,6 +255,116 @@ class _HTTPTransport:
             return json.loads(resp.read())
 
 
+class _ShardedTransport:
+    """The shard-aware client half over HTTP (round 21; the in-process
+    twin is ``serving.peers.ShardClient``): fetch the version-stamped
+    ownership map from any fleet member, compute each request's shard
+    from its route key, dial the owner directly, and treat a typed
+    ``wrong_shard``/``stale_epoch`` reject as "my map is stale" —
+    refresh and retry at the new owner, bounded."""
+
+    _REROUTE = ("wrong_shard", "stale_epoch")
+
+    def __init__(self, urls: list[str], timeout: float,
+                 max_redirects: int = 4):
+        self._by_addr = {u.rstrip("/"): _HTTPTransport(u, timeout)
+                         for u in urls}
+        self.timeout = timeout
+        self.max_redirects = max_redirects
+        self._lock = threading.Lock()
+        self._map: dict = {"version": -1, "n_shards": 1, "shards": {}}
+        self.refreshes = 0
+        self.refresh()
+
+    def refresh(self) -> dict:
+        import urllib.request
+
+        last: Exception | None = None
+        for base in list(self._by_addr):
+            try:
+                with urllib.request.urlopen(
+                        base + "/v1/shardmap",
+                        timeout=self.timeout) as r:
+                    smw = json.loads(r.read())
+            except Exception as e:  # noqa: BLE001 — try the next member
+                last = e
+                continue
+            with self._lock:
+                if smw.get("version", -1) >= self._map.get("version",
+                                                           -1):
+                    self._map = smw
+                self.refreshes += 1
+                return dict(self._map)
+        raise ConnectionError(
+            f"no fleet member answered /v1/shardmap: {last!r}")
+
+    def _transport_for(self, body: dict):
+        from parallel_convolution_tpu.serving.peers import shard_of
+        from parallel_convolution_tpu.serving.router import route_key
+
+        with self._lock:
+            smw = self._map
+        shard = shard_of(route_key(dict(body)),
+                         smw.get("n_shards", 1) or 1)
+        ent = (smw.get("shards") or {}).get(shard) or {}
+        addr = (ent.get("addr") or "").rstrip("/")
+        with self._lock:
+            tr = self._by_addr.get(addr)
+            if tr is None and addr:
+                # A takeover can publish an owner addr we were never
+                # given on the CLI — dial it anyway.
+                tr = self._by_addr.setdefault(
+                    addr, _HTTPTransport(addr, self.timeout))
+        if tr is None:
+            tr = next(iter(self._by_addr.values()))
+        return tr
+
+    def _call(self, method: str, body: dict):
+        status, wire = -1, {"ok": False, "detail": "no attempt"}
+        for _ in range(self.max_redirects):
+            tr = self._transport_for(body)
+            try:
+                status, wire = getattr(tr, method)(body)
+            except Exception as e:  # noqa: BLE001 — owner unreachable
+                # A dead owner is indistinguishable from a stale map:
+                # re-fetch from the survivors and retry at whoever the
+                # takeover elected.  If it never converges, hand the
+                # outer loop a typed RETRYABLE outcome (the same shape
+                # the broken-stream path uses) so its capped backoff
+                # spans the takeover window.
+                status = -1
+                wire = {"ok": False, "kind": "rejected",
+                        "rejected": "replica_unavailable",
+                        "retryable": True,
+                        "detail": f"owner unreachable: {e!r}"[:300]}
+                try:
+                    self.refresh()
+                except ConnectionError:
+                    pass
+                time.sleep(0.05)
+                continue
+            if (isinstance(wire, dict)
+                    and wire.get("rejected") in self._REROUTE):
+                # Ownership moved underneath us (redirect or fenced
+                # takeover): stale map, not a failed request.
+                try:
+                    self.refresh()
+                except ConnectionError:
+                    pass
+                continue
+            return status, wire
+        return status, wire
+
+    def request(self, body: dict):
+        return self._call("request", body)
+
+    def converge(self, body: dict):
+        return self._call("converge", body)
+
+    def snapshot(self) -> dict:
+        return next(iter(self._by_addr.values())).snapshot()
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     tgt = ap.add_mutually_exclusive_group(required=True)
@@ -260,6 +378,12 @@ def main() -> int:
                           "one router URL)")
     tgt.add_argument("--in-process", action="store_true",
                      help="build the service in this process (no sockets)")
+    ap.add_argument("--shardmap", action="store_true",
+                    help="treat the --target URLs as a SHARDED router "
+                         "fleet (scripts/router.py --shards N): fetch "
+                         "GET /v1/shardmap, route each request to its "
+                         "key shard's owner, and refresh-and-retry on "
+                         "typed wrong_shard / stale_epoch rejects")
     ap.add_argument("--n", type=int, default=50, help="total requests")
     ap.add_argument("--concurrency", type=int, default=4,
                     help="closed-loop worker count (ignored with --rate)")
@@ -394,6 +518,8 @@ def main() -> int:
                  if args.wire != "json" else [])
 
     targets = args.target or ([args.url] if args.url else None)
+    if args.shardmap and not targets:
+        ap.error("--shardmap needs HTTP --target fleet members")
     service = None
     if args.in_process:
         from parallel_convolution_tpu.obs import events as obs_events
@@ -436,6 +562,15 @@ def main() -> int:
             transports = [lambda b: client.request(b, timeout=args.timeout)]
             ftransports = [_request_frames_inproc]
         transport_snapshot = service.snapshot
+    elif args.shardmap:
+        if args.wire != "json":
+            ap.error("--shardmap routes on the JSON route key; use "
+                     "--wire json")
+        sharded = _ShardedTransport(targets, args.timeout)
+        transports = [sharded.converge if args.converge is not None
+                      else sharded.request]
+        ftransports = []
+        transport_snapshot = sharded.snapshot
     else:
         https = [_HTTPTransport(url, args.timeout) for url in targets]
         transports = [(h.converge if args.converge is not None
@@ -669,6 +804,11 @@ def main() -> int:
             and r["router"]["replica"] != r["router"]["home"]))
     replicas_seen = sorted({r.get("router", {}).get("replica", "")
                             for _, r in completed} - {""})
+    # Round 21: which control-plane shards served this client's keys —
+    # plus how often the shard map had to be re-fetched mid-run (>1
+    # means a redirect/takeover was observed and absorbed).
+    shards_seen = sorted({r.get("router", {}).get("shard", "")
+                          for _, r in completed} - {""})
     # Round 19: the router stamps its fencing epoch on every response;
     # an epoch CHANGE mid-run means the control plane restarted (or a
     # standby took over) underneath this client — and the run kept
@@ -718,6 +858,9 @@ def main() -> int:
         "rejected_retried": retried[0],
         "failovers_observed": failovers_observed,
         **({"replicas_seen": replicas_seen} if replicas_seen else {}),
+        **({"shards_seen": shards_seen} if shards_seen else {}),
+        **({"shardmap_refreshes": sharded.refreshes}
+           if args.shardmap else {}),
         **({"router_restarts_observed": len(epochs_seen) - 1,
             "router_epochs_seen": epochs_seen} if epochs_seen else {}),
         "non_rejected_failures": non_rejected_failures,

@@ -301,6 +301,67 @@ def wire_ab_flags(rows: list[dict], *, min_bytes: int,
     return out
 
 
+def router_scale_flags(rows: list[dict], *, min_ratio: float,
+                       p99_mult: float) -> list[dict]:
+    """Gate the sharded-control-plane scale lane (round 21): the
+    ``lane: "router_scale"`` rows ``scripts/shard_smoke.py`` appends to
+    ``evidence/scale_curve.jsonl``.  Each row is one fleet size (1, 2,
+    3 active routers, each fronting its OWN fixed-service-rate replica
+    pool) driven by the identical shard-spread workload.  Holds:
+
+    * the 1-router and 3-router rows both exist (missing evidence is a
+      flag, never a pass);
+    * no row carries non-rejected failures;
+    * 3-router aggregate RPS >= ``min_ratio`` x the 1-router knee — the
+      control plane must scale out, not serialize behind one router;
+    * 3-router p99 <= ``p99_mult`` x the 1-router p99 — throughput must
+      not be bought with tail latency.
+    """
+    out = []
+    lane = [r for r in rows if r.get("lane") == "router_scale"]
+    if not lane:
+        return [{"check": "router_scale", "why": "no router_scale rows"}]
+    by_k: dict[int, dict] = {}
+    for r in lane:
+        try:
+            by_k[int(r["routers"])] = r
+        except (KeyError, TypeError, ValueError):
+            out.append({"check": "router_scale",
+                        "why": f"malformed lane row {r}"})
+    for r in lane:
+        if r.get("failures"):
+            out.append({"check": "scale_failures",
+                        "routers": r.get("routers"),
+                        "why": f"{r['failures']} non-rejected failures "
+                               "in the scale lane"})
+    r1, r3 = by_k.get(1), by_k.get(3)
+    if r1 is None or r3 is None:
+        out.append({"check": "router_scale",
+                    "why": f"need 1- and 3-router rows, have "
+                           f"{sorted(by_k)}"})
+        return out
+    try:
+        rps1, rps3 = float(r1["rps"]), float(r3["rps"])
+        p99_1, p99_3 = float(r1["p99_ms"]), float(r3["p99_ms"])
+    except (KeyError, TypeError, ValueError):
+        out.append({"check": "router_scale",
+                    "why": "lane rows missing rps/p99_ms"})
+        return out
+    ratio = rps3 / rps1 if rps1 else 0.0
+    if ratio < min_ratio:
+        out.append({"check": "scale_ratio", "rps_1": rps1,
+                    "rps_3": rps3, "ratio": round(ratio, 3),
+                    "required": min_ratio,
+                    "why": "3-router aggregate RPS did not clear "
+                           f"{min_ratio}x the 1-router knee"})
+    if p99_1 and p99_3 > p99_mult * p99_1:
+        out.append({"check": "scale_p99", "p99_1_ms": p99_1,
+                    "p99_3_ms": p99_3, "mult": p99_mult,
+                    "why": "3-router p99 blew past the 1-router "
+                           "baseline band"})
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--history", default=None,
@@ -340,13 +401,27 @@ def main() -> int:
                     help="payload size from which frames must beat JSON")
     ap.add_argument("--wire-knee-ratio", type=float, default=1.2,
                     help="required refill/drain scale-curve knee ratio")
+    ap.add_argument("--router-scale", default=None, metavar="JSONL",
+                    help="scale-curve evidence holding the round-21 "
+                         "lane: \"router_scale\" rows "
+                         "(evidence/scale_curve.jsonl from scripts/"
+                         "shard_smoke.py): 3-router aggregate RPS must "
+                         "clear --scale-min-ratio x the 1-router knee "
+                         "with p99 inside --scale-p99-mult")
+    ap.add_argument("--scale-min-ratio", type=float, default=2.4,
+                    help="required 3-router / 1-router aggregate RPS "
+                         "ratio")
+    ap.add_argument("--scale-p99-mult", type=float, default=1.5,
+                    help="3-router p99 must stay within this multiple "
+                         "of the 1-router p99")
     ap.add_argument("--out", default=None,
                     help="also write the JSON report to this path")
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args()
-    if not args.row and not args.drift_metrics and not args.wire_ab:
-        print("need --row, --drift-metrics, and/or --wire-ab",
-              file=sys.stderr)
+    if (not args.row and not args.drift_metrics and not args.wire_ab
+            and not args.router_scale):
+        print("need --row, --drift-metrics, --wire-ab, and/or "
+              "--router-scale", file=sys.stderr)
         return 2
     if args.row and not args.history:
         print("--row needs --history", file=sys.stderr)
@@ -387,6 +462,18 @@ def main() -> int:
         wflags = wire_ab_flags(wrows, min_bytes=args.wire_min_bytes,
                                knee_ratio=args.wire_knee_ratio)
 
+    sflags = []
+    if args.router_scale:
+        try:
+            srows = load_rows([args.router_scale])
+        except (OSError, ValueError) as e:
+            print(f"perf_gate: unreadable router-scale file: {e}",
+                  file=sys.stderr)
+            return 2
+        sflags = router_scale_flags(srows,
+                                    min_ratio=args.scale_min_ratio,
+                                    p99_mult=args.scale_p99_mult)
+
     regressions = [v for v in verdicts if v["status"] == "regression"]
     if args.update and hist_path:
         # Append-only, one line per gated row — regressions too: a real
@@ -417,6 +504,7 @@ def main() -> int:
         "regressions": len(regressions),
         "drift_flags": flags,
         "wire_ab_flags": wflags,
+        "router_scale_flags": sflags,
         "updated": bool(args.update),
     }
     if not args.quiet:
@@ -433,13 +521,15 @@ def main() -> int:
                   f"[1/{fl['bound']}, {fl['bound']}]")
         for fl in wflags:
             print(f"wire_ab    {fl['check']}: {fl['why']}")
+        for fl in sflags:
+            print(f"router_scale {fl['check']}: {fl['why']}")
     if args.out:
         p = Path(args.out)
         p.parent.mkdir(parents=True, exist_ok=True)
         p.write_text(json.dumps(report, indent=2))
     else:
         print(json.dumps(report))
-    return 1 if regressions or flags or wflags else 0
+    return 1 if regressions or flags or wflags or sflags else 0
 
 
 if __name__ == "__main__":

@@ -13,6 +13,15 @@ Two deployment shapes, one wire format:
   python scripts/router.py --port 8090 \\
       --target http://host-a:8080 --target http://host-b:8080
 
+  # ONE member of a sharded control plane (round 21): three of these,
+  # each owning one shard's WAL lineage under a shared --state-dir,
+  # peer-synced and ready to take over a dead peer's shards:
+  python scripts/router.py --port 8090 --replicas 2 --mesh 1x2 \\
+      --shards 3 --name rA --state-dir /var/pctpu/ctl \\
+      --advertise http://host-a:8090 \\
+      --assign 0=rA --assign 1=rB --assign 2=rC \\
+      --peer rB=http://host-b:8091 --peer rC=http://host-c:8092
+
   curl -s localhost:8090/readyz | python -m json.tool   # 200 iff any
   #   replica is ready; per-replica breaker states in the payload
   python scripts/loadgen.py --target http://127.0.0.1:8090 --n 200 ...
@@ -97,6 +106,40 @@ def main() -> int:
                          "restarting this script on the same PATH is a "
                          "fenced takeover (the epoch bumps; a zombie "
                          "predecessor gets typed stale_epoch rejects)")
+    # Round 21 — sharded control plane (N active routers):
+    ap.add_argument("--shards", type=int, default=0, metavar="N",
+                    help="partition the control plane into N shards: "
+                         "this process becomes ONE active router of a "
+                         "fleet, owning the shards --assign maps to "
+                         "--name (each on its own WAL lineage under "
+                         "--state-dir) and redirecting the rest with "
+                         "typed wrong_shard rejects; requires "
+                         "--state-dir")
+    ap.add_argument("--name", default="r0",
+                    help="this router's fleet-unique name (sharded "
+                         "mode)")
+    ap.add_argument("--state-dir", default=None, metavar="DIR",
+                    help="directory holding the per-shard WAL "
+                         "lineages (shard-N.wal); booting any fleet "
+                         "member over the same DIR re-adopts its "
+                         "shards via the fenced takeover")
+    ap.add_argument("--peer", action="append", default=[],
+                    metavar="NAME=URL",
+                    help="peer router (repeatable): anti-entropy sync "
+                         "target, debt-replication source, and "
+                         "takeover candidate for this router's shards")
+    ap.add_argument("--assign", action="append", default=[],
+                    metavar="SHARD=NAME",
+                    help="boot ownership of SHARD (repeatable; shards "
+                         "left unassigned default to --name)")
+    ap.add_argument("--advertise", default=None, metavar="URL",
+                    help="own base URL published in the shard map "
+                         "(what redirected clients should dial)")
+    ap.add_argument("--sync-interval-s", type=float, default=0.25,
+                    help="peer anti-entropy period (sharded mode)")
+    ap.add_argument("--suspect-after", type=int, default=3,
+                    help="consecutive failed syncs before a dead "
+                         "peer's shards are taken over")
     args = ap.parse_args()
 
     if bool(args.target) == bool(args.replicas):
@@ -151,14 +194,59 @@ def main() -> int:
             r, c = args.mesh.lower().split("x")
             grid = (int(r), int(c))
         pricer = WorkPricer(grid=grid)
-    router = ReplicaRouter(
-        replicas, quotas=quotas, pricer=pricer, vnodes=args.vnodes,
-        breaker_threshold=args.breaker_threshold,
-        breaker_cooldown_s=args.breaker_cooldown_s,
-        poll_interval_s=args.poll_interval_s,
-        load_factor=args.load_factor,
-        hedge_s=args.hedge_ms / 1e3 if args.hedge_ms else None,
-        wal=args.wal)
+    if args.shards:
+        if not args.state_dir:
+            ap.error("--shards requires --state-dir (the per-shard "
+                     "WAL lineages live there)")
+        if args.wal:
+            ap.error("--shards replaces --wal: every shard gets its "
+                     "own lineage under --state-dir")
+        if args.autoscale_max:
+            ap.error("--autoscale-max is not supported in sharded "
+                     "mode")
+        from parallel_convolution_tpu.serving.peers import (
+            HTTPPeer, ShardRouter,
+        )
+
+        peers, addrs = [], {}
+        for spec in args.peer:
+            nm, _, url = spec.partition("=")
+            if not url:
+                ap.error(f"--peer wants NAME=URL, got {spec!r}")
+            peers.append(HTTPPeer(nm, url))
+            addrs[nm] = url
+        if args.advertise:
+            addrs[args.name] = args.advertise
+        assignments = {}
+        for spec in args.assign:
+            sh, _, nm = spec.partition("=")
+            if not nm:
+                ap.error(f"--assign wants SHARD=NAME, got {spec!r}")
+            assignments[sh] = nm
+        for s in range(args.shards):
+            assignments.setdefault(str(s), args.name)
+        owned = [s for s, o in assignments.items()
+                 if o == args.name]
+        router = ShardRouter(
+            args.name, replicas, n_shards=args.shards, owned=owned,
+            state_dir=args.state_dir, assignments=assignments,
+            addrs=addrs, quotas=quotas, pricer=pricer, peers=peers,
+            sync_interval_s=args.sync_interval_s,
+            suspect_after=args.suspect_after, vnodes=args.vnodes,
+            breaker_threshold=args.breaker_threshold,
+            breaker_cooldown_s=args.breaker_cooldown_s,
+            poll_interval_s=args.poll_interval_s,
+            load_factor=args.load_factor,
+            hedge_s=args.hedge_ms / 1e3 if args.hedge_ms else None)
+    else:
+        router = ReplicaRouter(
+            replicas, quotas=quotas, pricer=pricer, vnodes=args.vnodes,
+            breaker_threshold=args.breaker_threshold,
+            breaker_cooldown_s=args.breaker_cooldown_s,
+            poll_interval_s=args.poll_interval_s,
+            load_factor=args.load_factor,
+            hedge_s=args.hedge_ms / 1e3 if args.hedge_ms else None,
+            wal=args.wal)
 
     scaler = None
     if args.autoscale_max:
@@ -181,15 +269,22 @@ def main() -> int:
     host, port = server.server_address[:2]
     obs_events.emit("router", event="boot", url=f"http://{host}:{port}",
                     replicas=[r.name for r in replicas])
-    print(json.dumps({"routing": f"http://{host}:{port}",
-                      "replicas": [r.name for r in replicas],
-                      "tenant_quota": bool(quotas),
-                      "priced_admission": bool(pricer),
-                      "autoscale_max": args.autoscale_max or None,
-                      **({"wal": args.wal, "epoch": router.epoch,
-                          "recovery": router.recovery}
-                         if args.wal else {})},
-                     ), flush=True)
+    boot = {"routing": f"http://{host}:{port}",
+            "replicas": [r.name for r in replicas],
+            "tenant_quota": bool(quotas),
+            "priced_admission": bool(pricer),
+            "autoscale_max": args.autoscale_max or None}
+    if args.shards:
+        smw = router.shardmap_wire()
+        boot.update(name=args.name, shards=args.shards,
+                    owned=sorted(router.snapshot()["owned_shards"]),
+                    map_version=smw["version"],
+                    state_dir=args.state_dir,
+                    peers=[p.name for p in router.peers])
+    elif args.wal:
+        boot.update(wal=args.wal, epoch=router.epoch,
+                    recovery=router.recovery)
+    print(json.dumps(boot), flush=True)
 
     stopping = []
 

@@ -125,6 +125,36 @@
 #                                      []) lands in
 #                                      evidence/wire_gate.json (the
 #                                      supervisor leg's done_file).
+#   scripts/run_t1.sh --shard-smoke    sharded control plane (round 21):
+#                                      3 active routers over a 3-shard
+#                                      partition of the hash ring, each
+#                                      owning its own WAL lineage.  A
+#                                      shard-aware client routes by the
+#                                      version-stamped map; one router
+#                                      is SIGKILLed mid-converge-stream
+#                                      and the deterministic surviving
+#                                      successor performs the fenced
+#                                      takeover of the orphaned lineage
+#                                      (epoch bump, per-shard fence
+#                                      sweep, byte-identical resume,
+#                                      exactly one final per request_id,
+#                                      zombie writes rejected typed
+#                                      stale_epoch) while the OTHER
+#                                      shards serve with zero
+#                                      non-rejected failures.  Tenant
+#                                      debt replicates peer-to-peer so
+#                                      quotas shed fleet-wide, and the
+#                                      1/2/3-router scale lane
+#                                      (lane: router_scale in
+#                                      evidence/scale_curve.jsonl) must
+#                                      clear perf_gate --router-scale
+#                                      (3-router RPS >= 2.4x the
+#                                      1-router knee, p99 in band).
+#                                      Row (failures: 0) lands in
+#                                      evidence/shard_smoke.json (the
+#                                      supervisor leg's done_file); the
+#                                      lane gate report in
+#                                      evidence/shard_gate.json.
 #   scripts/run_t1.sh --static         fast static gate (no jax): every
 #                                      .py byte-compiles, no bare
 #                                      'except:', and every mutation of a
@@ -289,6 +319,14 @@ if [ "${1:-}" = "--wire-smoke" ]; then
   exec timeout -k 10 120 \
     python scripts/perf_gate.py --wire-ab evidence/wire_ab.jsonl \
       --out evidence/wire_gate.json
+fi
+
+if [ "${1:-}" = "--shard-smoke" ]; then
+  exec timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    PCTPU_OBS=1 \
+    python scripts/shard_smoke.py --n 12 --rows 24 --cols 32 \
+      --mesh 1x2 --out evidence/shard_smoke.json
 fi
 
 if [ "${1:-}" = "--static" ]; then

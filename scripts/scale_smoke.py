@@ -469,11 +469,24 @@ def main() -> int:
     router.close()
 
     # ---- evidence: the committed curve + the smoke's own perf gate.
+    # The curve file is SHARED: rows carrying a "lane" field belong to
+    # other smokes (round 21's router_scale lane from shard_smoke.py)
+    # and must survive our rewrite — we own only the un-laned rows.
     curve_path = Path(args.curve_out)
     curve_path.parent.mkdir(parents=True, exist_ok=True)
+    foreign: list[str] = []
+    if curve_path.exists():
+        for line in curve_path.read_text().splitlines():
+            try:
+                if line.strip() and json.loads(line).get("lane"):
+                    foreign.append(line)
+            except ValueError:
+                continue
     with open(curve_path, "w") as f:
         for r in curve_rows:
             f.write(json.dumps(r) + "\n")
+        for line in foreign:
+            f.write(line + "\n")
     out = Path(args.out)
     out.parent.mkdir(parents=True, exist_ok=True)
     out.write_text(json.dumps(row, indent=2))

@@ -834,6 +834,278 @@ def run_router_restart(args) -> int:
     return 1 if failures else 0
 
 
+def run_shard_kill(args) -> int:
+    """Sharded control-plane drill (round 21): N kill-one-of-three
+    cycles over PERSISTENT per-shard WAL lineages.
+
+    Each cycle boots a fresh 3-router fleet over the SAME three shard
+    lineages (a boot over an existing lineage is itself the r19 fenced
+    takeover, so epochs ratchet monotonically across cycles), rotates
+    which router owns which shard, then:
+
+    1. closed-loop traffic hammers the two NON-victim shards
+       throughout, with per-phase latency capture;
+    2. a converge stream on the victim's shard is cut mid-flight by
+       ``hard_stop`` (the in-process SIGKILL: flocks released, nothing
+       fenced gracefully);
+    3. surviving peers detect the death via anti-entropy misses and
+       the deterministic successor performs the cross-shard fenced
+       takeover of the orphaned lineage;
+    4. the shard client refreshes the map and retries: the job RESUMES
+       byte-identical to the uninterrupted oracle, exactly one final
+       per request_id; the zombie owner is rejected typed
+       ``stale_epoch``.
+
+    Gates: zero non-rejected failures on surviving shards in EVERY
+    phase, every cycle's resumed final byte-identical, exactly-once
+    finals, one takeover per cycle, and the surviving shards' p99
+    during the takeover window flat against the pre-kill baseline
+    (<= 5x + 25 ms slack — in-process noise, not a perf claim).
+    """
+    import base64
+    import threading
+
+    import numpy as np
+
+    from _chaos_common import oracle_converge_final
+    from parallel_convolution_tpu.ops import filters, oracle
+    from parallel_convolution_tpu.parallel.mesh import mesh_from_spec
+    from parallel_convolution_tpu.serving.peers import (
+        InProcessPeer, ShardClient, ShardRouter, shard_of,
+    )
+    from parallel_convolution_tpu.serving.router import (
+        InProcessReplica, route_key,
+    )
+    from parallel_convolution_tpu.serving.service import ConvolutionService
+    from parallel_convolution_tpu.utils import imageio
+
+    img = imageio.generate_test_image(32, 48, "grey", seed=args.seed)
+    b64 = base64.b64encode(np.ascontiguousarray(img).tobytes()).decode()
+
+    def factory():
+        return ConvolutionService(mesh_from_spec("1x2"),
+                                  max_delay_s=0.002, max_queue=256)
+
+    def batch_body(iters: int, rid: str) -> dict:
+        return {"image_b64": b64, "rows": 32, "cols": 48,
+                "mode": "grey", "filter": "blur3", "iters": iters,
+                "request_id": rid}
+
+    def cv_body(rid: str) -> dict:
+        return {"image_b64": b64, "rows": 32, "cols": 48,
+                "mode": "grey", "filter": "jacobi3",
+                "backend": "shifted", "quantize": False, "tol": 0.0,
+                "max_iters": 40, "check_every": 10, "request_id": rid}
+
+    # iters is a route-key field: scan it until every shard has a
+    # batch config (the traffic spreader) — plus the converge shard.
+    by_shard: dict[str, int] = {}
+    for it in range(1, 120):
+        s = shard_of(route_key(batch_body(it, "probe")), 3)
+        by_shard.setdefault(s, it)
+        if len(by_shard) == 3:
+            break
+    oracles = {it: oracle.run_serial_u8(
+        img, filters.get_filter("blur3"), it)
+        for it in by_shard.values()}
+    kill_shard = shard_of(route_key(cv_body("probe")), 3)
+    other_shards = [s for s in ("0", "1", "2") if s != kill_shard]
+    oracle_final = oracle_converge_final(factory, cv_body("oracle"))
+
+    names = ["sA", "sB", "sC"]
+    reps = [InProcessReplica(factory, name=f"sk{i}") for i in range(3)]
+    state_dir = Path(args.state_dir or tempfile.mkdtemp(
+        prefix="pctpu-shard-kill-"))
+
+    failures: list[str] = []
+    finals_per_rid: dict[str, int] = {}
+    takeovers = 0
+    p99s: list[dict] = []
+    t0 = time.time()
+
+    for cycle in range(args.shard_kill):
+        # Rotate ownership so the victim differs per cycle (the victim
+        # is whoever owns the converge body's shard this cycle).
+        rot = names[cycle % 3:] + names[:cycle % 3]
+        assign = {str(i): rot[i] for i in range(3)}
+        routers = {}
+        for nm in names:
+            routers[nm] = ShardRouter(
+                nm, reps, n_shards=3,
+                owned=[s for s, o in assign.items() if o == nm],
+                state_dir=state_dir, assignments=assign,
+                start_sync=False, start_health=False,
+                breaker_cooldown_s=0.2, wal_fsync=False)
+        for nm in names:
+            routers[nm].peers = [InProcessPeer(routers[o])
+                                 for o in names if o != nm]
+        victim = routers[assign[kill_shard]]
+        survivors = [routers[nm] for nm in names
+                     if nm != assign[kill_shard]]
+
+        phase = {"now": "before"}
+        lat: dict[str, list[float]] = {"before": [], "during": [],
+                                       "after": []}
+        lat_lock = threading.Lock()
+        stop = threading.Event()
+
+        def pound(shard: str, widx: int, routers=routers, phase=phase,
+                  lat=lat, lat_lock=lat_lock, stop=stop, cycle=cycle):
+            cl = ShardClient(list(routers.values()))
+            it = by_shard[shard]
+            j = 0
+            while not stop.is_set():
+                j += 1
+                t1 = time.perf_counter()
+                _, w = cl.request(
+                    batch_body(it, f"c{cycle}t{widx}-{j}"))
+                dt = (time.perf_counter() - t1) * 1000.0
+                if w.get("ok"):
+                    got = np.frombuffer(
+                        base64.b64decode(w["image_b64"]),
+                        np.uint8).reshape(32, 48)
+                    with lat_lock:
+                        lat[phase["now"]].append(dt)
+                        if not np.array_equal(got, oracles[it]):
+                            failures.append(
+                                f"cycle {cycle}: surviving-shard "
+                                f"byte mismatch on shard {shard}")
+                elif not w.get("retryable"):
+                    with lat_lock:
+                        failures.append(
+                            f"cycle {cycle}: non-rejected failure on "
+                            f"surviving shard {shard}: "
+                            f"{w.get('rejected')!r}")
+                else:
+                    time.sleep(0.01)
+
+        threads = [threading.Thread(target=pound, args=(s, i))
+                   for i, s in enumerate(other_shards)]
+        for th in threads:
+            th.start()
+        time.sleep(0.5)   # pre-kill baseline window (warm + measured)
+
+        client = ShardClient(list(routers.values()))
+        rid = f"sk-job{cycle}"
+        st, rows = client.converge(cv_body(rid))
+        pre = []
+        if st != 200:
+            failures.append(f"cycle {cycle}: admission failed ({st})")
+        else:
+            for row in rows:
+                pre.append(row)
+                if row.get("kind") == "final":
+                    finals_per_rid[rid] = finals_per_rid.get(rid, 0) + 1
+                if len(pre) >= 2:
+                    break   # abandon un-closed: the crash
+        phase["now"] = "during"
+        victim.hard_stop()
+        # Survivors detect the death and take over deterministically.
+        deadline = time.time() + 30.0
+        owner = None
+        while time.time() < deadline and owner is None:
+            for r in survivors:
+                r.sync_now()
+            owner = next((r for r in survivors
+                          if kill_shard in r._sub), None)
+        if owner is None:
+            failures.append(f"cycle {cycle}: no takeover within 30s")
+        else:
+            takeovers += 1
+        # In-process takeover completes in single-digit ms — hold the
+        # measurement window open so the p99 gate has samples that
+        # actually bracket it.
+        time.sleep(0.4)
+        phase["now"] = "after"
+        # Client retry: refresh the map, resume, finish byte-identical.
+        client.refresh()
+        st, rows = client.converge(cv_body(rid))
+        drained = list(rows) if st == 200 else []
+        for r in drained:
+            if r.get("kind") == "final":
+                finals_per_rid[rid] = finals_per_rid.get(rid, 0) + 1
+        final = drained[-1] if drained else {}
+        if final.get("kind") != "final":
+            failures.append(f"cycle {cycle}: retry did not finish")
+        else:
+            if final.get("router", {}).get("resume_count", 0) < 1:
+                failures.append(
+                    f"cycle {cycle}: restarted instead of resuming "
+                    f"({final.get('router')})")
+            if pre and final.get("iters", 0) <= pre[-1].get("iters", 0):
+                failures.append(
+                    f"cycle {cycle}: final iters {final.get('iters')} "
+                    f"not past pre-kill {pre[-1].get('iters')}")
+            if final.get("image_b64") != oracle_final["image_b64"]:
+                failures.append(
+                    f"cycle {cycle}: resumed final not byte-identical")
+        # Zombie: the dead owner's sub-router is fenced typed.
+        _, zrows = victim.sub(kill_shard).converge(
+            cv_body(f"z{cycle}"))
+        zfirst = next(iter(zrows), {})
+        if zfirst.get("rejected") != "stale_epoch":
+            failures.append(
+                f"cycle {cycle}: zombie not fenced "
+                f"({zfirst.get('rejected')!r})")
+        time.sleep(0.3)   # post-takeover window
+        stop.set()
+        for th in threads:
+            th.join(10.0)
+        p_before = _pct_ms(lat["before"])
+        p_during = _pct_ms(lat["during"])
+        p99s.append({"cycle": cycle, "victim": victim.name,
+                     "p99_before_ms": p_before,
+                     "p99_during_ms": p_during,
+                     "n_before": len(lat["before"]),
+                     "n_during": len(lat["during"])})
+        if not lat["during"]:
+            failures.append(
+                f"cycle {cycle}: surviving shards served NOTHING "
+                "during the takeover window")
+        elif (p_before is not None and p_during is not None
+                and p_during > 5.0 * p_before + 25.0):
+            failures.append(
+                f"cycle {cycle}: surviving-shard p99 spiked during "
+                f"takeover: {p_during:.1f}ms vs baseline "
+                f"{p_before:.1f}ms")
+        for r in routers.values():
+            try:
+                r.close(close_replicas=False)
+            except Exception:  # noqa: BLE001 — victim already dead
+                pass
+
+    for rep in reps:
+        rep.close()
+    dup = {r: n for r, n in finals_per_rid.items() if n != 1}
+    if dup:
+        failures.append(f"exactly-once final rows violated: {dup}")
+    summary = {
+        "summary": "shard-kill", "cycles": args.shard_kill,
+        "seed": args.seed,
+        "kill_shard": kill_shard,
+        "takeovers": takeovers,
+        "finals_per_request": finals_per_rid,
+        "p99_by_cycle": p99s,
+        "state_dir": str(state_dir),
+        "wall_s": round(time.time() - t0, 1),
+        "failures": len(failures),
+        "failure_detail": failures[:8],
+    }
+    if args.summary_out:
+        p = Path(args.summary_out)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(json.dumps(summary) + "\n")
+    print(json.dumps(summary), flush=True)
+    return 1 if failures else 0
+
+
+def _pct_ms(vals, q: float = 0.99):
+    if not vals:
+        return None
+    vs = sorted(vals)
+    return vs[min(len(vs) - 1, int(round(q * (len(vs) - 1))))]
+
+
 def run_autoscale_drill(args) -> int:
     """Sustained-load autoscale drill: N grow/shrink cycles (round 17).
 
@@ -1220,6 +1492,15 @@ def main() -> int:
                          "crashes mid-stream at a seeded router_kill "
                          "row, and proves the dead life is fenced "
                          "typed stale_epoch")
+    ap.add_argument("--shard-kill", type=int, default=0, metavar="N",
+                    help="sharded control-plane drill: 3 active "
+                         "routers over 3 per-shard WAL lineages, N "
+                         "kill-one cycles under continuous traffic on "
+                         "the surviving shards; gates on zero non-"
+                         "rejected failures, byte-identical resumed "
+                         "finals, exactly-once finals, one fenced "
+                         "takeover per cycle, and the surviving "
+                         "shards' p99 flat through the takeover")
     ap.add_argument("--summary-out", default=None, metavar="FILE",
                     help="also write the final summary row to FILE "
                          "(the tier-1 --elastic-smoke leg's done_file)")
@@ -1255,6 +1536,8 @@ def main() -> int:
         return run_router_kill(args)
     if args.router_restart:
         return run_router_restart(args)
+    if args.shard_kill:
+        return run_shard_kill(args)
     if args.autoscale:
         return run_autoscale_drill(args)
     if args.chaos:

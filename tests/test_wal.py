@@ -812,3 +812,220 @@ def test_wal_records_are_wire_shaped():
         rec = {"seq": i + 1, "kind": kind, **fields}
         assert parse_line(encode_record(rec).rstrip("\n")) == rec
         st.apply(rec)
+
+
+# -- round 21: sharded control plane — per-shard lineages, per-shard
+# -- fencing, multi-lineage isolation --------------------------------------
+
+def test_wal_refuses_numeric_suffix_lineage_name(tmp_path):
+    """Rotation names generations ``<name>.1``, ``<name>.2``, ... — a
+    lineage whose own name ends in ``.<digits>`` would be read as a
+    sibling's rotated generation.  The constructor refuses it."""
+    with pytest.raises(ValueError, match="collides"):
+        RouterWAL(tmp_path / "ctl.wal.2")
+    # Non-numeric suffixes (the shard naming convention) are fine.
+    RouterWAL(tmp_path / "shard-2.wal").close()
+
+
+def test_quarantine_renames_never_clobber(tmp_path):
+    """A second quarantine of the same lineage must not overwrite the
+    first one's forensic evidence (unique ``.quarantined.N`` names)."""
+    path = tmp_path / "ctl.wal"
+    for round_no in (1, 2):
+        w = RouterWAL(path, fsync=False)
+        for i in range(3):
+            w.append("ring_add", name=f"r{round_no}{i}")
+        w.close()
+        # MID-log damage (first line of several — a damaged ONLY line
+        # would be tolerated as a torn tail, not quarantined).
+        raw = bytearray(path.read_bytes())
+        raw[5] ^= 0xFF
+        path.write_bytes(bytes(raw))
+        with pytest.warns(RuntimeWarning, match="quarantined"):
+            w2 = RouterWAL(path, fsync=False)
+        assert w2.recovery_report["quarantined"] in _TYPED_CAUSES
+        w2.close()
+    quarantined = sorted(p.name for p in tmp_path.iterdir()
+                         if ".quarantined" in p.name)
+    # Two rounds of damage → at least two distinct quarantine names
+    # (never an os.replace clobber of the first round's evidence).
+    assert len(quarantined) >= 2, quarantined
+
+
+def test_multi_lineage_quarantine_isolation(tmp_path):
+    """Corrupting shard A's lineage quarantines A's files ONLY —
+    shard B, sharing the directory, replays untouched."""
+    a_path = tmp_path / "shard-a.wal"
+    b_path = tmp_path / "shard-b.wal"
+    wa = RouterWAL(a_path, shard="a", fsync=False)
+    wb = RouterWAL(b_path, shard="b", fsync=False)
+    for i in range(4):
+        wa.append("ring_add", name=f"ra{i}")
+        wb.append("ring_add", name=f"rb{i}")
+    wa.close()
+    wb.close()
+    raw = bytearray(a_path.read_bytes())
+    raw[5] ^= 0xFF   # mid-log damage in A
+    a_path.write_bytes(bytes(raw))
+    with pytest.warns(RuntimeWarning, match="quarantined"):
+        wa2 = RouterWAL(a_path, shard="a", fsync=False)
+    assert wa2.recovery_report["quarantined"] is not None
+    wa2.close()
+    wb2 = RouterWAL(b_path, shard="b", fsync=False)
+    assert wb2.recovery_report["quarantined"] is None
+    assert wb2.state.ring == {f"rb{i}" for i in range(4)}
+    wb2.close()
+    # no B file was renamed aside
+    assert not [p.name for p in tmp_path.iterdir()
+                if p.name.startswith("shard-b")
+                and ".quarantined" in p.name]
+
+
+def test_shard_stamp_and_crossed_lineage_refused(tmp_path):
+    """Every record a sharded writer appends carries its shard label;
+    replaying a lineage stamped for a DIFFERENT shard is typed
+    corruption (crossed files), never a silent splice."""
+    path = tmp_path / "shard-a.wal"
+    w = RouterWAL(path, shard="a", fsync=False)
+    rec = w.append("ring_add", name="r0")
+    assert rec["shard"] == "a"
+    w.close()
+    records, _torn = read_wal(path)
+    assert records and all(r.get("shard") == "a" for r in records)
+    # same file adopted under the WRONG shard label → quarantine
+    with pytest.warns(RuntimeWarning, match="quarantined"):
+        wrong = RouterWAL(path, shard="b", fsync=False)
+    assert wrong.recovery_report["quarantined"] == "format"
+    wrong.close()
+    # an UNSHARDED reader (legacy) adopts shard-stamped records fine —
+    # and a sharded reader adopts unstamped legacy records fine.
+    legacy_path = tmp_path / "legacy.wal"
+    lw = RouterWAL(legacy_path, fsync=False)
+    lw.append("ring_add", name="r1")
+    lw.close()
+    adopted = RouterWAL(legacy_path, shard="c", fsync=False)
+    assert adopted.recovery_report["quarantined"] is None
+    assert adopted.state.ring == {"r1"}
+    adopted.close()
+
+
+def test_per_shard_fencing_zombie_on_a_live_on_b(tmp_path):
+    """Per-SHARD, not per-process, fencing: after shard A's lineage is
+    taken over, the old owner is a zombie FOR SHARD A ONLY — the same
+    process's ownership of shard B keeps serving."""
+    img = _img()
+    rep = InProcessReplica(_factory(), name="w0")
+    ra = _wal_router([rep], tmp_path / "shard-a.wal", shard="a")
+    rb = _wal_router([rep], tmp_path / "shard-b.wal", shard="b")
+    assert ra.epoch == 1 and rb.epoch == 1
+    # takeover of A by a NEW router (same replica pool)
+    ra2 = _wal_router([rep], tmp_path / "shard-a.wal", shard="a")
+    assert ra2.epoch == 2
+    # zombie on A: typed stale_epoch, non-retryable, scoped to shard a
+    status, wire = ra.request(
+        dict(_converge_body(img), filter="blur3", request_id="za"))
+    assert status == 409 and wire["rejected"] == "stale_epoch"
+    assert wire.get("shard") == "a"
+    # ...but the SAME process's shard-B ownership still serves.
+    status, wire = rb.request(
+        dict(_converge_body(img), filter="blur3", request_id="zb"))
+    assert status == 200 and wire["ok"], wire
+    assert wire["router"]["shard"] == "b"
+    # and the replica reports both ratchets independently.
+    fences = rep.snapshot().get("fence_epochs", {})
+    assert fences.get("a") == 2 and fences.get("b") == 1
+    for r in (ra, ra2, rb):
+        r.close(close_replicas=False)
+    rep.close()
+
+
+def test_shard_router_takeover_and_fleet_quota(tmp_path):
+    """The peer layer end-to-end, in process: boot 3 single-shard
+    routers, kill one, a surviving peer performs the fenced takeover
+    of the orphaned lineage (deterministic successor), the client's
+    map refresh makes the move invisible, and tenant debt replicates
+    so fleet-wide admitted cost never exceeds one router's budget."""
+    from parallel_convolution_tpu.serving.peers import (
+        InProcessPeer, ShardClient, ShardRouter, shard_of,
+    )
+    from parallel_convolution_tpu.serving.router import route_key
+
+    img = _img()
+    reps = [InProcessReplica(_factory(), name=f"w{i}") for i in range(2)]
+    names = ["rA", "rB", "rC"]
+    assign = {"0": "rA", "1": "rB", "2": "rC"}
+    # ONE shared quota pool per router process (here: one per router,
+    # replicated via the debt log), frozen clock = no refill.
+    quotas = {nm: TenantQuotas(rate=1.0, burst=4.0,
+                               clock=lambda: 0.0) for nm in names}
+    routers = {}
+    for nm in names:
+        routers[nm] = ShardRouter(
+            nm, reps, n_shards=3,
+            owned=[s for s, o in assign.items() if o == nm],
+            state_dir=tmp_path, assignments=assign,
+            quotas=quotas[nm], pricer=WorkPricer(min_units=1e-9),
+            start_sync=False, start_health=False,
+            breaker_cooldown_s=0.2, clock=lambda: 0.0)
+    for nm in names:
+        routers[nm].peers = [InProcessPeer(routers[o])
+                             for o in names if o != nm]
+    client = ShardClient(list(routers.values()))
+
+    body = _converge_body(img, request_id="job-1", tenant="t1")
+    shard = shard_of(route_key(dict(body)), 3)
+    victim_name = assign[shard]
+    victim = routers[victim_name]
+    survivors = [routers[n] for n in names if n != victim_name]
+
+    # mid-stream kill: consume two rows, then SIGKILL-equivalent.
+    status, rows = client.converge(dict(body))
+    assert status == 200
+    consumed = [next(rows), next(rows)]
+    assert consumed[-1]["router"]["shard"] == shard
+    victim.hard_stop()
+    # survivors notice via anti-entropy and take over deterministically
+    for r in survivors:
+        for _ in range(r.suspect_after + 1):
+            r.sync_now()
+    owners = [r for r in survivors if shard in r._sub]
+    assert len(owners) == 1, [r.name for r in survivors]
+    successor = owners[0]
+    assert successor.stats["takeovers"] == 1
+    assert successor.sub(shard).epoch == victim.sub(shard).epoch + 1
+    # zombie write on the taken-over shard: typed stale_epoch
+    _zst, z_rows = victim.sub(shard).converge(
+        dict(body, request_id="zombie-1"))
+    assert next(iter(z_rows))["rejected"] == "stale_epoch"
+    # the client retry resumes byte-identically, exactly one final
+    client.refresh()
+    status, rows2 = client.converge(dict(body))
+    got = list(rows2)
+    final = got[-1]
+    assert final["kind"] == "final"
+    assert final["router"]["resume_count"] >= 1
+    assert final["router"]["shard"] == shard
+    assert final["iters"] > consumed[-1]["iters"]
+    clean = ReplicaRouter([InProcessReplica(_factory(), name="clean")],
+                          start_health=False)
+    _, orows = clean.converge(_converge_body(img, request_id="oracle"))
+    oracle_final = list(orows)[-1]
+    clean.close()
+    assert final["image_b64"] == oracle_final["image_b64"]
+    # fleet-wide quota: tenant t2 is charged on rB; after peer sync,
+    # rC's local bucket reflects the charge, so total admitted cost
+    # across the fleet never exceeds one router's budget.
+    lvl_before = quotas["rC"].bucket("t2").level()
+    quotas["rB"].take("t2", 3.0)
+    routers["rB"].debts.record("t2", 3.0)
+    for r in survivors:
+        r.sync_now()
+    lvl_after = quotas["rC"].bucket("t2").level()
+    assert lvl_after <= lvl_before - 3.0 + 1e-9
+    for r in routers.values():
+        try:
+            r.close(close_replicas=False)
+        except Exception:
+            pass
+    for rep in reps:
+        rep.close()
