@@ -265,6 +265,12 @@ def _response_parts(result) -> tuple[int, dict, dict]:
         "batch_size": result.batch_size,
         "phases": result.phases,
         "trace_id": result.trace_id,
+        # Content-addressed result cache verdict (hit|miss|off) + the
+        # input digest — every wire body carries them, so loadgen rows,
+        # the router's hit-refund settlement, and the cache_smoke gates
+        # all read the same stamp.
+        "cache": result.cache,
+        "digest": result.digest,
     }, {"image": result.image}
 
 
@@ -378,6 +384,8 @@ def _stream_row_parts(row) -> tuple[dict, dict]:
         "effective_grid": row.effective_grid,
         "plan_key": row.plan_key,
         "trace_id": row.trace_id,
+        "cache": row.cache,
+        "digest": row.digest,
     }
     tensors = {"image": row.image}
     if row.state is not None:

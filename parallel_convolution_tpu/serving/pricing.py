@@ -62,14 +62,33 @@ class WorkPricer:
         self._lock = threading.Lock()
 
     # -- the public surface ---------------------------------------------------
-    def price(self, body: dict, converge: bool = False) -> float:
+    def hit_units(self) -> float:
+        """What a content-addressed CACHE HIT costs: the floor.
+
+        A hit consumes no device time — it is a digest, a dict probe,
+        and a memcpy — so it meters at ``min_units``, the same floor a
+        malformed body prices at.  Charging hits near-zero is the
+        incentive side of the result cache (serving/cache.py): a tenant
+        whose traffic is duplicate-heavy spends almost none of its
+        device-seconds budget on the duplicate head.  The router settles
+        the difference AFTER the response comes back stamped
+        ``cache: hit`` (it cannot know at admission), refunding
+        ``charged - hit_units()`` through the journaled refund path.
+        """
+        return self.min_units
+
+    def price(self, body: dict, converge: bool = False,
+              cache_hit: bool = False) -> float:
         """Work units (predicted device-seconds) one request will cost.
 
         Never raises: a malformed body prices at the floor — admission
         pricing must not pre-empt the typed ``invalid`` rejection the
         replica owns (charging garbage the minimum keeps the quota path
-        orthogonal to validation).
+        orthogonal to validation).  ``cache_hit=True`` prices the
+        request as a served-from-cache duplicate: :meth:`hit_units`.
         """
+        if cache_hit:
+            return self.hit_units()
         try:
             ck = self._cache_key(body, converge)
             with self._lock:

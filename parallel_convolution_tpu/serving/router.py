@@ -1307,6 +1307,16 @@ class ReplicaRouter:
                    failovers=meta.get("failovers", 0))
             if status == 200 and wire.get("ok"):
                 self._bump("completed")
+                if (self.quotas is not None and self.pricer is not None
+                        and wire.get("cache") == "hit"):
+                    # The replica served this from its content-addressed
+                    # result cache: no device ran.  Settle the admission
+                    # charge down to the hit floor (pricing.hit_units) —
+                    # the router cannot know at admission time, so it
+                    # refunds the difference once the response says so.
+                    over = cost - self.pricer.hit_units()
+                    if over > 0:
+                        self._refund(tenant, over)
             elif (self.quotas is not None
                   and wire.get("rejected") in _REFUND_REJECTS):
                 # Refund the SAME charge admission took: with a pricer

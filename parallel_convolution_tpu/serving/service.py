@@ -32,6 +32,7 @@ import numpy as np
 from parallel_convolution_tpu.obs import (
     events as obs_events, metrics as obs_metrics, trace as obs_trace,
 )
+from parallel_convolution_tpu.serving import cache as cache_mod
 from parallel_convolution_tpu.serving import engine as engine_mod
 from parallel_convolution_tpu.serving.batcher import MicroBatcher
 from parallel_convolution_tpu.serving.engine import EngineKey, WarmEngine
@@ -134,6 +135,13 @@ class Response:
     plan_key: str = ""               # tuning canonical key of the served
     #                                  config — the perf_gate.py history
     #                                  key and the drift-series label
+    cache: str = "miss"              # content-addressed result cache
+    #                                  verdict: "hit" bytes came from the
+    #                                  cache (no lane, no device); "miss"
+    #                                  they were executed (and stored);
+    #                                  "off" the service runs uncached
+    digest: str = ""                 # the request's input digest (SHA-256
+    #                                  over the planar bytes; "" uncached)
 
     ok = True
 
@@ -213,6 +221,11 @@ class Snapshot:
     #                                  lossy, so durability needs the
     #                                  exact carries.  Final rows never
     #                                  carry it (nothing left to resume).
+    cache: str = "miss"              # final rows only: "hit" when the
+    #                                  converged fixed point came from the
+    #                                  result cache (the stream is then
+    #                                  one row, no device work)
+    digest: str = ""                 # the job's rhs input digest
 
     ok = True
 
@@ -264,7 +277,8 @@ class ConvolutionService:
                  max_batch: int = 8, max_delay_s: float = 0.005,
                  max_queue: int = 64, fallback: bool = True,
                  retry_policy=None, start: bool = True, plans=None,
-                 dedup_capacity: int = 256, max_progressive: int = 2):
+                 dedup_capacity: int = 256, max_progressive: int = 2,
+                 cache=None):
         from collections import OrderedDict
 
         from parallel_convolution_tpu.resilience.retry import RetryPolicy
@@ -305,6 +319,16 @@ class ConvolutionService:
         # shed typed-retryable queue_full.
         self.max_progressive = max(1, int(max_progressive))
         self._progressive_active = 0
+        # Content-addressed result cache (serving.cache), consulted in
+        # _admit AHEAD of the batcher so a hit never touches a lane, a
+        # compile, or the device.  ``cache`` is a ResultCache, True (a
+        # default in-memory tier), or None/False (off — the default:
+        # duplicate-sensitive drills construct services bare, and the
+        # serving entrypoints opt in explicitly).
+        if cache is True:
+            cache = cache_mod.ResultCache()
+        # NOT ``cache or None``: an EMPTY ResultCache is falsy (__len__).
+        self.cache = cache if cache is not None else None
         # The legacy stats dict, now a view over the obs registry: every
         # write mirrors into pctpu_service_stats{key=...} (obs.metrics),
         # so the admission-control ledger is one /metrics scrape away.
@@ -316,7 +340,7 @@ class ConvolutionService:
             "rejected_invalid": 0, "rejected_error": 0,
             "rejected_resharding": 0, "client_timeouts": 0,
             "reshapes": 0, "deduped": 0, "progressive": 0,
-            "rejected_stale_epoch": 0,
+            "rejected_stale_epoch": 0, "cache_hits": 0, "cache_misses": 0,
         })
         # Router-epoch fence (round 19): the highest epoch any router
         # has ever stamped on a request to THIS replica.  A request
@@ -521,10 +545,39 @@ class ConvolutionService:
                 return self._shed("invalid", rid, detail=str(e),
                                   counter="rejected_invalid",
                                   trace=root), root
+            digest, ckey = "", ""
+            if self.cache is not None:
+                # Content-addressed lookup AHEAD of the batcher: a hit is
+                # served right here — no lane, no queue, no device.  The
+                # key folds the input digest with the FULL compile
+                # identity, so equal keys are byte-identical answers by
+                # construction (the cache_smoke oracle gate).
+                t_lookup = time.monotonic()
+                digest = cache_mod.input_digest(planar)
+                ckey = cache_mod.result_key(digest, key)
+                got = self.cache.get(ckey)
+                if got is not None:
+                    hit = self._hit_response(
+                        req, rid, got, digest=digest, root=root,
+                        plan_source=plan_source,
+                        lookup_s=time.monotonic() - t_lookup)
+                    asp.set(outcome="cache_hit", cache="hit",
+                            digest=digest)
+                    out_slot = slot
+                    if out_slot is None:
+                        from parallel_convolution_tpu.serving.batcher \
+                            import Slot
+
+                        out_slot = Slot()
+                    out_slot.set(hit)
+                    return out_slot, root
+                asp.set(cache="miss", digest=digest)
+                self._bump("cache_misses")
             deadline_at = (time.monotonic() + req.deadline_s
                            if req.deadline_s is not None else None)
             payload = {"planar": planar, "rid": rid,
                        "rgb": req.image.ndim == 3,
+                       "digest": digest, "ckey": ckey,
                        "backend": req.backend, "plan_source": plan_source,
                        # Predicted device-seconds: the batcher's lane-
                        # priority input (cheap lanes flush first when
@@ -548,6 +601,46 @@ class ConvolutionService:
                     detail=f"queue depth >= {self.batcher.max_queue}",
                     counter="rejected_queue_full", trace=root), root
         return out_slot, root
+
+    def _hit_response(self, req: Request, rid: str, got, *, digest: str,
+                      root, plan_source: str, lookup_s: float) -> Response:
+        """Rebuild a served Response from one cache entry.  The stored
+        image layout always matches the request's (grey vs RGB changes
+        the planar shape, which changes the digest), and the stamped
+        provenance is the EXECUTING request's — the one that paid."""
+        arrays, meta = got
+        per = {"queue": 0.0, "cache": round(lookup_s, 6),
+               "total": round(lookup_s, 6)}
+        self._bump("cache_hits")
+        self._bump("completed")
+        if obs_metrics.enabled():
+            obs_metrics.counter(
+                "pctpu_admission_total",
+                "typed request outcomes at the admission boundary",
+                ("outcome",)).inc(outcome="cache_hit")
+            obs_events.emit(
+                "admission", outcome="cache_hit", request_id=rid,
+                digest=digest[:16],
+                **({"trace_id": root.trace_id} if root is not None
+                   else {}))
+        return Response(
+            # Copy: the memory tier's array is shared; an in-process
+            # caller mutating its response must not poison the cache.
+            image=np.array(arrays["image"]),
+            effective_backend=str(meta.get("effective_backend", "")),
+            backend=req.backend, request_id=rid,
+            batch_size=1, phases=per,
+            plan_source=plan_source,
+            predicted_gpx_per_chip=meta.get("predicted_gpx_per_chip"),
+            effective_grid=str(meta.get("effective_grid", "")),
+            overlap=bool(meta.get("overlap", False)),
+            col_mode=str(meta.get("col_mode", "packed")),
+            exchange_fraction=float(meta.get("exchange_fraction", 0.0)),
+            exchange_hidden_fraction=float(
+                meta.get("exchange_hidden_fraction", 0.0)),
+            trace_id=root.trace_id if root is not None else "",
+            plan_key=str(meta.get("plan_key", "")),
+            cache="hit", digest=digest)
 
     # -- execution (batcher collector + executor threads) ---------------------
     def _prepare_batch(self, lane: EngineKey, items) -> dict:
@@ -678,6 +771,24 @@ class ConvolutionService:
                        }
                 per["total"] = round(queue_s + sum(phases.values()), 6)
                 c = it.payload.get("trace")
+                if self.cache is not None and it.payload.get("ckey"):
+                    # Store the FINAL response bytes (post-crop, post-
+                    # interleave) so a later hit is byte-identical to
+                    # this miss by construction; meta carries the stamps
+                    # a hit Response needs to rebuild provenance.
+                    self.cache.put(it.payload["ckey"], {"image": image}, {
+                        "effective_backend": info["effective_backend"],
+                        "effective_grid": info.get("effective_grid", ""),
+                        "plan_key": info.get("plan_key", ""),
+                        "overlap": bool(info.get("overlap", False)),
+                        "col_mode": str(info.get("col_mode", "packed")),
+                        "exchange_fraction": info.get(
+                            "exchange_fraction", 0.0),
+                        "exchange_hidden_fraction": info.get(
+                            "exchange_hidden_fraction", 0.0),
+                        "predicted_gpx_per_chip": info.get(
+                            "predicted_gpx_per_chip"),
+                    })
                 it.slot.set(Response(
                     image=image,
                     effective_backend=info["effective_backend"],
@@ -700,6 +811,8 @@ class ConvolutionService:
                         "exchange_hidden_fraction", 0.0),
                     trace_id=c.trace_id if c is not None else "",
                     plan_key=info.get("plan_key", ""),
+                    cache="miss" if self.cache is not None else "off",
+                    digest=it.payload.get("digest", ""),
                 ))
                 self._bump("completed")
                 if obs_metrics.enabled():
@@ -794,6 +907,26 @@ class ConvolutionService:
                 asp.set(outcome="invalid")
                 return self._shed("invalid", rid, detail=str(e),
                                   counter="rejected_invalid", trace=root)
+            digest, fkey = "", ""
+            if self.cache is not None:
+                # Convergence finals are keyed on the FIXED POINT's
+                # identity — (rhs digest, tol, solver, mg_levels) plus
+                # the stencil key — never on check_every/max_iters.  A
+                # job whose final is cached short-circuits to the one
+                # final row, even a mid-stream RESUME of it (the token
+                # only says where the dead stream got to; the fixed
+                # point it was walking toward is already in hand).
+                digest = cache_mod.input_digest(planar)
+                fkey = cache_mod.converge_key(
+                    digest, tol=tol, solver=key.solver,
+                    mg_levels=key.mg_levels, engine_key=key)
+                got = self.cache.get(fkey)
+                if got is not None:
+                    asp.set(outcome="cache_hit", cache="hit",
+                            digest=digest)
+                    return self._hit_final_stream(got, rid, digest, root)
+                asp.set(cache="miss", digest=digest)
+                self._bump("cache_misses")
             with self._lock:
                 # Decide under the lock, shed OUTSIDE it: _shed bumps
                 # counters through _bump, which takes this same
@@ -813,8 +946,45 @@ class ConvolutionService:
             self._progressive_stream(req, rid, key, planar, tol,
                                      max_iters, check_every, root, release,
                                      resume=resume,
-                                     carry_state=carry_state),
+                                     carry_state=carry_state,
+                                     digest=digest, fkey=fkey),
             release)
+
+    def _hit_final_stream(self, got, rid: str, digest: str, root):
+        """A cached convergence final as a one-row stream (no device
+        work, no progressive slot — the job never starts)."""
+        arrays, meta = got
+        self._bump("cache_hits")
+        self._bump("completed")
+        if obs_metrics.enabled():
+            obs_metrics.counter(
+                "pctpu_admission_total",
+                "typed request outcomes at the admission boundary",
+                ("outcome",)).inc(outcome="cache_hit")
+            obs_events.emit(
+                "admission", outcome="cache_hit", request_id=rid,
+                digest=digest[:16], progressive=True,
+                **({"trace_id": root.trace_id} if root is not None
+                   else {}))
+        row = Snapshot(
+            image=np.array(arrays["image"]),
+            iters=int(meta.get("iters", 0)),
+            diff=float(meta.get("diff", 0.0)), final=True,
+            converged=True, request_id=rid,
+            effective_backend=str(meta.get("effective_backend", "")),
+            effective_grid=str(meta.get("effective_grid", "")),
+            plan_key=str(meta.get("plan_key", "")),
+            trace_id=root.trace_id if root is not None else "",
+            solver=str(meta.get("solver", "jacobi")),
+            work_units=float(meta.get("work_units", 0.0)),
+            mg_levels=meta.get("mg_levels"),
+            col_mode=str(meta.get("col_mode", "packed")),
+            cache="hit", digest=digest)
+
+        def gen():
+            yield row
+
+        return ReleasingStream(gen(), lambda: None)
 
     @staticmethod
     def _validate_resume(resume, key, planar, check_every, max_iters):
@@ -867,7 +1037,7 @@ class ConvolutionService:
 
     def _progressive_stream(self, req, rid, key, planar, tol, max_iters,
                             check_every, root, release, resume=None,
-                            carry_state=False):
+                            carry_state=False, digest="", fkey=""):
         """The admitted job's generator (runs on the CONSUMER's thread)."""
         from parallel_convolution_tpu.utils import imageio
 
@@ -932,8 +1102,25 @@ class ConvolutionService:
                 converged = last is not None and last[1] < tol
                 psp.set(outcome="completed",
                         iters=last[0] if last else 0, converged=converged)
+                final_u8 = to_u8(last_out)
+                if self.cache is not None and fkey and converged:
+                    # Only CONVERGED finals are cacheable: an exhausted-
+                    # budget final depends on max_iters, which is not
+                    # part of the fixed point's key.
+                    self.cache.put(fkey, {"image": final_u8}, {
+                        "iters": last[0] if last else 0,
+                        "diff": last[1] if last else 0.0,
+                        "effective_backend": entry.effective_backend,
+                        "effective_grid": grid,
+                        "plan_key": entry.plan_key,
+                        "solver": key.solver,
+                        "work_units": (round(float(last[2]), 3)
+                                       if last else 0.0),
+                        "mg_levels": entry.mg_levels,
+                        "col_mode": entry.effective_col_mode,
+                    })
                 yield Snapshot(
-                    image=to_u8(last_out), iters=last[0] if last else 0,
+                    image=final_u8, iters=last[0] if last else 0,
                     diff=last[1] if last else 0.0, final=True,
                     converged=converged, request_id=rid,
                     effective_backend=entry.effective_backend,
@@ -941,7 +1128,9 @@ class ConvolutionService:
                     trace_id=tid, solver=key.solver,
                     work_units=round(float(last[2]), 3) if last else 0.0,
                     mg_levels=entry.mg_levels,
-                    col_mode=entry.effective_col_mode)
+                    col_mode=entry.effective_col_mode,
+                    cache="miss" if self.cache is not None else "off",
+                    digest=digest)
                 self._bump("completed")
         finally:
             release()
@@ -996,6 +1185,12 @@ class ConvolutionService:
                         self.engine.grid(), getattr(dev, "platform", "cpu"),
                         getattr(dev, "device_kind", ""))
                 self._bump("reshapes")
+                if self.cache is not None:
+                    # Cached metadata stamps the OLD grid's provenance
+                    # (effective_grid, plan_key); serving it after the
+                    # swap would lie.  Every drop is journaled dead
+                    # (write-ahead), so a restart cannot resurrect them.
+                    self.cache.invalidate_all()
             finally:
                 self._reshaping = False
         return info
@@ -1153,6 +1348,8 @@ class ConvolutionService:
             "service": stats,
             "batcher": dict(self.batcher.stats),
             "engine": snap["stats"],
+            "cache": (self.cache.snapshot()
+                      if self.cache is not None else None),
             "resident": snap["resident"],
             "queue_depth": self.batcher.depth(),
             "mesh": "x".join(str(s)

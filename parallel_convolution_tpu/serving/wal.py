@@ -50,6 +50,7 @@ Record vocabulary (see DESIGN.md "Durable control plane"):
 ``resume``       one mid-stream/client-retry resume (stamp provenance)
 ``ring_add`` / ``ring_remove``   consistent-hash ring membership
 ``debt``         a tenant bucket's post-charge/refund level (+ delta)
+``cache``        a result-cache eviction/invalidation (op + entry key)
 ``snapshot``     full folded state (rotation compaction head)
 
 Round 21 generalizes the journal from epoch-per-router to
@@ -86,7 +87,7 @@ __all__ = ["RECORD_KINDS", "RouterWAL", "WALCorrupt", "WALFenced",
 
 RECORD_KINDS = frozenset({
     "epoch", "admit", "token", "final", "resume", "job_settled",
-    "ring_add", "ring_remove", "debt", "snapshot",
+    "ring_add", "ring_remove", "debt", "cache", "snapshot",
 })
 
 # Bounds on the folded state so a long-lived WAL cannot grow its
@@ -94,6 +95,7 @@ RECORD_KINDS = frozenset({
 # the ledger re-bounds to its own capacity on restore anyway).
 _JOBS_CAP = 256
 _FINALIZED_CAP = 1024
+_CACHE_DEAD_CAP = 4096
 
 
 class WALFenced(RuntimeError):
@@ -167,6 +169,11 @@ class WALState:
         self.ring: set[str] = set()
         self.ring_ever: set[str] = set()
         self.debts: dict[str, float] = {}
+        # Result-cache entry keys journaled dead (evicted/invalidated);
+        # dict-as-ordered-set, bounded like ``finalized``.  A cache
+        # rebuilt over this state refuses to serve these entries even
+        # if their disk-tier bytes survived the crash.
+        self.cache_dead: dict[str, bool] = {}
 
     # -- record folding -------------------------------------------------------
     def _job(self, lid: str, key: str) -> dict:
@@ -234,6 +241,22 @@ class WALState:
             self.ring_ever.add(rec["name"])
         elif kind == "debt":
             self.debts[str(rec["tenant"])] = float(rec["level"])
+        elif kind == "cache":
+            # ``op`` is "dead" (evict/invalidate: the entry key must
+            # never be served after recovery) or "live" (a re-store of
+            # the same key after a later miss re-executed it — lifts
+            # the tombstone so the fresh bytes are servable again).
+            op = rec.get("op", "dead")
+            ckey = str(rec["ckey"])
+            if op == "live":
+                self.cache_dead.pop(ckey, None)
+            else:
+                # Re-insert at the end: recency-ordered so the cap
+                # evicts the stalest tombstone first.
+                self.cache_dead.pop(ckey, None)
+                self.cache_dead[ckey] = True
+                while len(self.cache_dead) > _CACHE_DEAD_CAP:
+                    self.cache_dead.pop(next(iter(self.cache_dead)))
         else:
             raise ValueError(f"unknown_kind: {kind!r}")
 
@@ -246,6 +269,7 @@ class WALState:
             "ring": sorted(self.ring),
             "ring_ever": sorted(self.ring_ever),
             "debts": dict(self.debts),
+            "cache_dead": list(self.cache_dead),
         }
 
     def load_wire(self, wire: dict) -> None:
@@ -265,6 +289,8 @@ class WALState:
         self.ring_ever = {str(n) for n in wire.get("ring_ever") or ()}
         self.debts = {str(t): float(v)
                       for t, v in dict(wire.get("debts") or {}).items()}
+        self.cache_dead = {str(k): True
+                           for k in wire.get("cache_dead") or ()}
 
 
 def _generations(path: Path) -> list[Path]:

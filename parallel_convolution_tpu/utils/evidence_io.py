@@ -1,0 +1,86 @@
+"""Shared-evidence JSONL rewriting that preserves foreign lanes.
+
+Several smoke legs co-own one curve file (``evidence/scale_curve.jsonl``
+holds scale_smoke's un-laned rows AND shard_smoke's ``router_scale``
+lane; the cache smoke adds a ``cache_skew`` lane).  Each writer must
+rewrite ONLY its own rows and keep every other lane's lines byte-for-
+byte — round 21 proved this inline in two scripts with two slightly
+different copies; this module is the one shared implementation, and
+``scripts/static_check.py`` forbids any other open-for-write of a
+shared curve file so the next smoke script cannot silently clobber a
+foreign lane.
+
+Ownership is declared by ``lane``:
+
+* ``lane=None`` — the caller owns the UN-LANED rows (scale_smoke's
+  contract): lines whose JSON carries a truthy ``"lane"`` are foreign
+  and preserved.
+* ``lane="router_scale"`` — the caller owns exactly that lane: lines
+  with any OTHER lane (including none) are preserved.
+
+Unparseable lines are dropped (same tolerance both inline copies had:
+a torn line is not evidence).  The rewrite is atomic (temp +
+``os.replace``) so a crashed smoke can never leave a half-written
+curve for the next leg's gate to misread.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from pathlib import Path
+
+__all__ = ["rewrite_shared_jsonl"]
+
+
+def rewrite_shared_jsonl(path, rows, *, lane: str | None = None) -> int:
+    """Rewrite ``path`` with ``rows`` (this writer's lane), preserving
+    every foreign line.  ``rows`` that do not already carry the owned
+    ``lane`` are stamped with it.  Returns the number of foreign lines
+    preserved.
+    """
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    foreign: list[str] = []
+    if p.exists():
+        for line in p.read_text().splitlines():
+            if not line.strip():
+                continue
+            try:
+                row_lane = json.loads(line).get("lane")
+            except (ValueError, AttributeError):
+                continue
+            if (row_lane if lane is None else row_lane != lane):
+                foreign.append(line)
+    out_rows = []
+    for r in rows:
+        r = dict(r)
+        if lane is not None:
+            r.setdefault("lane", lane)
+        out_rows.append(r)
+    fd, tmp = tempfile.mkstemp(dir=str(p.parent), prefix=f".{p.name}.",
+                               suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            if lane is None:
+                # The un-laned owner leads (scale_smoke's established
+                # file shape: own rows first, foreign lanes after).
+                for r in out_rows:
+                    f.write(json.dumps(r) + "\n")
+                for line in foreign:
+                    f.write(line + "\n")
+            else:
+                # Lane owners append after the foreign lines they kept.
+                for line in foreign:
+                    f.write(line + "\n")
+                for r in out_rows:
+                    f.write(json.dumps(r) + "\n")
+        os.replace(tmp, p)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return len(foreign)

@@ -1,0 +1,419 @@
+#!/usr/bin/env python
+"""Result-cache smoke: the ``run_t1.sh --cache-smoke`` leg (round 22).
+
+Prove the content-addressed result cache (serving/cache.py) end to end
+on the CPU mesh, in five phases:
+
+1. **Byte-identity + flat device counters** — one miss executes a
+   request on device; a 100%-duplicate tail of the SAME request must
+   then be served entirely from the cache: every response stamped
+   ``cache: "hit"`` with the miss's digest, byte-identical to the
+   NumPy oracle, while the engine's ``compiles`` / ``batches`` /
+   ``images`` counters stay EXACTLY flat (a hit that touches a lane or
+   a chip is a miss with extra steps).
+2. **Convergence finals** — a converge job's final row is cached keyed
+   on the fixed point's identity (rhs digest, tol, solver, mg_levels —
+   NOT max_iters/check_every); a re-submitted job must stream exactly
+   ONE final row, stamped hit, byte-identical to the first run's.
+3. **WAL-recovery drill** — an entry's death is journaled (the new
+   ``cache`` WAL record kind) and the process "crashes" BEFORE the
+   disk bytes are unlinked — the worst crash point.  A fresh WAL
+   replay + cache rebuild over the recovered ``cache_dead`` set must
+   REFUSE the surviving bytes (re-executes, then re-caches live),
+   while a never-invalidated neighbor entry IS adopted from disk and
+   served as a hit — proving the refusal is the tombstone, not a
+   broken disk tier.
+4. **Hit-rate-vs-skew curve** — zipf(S) traffic over a pool of
+   distinct same-config images at several skews, every response
+   byte-checked against its pool member's oracle; one
+   ``lane: "cache_skew"`` row per skew plus an all-unique cache
+   on/off A/B pair land in the SHARED curve file
+   (``evidence/scale_curve.jsonl``) via the evidence_io helper.
+5. **Perf gate** — ``perf_gate.py --cache-lane`` holds: hit rate
+   rising with skew and clearing the bar at the top, hit p99
+   decisively under miss p99, the all-unique arm untaxed; and a
+   synthetic flat-hit-rate lane must DEMONSTRABLY fail the gate.
+
+The summary row lands in ``--out`` (``evidence/cache_smoke.json``,
+the supervisor leg's done_file) with ``"failures": 0`` iff every gate
+held; the lane gate report in ``evidence/cache_gate.json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import random
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import _path  # noqa: F401  (repo root + JAX_PLATFORMS re-apply)
+
+from parallel_convolution_tpu.utils.evidence_io import rewrite_shared_jsonl
+
+SCRIPTS = Path(__file__).resolve().parent
+
+
+def _pct(vals, q):
+    if not vals:
+        return None
+    vs = sorted(vals)
+    return vs[min(len(vs) - 1, int(round(q * (len(vs) - 1))))]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rows", type=int, default=40)
+    ap.add_argument("--cols", type=int, default=56)
+    ap.add_argument("--iters", type=int, default=2)
+    ap.add_argument("--filter", dest="filter_name", default="blur3")
+    ap.add_argument("--mesh", default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--dup-n", type=int, default=16,
+                    help="length of the 100%%-duplicate tail")
+    ap.add_argument("--pool", type=int, default=48,
+                    help="zipf pool size (distinct same-config images)")
+    ap.add_argument("--zipf-n", type=int, default=90,
+                    help="requests per zipf skew step")
+    ap.add_argument("--skews", default="0.3,1.1,2.0",
+                    help="comma-separated zipf S values (rising)")
+    ap.add_argument("--unique-n", type=int, default=24,
+                    help="requests per all-unique A/B arm")
+    ap.add_argument("--out", default="evidence/cache_smoke.json")
+    ap.add_argument("--curve-out", default="evidence/scale_curve.jsonl")
+    ap.add_argument("--gate-out", default="evidence/cache_gate.json")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from parallel_convolution_tpu.ops import oracle
+    from parallel_convolution_tpu.ops.filters import get_filter
+    from parallel_convolution_tpu.serving.cache import ResultCache
+    from parallel_convolution_tpu.serving.frontend import InProcessClient
+    from parallel_convolution_tpu.serving.service import ConvolutionService
+    from parallel_convolution_tpu.serving.wal import RouterWAL
+    from parallel_convolution_tpu.utils import imageio
+
+    mesh = None
+    if args.mesh:
+        from parallel_convolution_tpu.parallel.mesh import mesh_from_spec
+
+        mesh = mesh_from_spec(args.mesh)
+
+    t0 = time.time()
+    failures: list[str] = []
+    filt = get_filter(args.filter_name)
+
+    def mkimg(seed: int):
+        return imageio.generate_test_image(args.rows, args.cols, "grey",
+                                           seed=seed)
+
+    def mkbody(img, rid: str) -> dict:
+        return {
+            "image_b64": base64.b64encode(
+                np.ascontiguousarray(img).tobytes()).decode("ascii"),
+            "rows": args.rows, "cols": args.cols, "mode": "grey",
+            "filter": args.filter_name, "iters": args.iters,
+            "backend": "shifted", "storage": "f32", "fuse": 1,
+            "boundary": "zero", "request_id": rid,
+        }
+
+    def want(img) -> bytes:
+        return oracle.run_serial_u8(img, filt, args.iters,
+                                    boundary="zero").tobytes()
+
+    def mkservice(cache):
+        return ConvolutionService(mesh, max_batch=4, max_delay_s=0.002,
+                                  max_queue=64, cache=cache)
+
+    # ---- phase 1+2+3 share one WAL lineage + disk tier ---------------------
+    tmp = tempfile.TemporaryDirectory(prefix="cache_smoke_")
+    wal_path = Path(tmp.name) / "cache-shard.wal"
+    disk_dir = Path(tmp.name) / "rc"
+    wal1 = RouterWAL(wal_path, fsync=False, shard="s0")
+    cache1 = ResultCache(
+        capacity_entries=1,   # second store spills the first to disk
+        disk_dir=disk_dir, shard="s0",
+        journal=lambda op, ckey: wal1.append("cache", op=op, ckey=ckey),
+        dead=wal1.state.cache_dead)
+    svc1 = mkservice(cache1)
+    client1 = InProcessClient(svc1)
+
+    # ---- phase 1: duplicate tail -------------------------------------------
+    dup_img = mkimg(args.seed)
+    dup_want = want(dup_img)
+    status, r0 = client1.request(mkbody(dup_img, "dup0"), timeout=60)
+    digest = r0.get("digest", "")
+    if status != 200 or not r0.get("ok"):
+        failures.append(f"seed miss failed: {status} {r0.get('detail')}")
+    else:
+        if r0.get("cache") != "miss":
+            failures.append(f"seed request stamped {r0.get('cache')!r}, "
+                            "want 'miss'")
+        if len(digest) != 64:
+            failures.append(f"seed digest malformed: {digest!r}")
+        if base64.b64decode(r0.get("image_b64", "")) != dup_want:
+            failures.append("seed miss not byte-identical to oracle")
+    eng_before = dict(svc1.snapshot().get("engine") or {})
+    hit_stamps = 0
+    for i in range(args.dup_n):
+        status, r = client1.request(mkbody(dup_img, f"dup{i + 1}"),
+                                    timeout=60)
+        if status != 200 or not r.get("ok"):
+            failures.append(f"dup {i}: {status} {r.get('detail')}")
+            continue
+        if r.get("cache") == "hit":
+            hit_stamps += 1
+        if r.get("digest") != digest:
+            failures.append(f"dup {i}: digest drifted")
+        if base64.b64decode(r.get("image_b64", "")) != dup_want:
+            failures.append(f"dup {i}: hit bytes != oracle")
+    if hit_stamps != args.dup_n:
+        failures.append(f"duplicate tail: {hit_stamps}/{args.dup_n} "
+                        "hits (want all)")
+    eng_after = dict(svc1.snapshot().get("engine") or {})
+    flat = {k: (eng_before.get(k), eng_after.get(k))
+            for k in ("compiles", "batches", "images")}
+    for k, (b, a) in flat.items():
+        if b != a:
+            failures.append(f"100% duplicate tail moved engine {k}: "
+                            f"{b} -> {a} (hits touched the device)")
+
+    # Two more distinct entries: with capacity_entries=1, storing the
+    # neighbor spills the dup entry to disk, and storing the filler
+    # spills the neighbor — so BOTH drill subjects have disk-tier bytes
+    # at "crash" time.  The neighbor is the drill's post-restart
+    # positive control.
+    nb_img = mkimg(args.seed + 7001)
+    nb_want = want(nb_img)
+    status, rn = client1.request(mkbody(nb_img, "nb0"), timeout=60)
+    if status != 200 or not rn.get("ok"):
+        failures.append(f"neighbor miss failed: {status}")
+    nb_digest = rn.get("digest", "")
+    status, _rf = client1.request(mkbody(mkimg(args.seed + 7002), "fill0"),
+                                  timeout=60)
+    if status != 200:
+        failures.append(f"filler miss failed: {status}")
+
+    # ---- phase 2: convergence finals ---------------------------------------
+    def cvbody(rid: str) -> dict:
+        b = mkbody(dup_img, rid)
+        b.pop("iters")
+        b.update(tol=1.0, max_iters=400, check_every=10,
+                 quantize=False, solver="jacobi")
+        return b
+
+    status, rows = client1.converge(cvbody("cv0"), timeout=120)
+    rows = list(rows)
+    finals = [r for r in rows if r.get("kind") == "final"]
+    cv_b64 = ""
+    if status != 200 or not finals or not finals[-1].get("converged"):
+        failures.append(f"converge seed run: status {status}, "
+                        f"finals {len(finals)}")
+    else:
+        cv_b64 = finals[-1].get("image_b64", "")
+        if finals[-1].get("cache") != "miss":
+            failures.append("converge seed final stamped "
+                            f"{finals[-1].get('cache')!r}, want 'miss'")
+    status, rows2 = client1.converge(cvbody("cv1"), timeout=120)
+    rows2 = list(rows2)
+    if status != 200 or len(rows2) != 1:
+        failures.append(f"cached converge: status {status}, "
+                        f"{len(rows2)} rows (want exactly 1 final)")
+    else:
+        f2 = rows2[0]
+        if f2.get("cache") != "hit" or not f2.get("converged"):
+            failures.append(f"cached converge final: cache="
+                            f"{f2.get('cache')!r} converged="
+                            f"{f2.get('converged')!r}")
+        if f2.get("image_b64") != cv_b64:
+            failures.append("cached converge final not byte-identical "
+                            "to the first run's")
+
+    # ---- phase 3: WAL-recovery drill ---------------------------------------
+    # Journal the dup entry dead, then "crash" WITHOUT dropping its
+    # disk bytes — the worst crash point (write-ahead means the journal
+    # lands first; the bytes survive).  Recovery must refuse them.
+    dup_ckey = next((k for k in cache1.keys() if k.startswith(digest)
+                     and "-cv" not in k), None)
+    drill = {"ckey": (dup_ckey or "")[:24]}
+    if dup_ckey is None:
+        failures.append("drill: dup entry key not resident")
+    else:
+        wal1.append("cache", op="dead", ckey=dup_ckey)
+        dup_file = disk_dir / f"{dup_ckey}.rc"
+        drill["disk_bytes_survive_crash"] = dup_file.exists()
+        if not dup_file.exists():
+            failures.append("drill: dup entry has no disk-tier file to "
+                            "survive the crash (spill did not happen)")
+    svc1.close()
+    wal1.close()
+
+    wal2 = RouterWAL(wal_path, fsync=False, shard="s0")
+    drill["recovered_dead"] = len(wal2.state.cache_dead)
+    if dup_ckey is not None and dup_ckey not in wal2.state.cache_dead:
+        failures.append("drill: replay lost the cache-dead record")
+    cache2 = ResultCache(
+        capacity_entries=8, disk_dir=disk_dir, shard="s0",
+        journal=lambda op, ckey: wal2.append("cache", op=op, ckey=ckey),
+        dead=wal2.state.cache_dead)
+    if dup_ckey is not None and (disk_dir / f"{dup_ckey}.rc").exists():
+        failures.append("drill: adoption left the dead entry's bytes "
+                        "on disk")
+    if dup_ckey is not None and cache2.get(dup_ckey) is not None:
+        failures.append("drill: RESURRECTED a journaled-dead entry "
+                        "after restart")
+    svc2 = mkservice(cache2)
+    client2 = InProcessClient(svc2)
+    status, rd = client2.request(mkbody(dup_img, "drill0"), timeout=60)
+    drill["post_restart_dup"] = rd.get("cache")
+    if rd.get("cache") != "miss":
+        failures.append("drill: post-restart duplicate served "
+                        f"{rd.get('cache')!r}, want a re-executed miss")
+    if base64.b64decode(rd.get("image_b64", "")) != dup_want:
+        failures.append("drill: post-restart re-execution != oracle")
+    status, rd2 = client2.request(mkbody(dup_img, "drill1"), timeout=60)
+    drill["post_restore_dup"] = rd2.get("cache")
+    if rd2.get("cache") != "hit":
+        failures.append("drill: re-stored entry not serving hits "
+                        "(live record did not lift the tombstone)")
+    status, rnb = client2.request(mkbody(nb_img, "drill2"), timeout=60)
+    drill["neighbor_post_restart"] = rnb.get("cache")
+    if rnb.get("cache") != "hit":
+        failures.append("drill: never-invalidated neighbor not adopted "
+                        f"from disk (got {rnb.get('cache')!r})")
+    elif base64.b64decode(rnb.get("image_b64", "")) != nb_want:
+        failures.append("drill: disk-adopted neighbor bytes != oracle")
+    if rnb.get("digest") != nb_digest:
+        failures.append("drill: neighbor digest drifted across restart")
+    drill["cache"] = cache2.snapshot()
+    svc2.close()
+    wal2.close()
+
+    # ---- phase 4: hit-rate-vs-skew curve -----------------------------------
+    skews = [float(s) for s in args.skews.split(",") if s.strip()]
+    pool_imgs = [mkimg(args.seed + k) for k in range(args.pool)]
+    pool_wants = [want(im) for im in pool_imgs]
+    pool_bodies = [mkbody(im, "p") for im in pool_imgs]
+
+    def zipf_pick(i: int, s: float) -> int:
+        cum, acc = [], 0.0
+        for r in range(1, args.pool + 1):
+            acc += 1.0 / (r ** s)
+            cum.append(acc)
+        rng = random.Random((args.seed << 24) ^ (1000003 * (i + 1)))
+        return rng.choices(range(args.pool), cum_weights=cum)[0]
+
+    def drive(n: int, pick, cache) -> dict:
+        svc = mkservice(cache)
+        cl = InProcessClient(svc)
+        lats: list[tuple[float, str]] = []
+        fails = 0
+        for i in range(n):
+            j = pick(i)
+            b = dict(pool_bodies[j], request_id=f"z{i}")
+            t = time.perf_counter()
+            status, r = cl.request(b, timeout=60)
+            lat = time.perf_counter() - t
+            if status != 200 or not r.get("ok"):
+                fails += 1
+                continue
+            if base64.b64decode(r.get("image_b64", "")) != pool_wants[j]:
+                fails += 1
+                failures.append(f"curve: response {i} != pool member "
+                                f"{j}'s oracle")
+                continue
+            lats.append((lat, r.get("cache", "")))
+        svc.close()
+        hits = [l for l, c in lats if c == "hit"]
+        miss = [l for l, c in lats if c != "hit"]
+        return {
+            "n": n, "completed": len(lats), "failures": fails,
+            "cache_hit_rate": round(len(hits) / len(lats), 4) if lats
+            else 0.0,
+            "p99_ms": round(1e3 * (_pct([l for l, _ in lats], 0.99)
+                                   or 0.0), 3),
+            "hit_p99_ms": round(1e3 * (_pct(hits, 0.99) or 0.0), 3),
+            "miss_p99_ms": round(1e3 * (_pct(miss, 0.99) or 0.0), 3),
+        }
+
+    lane_rows = []
+    for s in skews:
+        m = drive(args.zipf_n, lambda i, s=s: zipf_pick(i, s),
+                  ResultCache())
+        lane_rows.append({
+            "mode": "zipf", "zipf_s": s, "pool": args.pool,
+            "workload": f"cache-skew blur3 {args.rows}x{args.cols} "
+                        f"zipf={s} pool={args.pool}", **m})
+        if m["failures"]:
+            failures.append(f"zipf s={s}: {m['failures']} failures")
+    # All-unique A/B: the 0%-hit workload must not pay for the cache.
+    uniq = min(args.unique_n, args.pool)
+    for arm, cache in (("off", None), ("on", ResultCache())):
+        m = drive(uniq, lambda i: i, cache)
+        lane_rows.append({
+            "mode": "unique", "cache": arm,
+            "workload": f"cache-unique blur3 {args.rows}x{args.cols} "
+                        f"cache={arm}", **m})
+        if m["failures"]:
+            failures.append(f"unique cache={arm}: {m['failures']} "
+                            "failures")
+        if arm == "on" and m["cache_hit_rate"]:
+            failures.append("unique cache=on arm reported hits "
+                            f"({m['cache_hit_rate']})")
+    rates = [r["cache_hit_rate"] for r in lane_rows
+             if r["mode"] == "zipf"]
+    if rates != sorted(rates):
+        failures.append(f"hit rate not monotone with skew: {rates}")
+
+    curve_path = Path(args.curve_out)
+    rewrite_shared_jsonl(curve_path, lane_rows, lane="cache_skew")
+
+    # ---- phase 5: the lane gate, and its demonstrable teeth ----------------
+    rc_gate = subprocess.run(
+        [sys.executable, str(SCRIPTS / "perf_gate.py"),
+         "--cache-lane", str(curve_path), "--out", args.gate_out,
+         "--quiet"], check=False).returncode
+    if rc_gate != 0:
+        failures.append(f"perf_gate --cache-lane exited {rc_gate}")
+    bad = [dict(r, cache_hit_rate=0.01) for r in lane_rows]
+    bad_path = Path(tmp.name) / "bad_lane.jsonl"
+    bad_path.write_text("".join(
+        json.dumps(dict(r, lane="cache_skew")) + "\n" for r in bad))
+    rc_bad = subprocess.run(
+        [sys.executable, str(SCRIPTS / "perf_gate.py"),
+         "--cache-lane", str(bad_path), "--quiet"],
+        check=False, stdout=subprocess.DEVNULL).returncode
+    if rc_bad == 0:
+        failures.append("perf_gate --cache-lane PASSED a synthetic "
+                        "flat-hit-rate lane (the gate has no teeth)")
+
+    wall = time.time() - t0
+    row = {
+        "workload": f"cache-smoke blur3 {args.rows}x{args.cols} "
+                    f"dup-tail+converge+wal-drill+zipf-curve",
+        "dup_n": args.dup_n, "dup_hits": hit_stamps,
+        "engine_flat": {k: v[1] for k, v in flat.items()},
+        "wal_drill": drill,
+        "skew_hit_rates": dict(zip((str(s) for s in skews), rates)),
+        "lane_rows": len(lane_rows),
+        "effective_backend": "shifted",
+        "mesh": args.mesh,
+        "wall_s": round(wall, 3),
+        "failures": len(failures),
+        "failure_detail": failures[:12],
+    }
+    out = Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(row, indent=2))
+    print(json.dumps(row), flush=True)
+    tmp.cleanup()
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -436,6 +436,21 @@ def main() -> int:
                          "full 1920x2520 frames — the mixed-size "
                          "workload the shape-bucketed batcher lanes "
                          "exist for")
+    ap.add_argument("--zipf", type=float, default=None, metavar="S",
+                    help="duplicate-heavy traffic: draw each request's "
+                         "image from a --pool of distinct seeded images "
+                         "with Zipf(S)-ranked probabilities (S=0 is "
+                         "uniform-unique-ish, S>=1.1 is the classic "
+                         "duplicate-heavy head) — deterministic per "
+                         "(seed, index), so a rerun offers the same "
+                         "stream; the summary row reports the served "
+                         "cache hit rate")
+    ap.add_argument("--pool", type=int, default=16,
+                    help="distinct images in the --zipf pool")
+    ap.add_argument("--cache", action="store_true",
+                    help="enable the content-addressed result cache on "
+                         "the in-process service (no-op with --url: the "
+                         "server's own --cache flag decides)")
     ap.add_argument("--deadline-ms", type=float, default=None,
                     help="per-request latency budget (missed -> typed shed)")
     ap.add_argument("--tenant", default=None,
@@ -512,6 +527,39 @@ def main() -> int:
                               image_b64=base64.b64encode(
                                   np.ascontiguousarray(big_img).tobytes()
                               ).decode("ascii")), big_img))
+    if args.zipf is not None and args.mixed_sizes:
+        ap.error("--zipf and --mixed-sizes are exclusive (the zipf pool "
+                 "is same-shape by design: it isolates content "
+                 "duplication from lane mixing)")
+    if args.zipf is not None:
+        # The duplicate-heavy head: a pool of DISTINCT same-config
+        # images, request i drawing pool rank r with probability
+        # ∝ 1/r^S — real traffic's shape, and the result cache's
+        # reason to exist.  Selection is deterministic per (seed, i):
+        # a rerun offers byte-identical traffic.
+        import random
+
+        for k in range(1, max(1, args.pool)):
+            pimg = imageio.generate_test_image(
+                args.rows, args.cols, args.mode, seed=args.seed + k)
+            profiles.append((dict(body, image_b64=base64.b64encode(
+                np.ascontiguousarray(pimg).tobytes()).decode("ascii")),
+                pimg))
+        _zw = [1.0 / (r ** args.zipf)
+               for r in range(1, len(profiles) + 1)]
+        _zcum = []
+        _acc = 0.0
+        for w in _zw:
+            _acc += w
+            _zcum.append(_acc)
+
+        def pick(i: int) -> int:
+            rng = random.Random((args.seed << 24) ^ (1000003 * (i + 1)))
+            return rng.choices(range(len(profiles)),
+                               cum_weights=_zcum)[0]
+    else:
+        def pick(i: int) -> int:
+            return i % len(profiles)
     # Binary-wire profiles: header/frames split once, request_id
     # restamped per request around the SAME frame bytes.
     fprofiles = ([_frames_profile(b, im) for b, im in profiles]
@@ -536,9 +584,15 @@ def main() -> int:
             from parallel_convolution_tpu.parallel.mesh import mesh_from_spec
 
             mesh = mesh_from_spec(args.mesh)
+        cache = None
+        if args.cache:
+            from parallel_convolution_tpu.serving.cache import ResultCache
+
+            cache = ResultCache()
         service = ConvolutionService(
             mesh, max_batch=args.max_batch,
-            max_delay_s=args.max_delay_ms / 1e3, max_queue=args.max_queue)
+            max_delay_s=args.max_delay_ms / 1e3, max_queue=args.max_queue,
+            cache=cache)
         client = InProcessClient(service)
         if args.converge is not None:
             def _converge_inproc(b):
@@ -587,7 +641,7 @@ def main() -> int:
                          "boundary": args.boundary}
                         for b, _ in profiles])
 
-    want = None
+    wants = None
     if args.check and args.converge is not None:
         ap.error("--check byte-compares the fixed-count oracle; it does "
                  "not apply to --converge jobs")
@@ -598,8 +652,13 @@ def main() -> int:
         from parallel_convolution_tpu.ops import oracle
         from parallel_convolution_tpu.ops.filters import get_filter
 
-        want = oracle.run_serial_u8(img, get_filter(args.filter_name),
-                                    args.iters, boundary=args.boundary)
+        # One oracle per profile image: a --zipf run byte-checks every
+        # pool member, so a cache HIT serving stale/wrong bytes can
+        # never pass (the hit-vs-miss byte-identity gate).
+        filt = get_filter(args.filter_name)
+        wants = [oracle.run_serial_u8(im, filt, args.iters,
+                                      boundary=args.boundary).tobytes()
+                 for _, im in profiles]
 
     results = []                      # (index, latency_s, status, resp)
     results_lock = threading.Lock()
@@ -611,7 +670,7 @@ def main() -> int:
         # retry that races a late completion dedups at the replica).
         # --wire mixed alternates codec arms on a stride DECOUPLED from
         # the profile stride, so each size sees both codecs.
-        pbody, _ = profiles[i % len(profiles)]
+        pbody, _ = profiles[pick(i)]
         framed = (args.wire == "frames"
                   or (args.wire == "mixed"
                       and (i // len(profiles)) % 2 == 1))
@@ -620,7 +679,7 @@ def main() -> int:
                 frames as frames_mod,
             )
 
-            fheader, fraw = fprofiles[i % len(profiles)]
+            fheader, fraw = fprofiles[pick(i)]
             request = ftransports[i % len(ftransports)]
             b = frames_mod.join_envelope(
                 {**fheader, "request_id": f"lg{i}"}, fraw)
@@ -733,6 +792,10 @@ def main() -> int:
                         batch_size=r.get("batch_size"),
                         plan_source=r.get("plan_source", ""),
                         phases=r.get("phases", {}),
+                        # The result-cache stamp every served body
+                        # carries (hit|miss|off + input digest).
+                        cache=r.get("cache", ""),
+                        digest=(r.get("digest") or "")[:16],
                     )
                 else:
                     line.update(rejected=r.get("rejected"),
@@ -761,15 +824,14 @@ def main() -> int:
     ok_rows = [(i, r) for i, _, _, s, r in results
                if s == 200 and r.get("ok")]
     mismatches = 0
-    if want is not None:
-        raw = want.tobytes()
-        for _, r in completed:
-            if base64.b64decode(r["image_b64"]) != raw:
+    if wants is not None:
+        for i, r in ok_rows:
+            if base64.b64decode(r["image_b64"]) != wants[pick(i)]:
                 mismatches += 1
     bad_bytes = sum(
         1 for i, r in ok_rows
         if len(base64.b64decode(r["image_b64"]))
-        != area_of[i % len(profiles)] * channels)
+        != area_of[pick(i)] * channels)
     non_rejected_failures = len(failures) + mismatches + bad_bytes
 
     lats = sorted(lat for lat, _ in completed)
@@ -778,11 +840,11 @@ def main() -> int:
         # fine-grid work units each final row stamps (iterations for
         # jacobi, the pixel-weighted per-level sum for multigrid).
         px = int(channels * sum(
-            area_of[i % len(profiles)] * r.get("work_units", 0.0)
+            area_of[pick(i)] * r.get("work_units", 0.0)
             for i, r in ok_rows))
     else:
         px = channels * args.iters * sum(
-            area_of[i % len(profiles)] for i, _ in ok_rows)
+            area_of[pick(i)] for i, _ in ok_rows)
     phase_names = ("queue", "compile", "device", "copy_in", "copy_out")
     phases_ms = {
         p: round(1e3 * statistics.mean(
@@ -826,7 +888,9 @@ def main() -> int:
                      + f"x{channels} "
                      + (f"converge tol={args.converge}"
                         if args.converge is not None
-                        else f"{args.iters} iters")),
+                        else f"{args.iters} iters")
+                     + (f" zipf={args.zipf}" if args.zipf is not None
+                        else "")),
         "wire": args.wire,
         **({"wires_seen": wires_seen} if wires_seen else {}),
         "loop": ("open-poisson" if args.rps
@@ -874,6 +938,20 @@ def main() -> int:
                        if batch_sizes else None),
         "batch_max": max(batch_sizes, default=None),
     }
+    # Result-cache accounting (every served body stamps cache: hit|miss
+    # when the server runs cached; the hit-rate-vs-skew curve and the
+    # perf_gate cache lane read these).
+    cache_stamps = {r.get("cache", "") for _, r in completed} - {"", "off"}
+    if cache_stamps or args.zipf is not None:
+        hits = sum(1 for _, r in completed if r.get("cache") == "hit")
+        row["cache_hits"] = hits
+        row["cache_misses"] = sum(1 for _, r in completed
+                                  if r.get("cache") == "miss")
+        row["cache_hit_rate"] = (round(hits / len(completed), 4)
+                                 if completed else None)
+    if args.zipf is not None:
+        row["zipf_s"] = args.zipf
+        row["pool"] = len(profiles)
     if args.converge is not None:
         # Solver-shaped convergence accounting (r15), stamped from the
         # final rows the SERVER streamed (post-resolution — mg_levels is
@@ -906,7 +984,7 @@ def main() -> int:
         row["resumes_observed"] = sum(
             1 for _, r in completed
             if r.get("router", {}).get("resume_count", 0) > 0)
-    if want is not None:
+    if wants is not None:
         row["oracle_mismatches"] = mismatches
     try:
         snap = transport_snapshot()

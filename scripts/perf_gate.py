@@ -362,6 +362,107 @@ def router_scale_flags(rows: list[dict], *, min_ratio: float,
     return out
 
 
+def cache_lane_flags(rows: list[dict], *, min_top_hit_rate: float,
+                     hit_p99_ratio: float,
+                     unique_p99_mult: float) -> list[dict]:
+    """Gate the result-cache lane: the ``lane: "cache_skew"`` rows
+    ``scripts/cache_smoke.py`` writes into the shared curve file.  The
+    lane holds one row per zipf skew S (``mode: "zipf"``) plus an
+    all-unique A/B pair (``mode: "unique"``, ``cache: "on" | "off"``).
+    Holds:
+
+    * the lane exists, with >= 2 distinct skews (a one-point "curve"
+      proves nothing) and the unique on/off pair — missing evidence is
+      a flag, never a pass;
+    * no row carries non-rejected failures;
+    * hit rate RISES with skew and the top-skew row clears
+      ``min_top_hit_rate`` — the cache must actually absorb the
+      duplicate-heavy head;
+    * on the top-skew row, hit p99 <= ``hit_p99_ratio`` x miss p99 —
+      served-from-cache must be decisively faster than touching the
+      device (the "p99 drops on the zipf lane" gate, measured where
+      the effect lives instead of through the mix's miss-dominated
+      tail);
+    * all-unique p99 with the cache ON <= ``unique_p99_mult`` x OFF —
+      digest+lookup overhead must not tax the 0%-hit workload.
+    """
+    out = []
+    lane = [r for r in rows if r.get("lane") == "cache_skew"]
+    if not lane:
+        return [{"check": "cache_lane", "why": "no cache_skew rows"}]
+    for r in lane:
+        if r.get("failures"):
+            out.append({"check": "cache_failures",
+                        "mode": r.get("mode"), "zipf_s": r.get("zipf_s"),
+                        "why": f"{r['failures']} non-rejected failures "
+                               "in the cache lane"})
+    zipf = sorted((r for r in lane if r.get("mode") == "zipf"),
+                  key=lambda r: float(r.get("zipf_s") or 0.0))
+    uniq = {str(r.get("cache")): r for r in lane
+            if r.get("mode") == "unique"}
+    if len({r.get("zipf_s") for r in zipf}) < 2:
+        out.append({"check": "cache_curve",
+                    "why": f"need >= 2 zipf skews, have "
+                           f"{[r.get('zipf_s') for r in zipf]}"})
+    if zipf:
+        try:
+            rates = [float(r["cache_hit_rate"]) for r in zipf]
+        except (KeyError, TypeError, ValueError):
+            out.append({"check": "cache_curve",
+                        "why": "zipf rows missing cache_hit_rate"})
+            rates = []
+        if rates:
+            if rates[-1] < min_top_hit_rate:
+                out.append({"check": "cache_hit_rate",
+                            "zipf_s": zipf[-1].get("zipf_s"),
+                            "hit_rate": rates[-1],
+                            "required": min_top_hit_rate,
+                            "why": "top-skew hit rate below the bar"})
+            if len(rates) >= 2 and rates[-1] <= rates[0]:
+                out.append({"check": "cache_skew_monotone",
+                            "rates": rates,
+                            "why": "hit rate did not rise with skew"})
+        top = zipf[-1]
+        try:
+            hp = float(top["hit_p99_ms"])
+            mp = float(top["miss_p99_ms"])
+        except (KeyError, TypeError, ValueError):
+            out.append({"check": "cache_hit_p99",
+                        "why": "top-skew row missing hit/miss p99"})
+        else:
+            if mp and hp > hit_p99_ratio * mp:
+                out.append({"check": "cache_hit_p99",
+                            "hit_p99_ms": hp, "miss_p99_ms": mp,
+                            "ratio": hit_p99_ratio,
+                            "why": "cache hits not decisively faster "
+                                   "than device misses at p99"})
+    on, off = uniq.get("on"), uniq.get("off")
+    if on is None or off is None:
+        out.append({"check": "cache_unique",
+                    "why": f"need unique cache on+off rows, have "
+                           f"{sorted(uniq)}"})
+        return out
+    try:
+        p_on, p_off = float(on["p99_ms"]), float(off["p99_ms"])
+    except (KeyError, TypeError, ValueError):
+        out.append({"check": "cache_unique",
+                    "why": "unique rows missing p99_ms"})
+        return out
+    if p_off and p_on > unique_p99_mult * p_off:
+        out.append({"check": "cache_unique_p99",
+                    "p99_on_ms": p_on, "p99_off_ms": p_off,
+                    "mult": unique_p99_mult,
+                    "why": "all-unique p99 regressed with the cache "
+                           "enabled (lookup overhead tax)"})
+    hr = on.get("cache_hit_rate")
+    if hr:
+        out.append({"check": "cache_unique_hits", "hit_rate": hr,
+                    "why": "all-unique run reported cache hits — the "
+                           "digest is colliding or the workload is "
+                           "not unique"})
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--history", default=None,
@@ -414,14 +515,33 @@ def main() -> int:
     ap.add_argument("--scale-p99-mult", type=float, default=1.5,
                     help="3-router p99 must stay within this multiple "
                          "of the 1-router p99")
+    ap.add_argument("--cache-lane", default=None, metavar="JSONL",
+                    help="curve evidence holding the result-cache "
+                         "lane: \"cache_skew\" rows "
+                         "(evidence/scale_curve.jsonl from scripts/"
+                         "cache_smoke.py): hit rate must rise with "
+                         "skew and clear --cache-min-hit-rate at the "
+                         "top, hit p99 must beat miss p99 by "
+                         "--cache-hit-p99-ratio, and the all-unique "
+                         "cache-on arm must stay within "
+                         "--cache-unique-p99-mult of cache-off")
+    ap.add_argument("--cache-min-hit-rate", type=float, default=0.5,
+                    help="required hit rate on the most-skewed zipf "
+                         "row")
+    ap.add_argument("--cache-hit-p99-ratio", type=float, default=0.5,
+                    help="hit p99 must be <= this fraction of miss "
+                         "p99 on the top-skew row")
+    ap.add_argument("--cache-unique-p99-mult", type=float, default=1.5,
+                    help="all-unique p99 with cache on must stay "
+                         "within this multiple of cache off")
     ap.add_argument("--out", default=None,
                     help="also write the JSON report to this path")
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args()
     if (not args.row and not args.drift_metrics and not args.wire_ab
-            and not args.router_scale):
-        print("need --row, --drift-metrics, --wire-ab, and/or "
-              "--router-scale", file=sys.stderr)
+            and not args.router_scale and not args.cache_lane):
+        print("need --row, --drift-metrics, --wire-ab, "
+              "--router-scale, and/or --cache-lane", file=sys.stderr)
         return 2
     if args.row and not args.history:
         print("--row needs --history", file=sys.stderr)
@@ -474,6 +594,19 @@ def main() -> int:
                                     min_ratio=args.scale_min_ratio,
                                     p99_mult=args.scale_p99_mult)
 
+    cflags = []
+    if args.cache_lane:
+        try:
+            crows = load_rows([args.cache_lane])
+        except (OSError, ValueError) as e:
+            print(f"perf_gate: unreadable cache-lane file: {e}",
+                  file=sys.stderr)
+            return 2
+        cflags = cache_lane_flags(
+            crows, min_top_hit_rate=args.cache_min_hit_rate,
+            hit_p99_ratio=args.cache_hit_p99_ratio,
+            unique_p99_mult=args.cache_unique_p99_mult)
+
     regressions = [v for v in verdicts if v["status"] == "regression"]
     if args.update and hist_path:
         # Append-only, one line per gated row — regressions too: a real
@@ -505,6 +638,7 @@ def main() -> int:
         "drift_flags": flags,
         "wire_ab_flags": wflags,
         "router_scale_flags": sflags,
+        "cache_lane_flags": cflags,
         "updated": bool(args.update),
     }
     if not args.quiet:
@@ -523,13 +657,16 @@ def main() -> int:
             print(f"wire_ab    {fl['check']}: {fl['why']}")
         for fl in sflags:
             print(f"router_scale {fl['check']}: {fl['why']}")
+        for fl in cflags:
+            print(f"cache_lane {fl['check']}: {fl['why']}")
     if args.out:
         p = Path(args.out)
         p.parent.mkdir(parents=True, exist_ok=True)
         p.write_text(json.dumps(report, indent=2))
     else:
         print(json.dumps(report))
-    return 1 if regressions or flags or wflags or sflags else 0
+    return 1 if (regressions or flags or wflags or sflags
+                 or cflags) else 0
 
 
 if __name__ == "__main__":

@@ -155,13 +155,51 @@
 #                                      supervisor leg's done_file); the
 #                                      lane gate report in
 #                                      evidence/shard_gate.json.
+#   scripts/run_t1.sh --cache-smoke    content-addressed result cache
+#                                      (round 22): a 100%-duplicate tail
+#                                      must be served entirely from the
+#                                      cache (every response stamped
+#                                      cache: hit + digest, byte-identical
+#                                      to the oracle, engine compile/
+#                                      batch/image counters EXACTLY flat);
+#                                      a converge job's final re-streams
+#                                      as one cached hit row; a WAL drill
+#                                      journals an entry dead, "crashes"
+#                                      before the disk bytes drop, and
+#                                      the recovered cache must refuse
+#                                      them (never-resurrect) while a
+#                                      live neighbor IS adopted from
+#                                      disk; zipf(S) traffic at several
+#                                      skews + an all-unique on/off A/B
+#                                      land as lane: cache_skew rows in
+#                                      evidence/scale_curve.jsonl and
+#                                      must clear perf_gate --cache-lane
+#                                      (hit rate rising with skew, hit
+#                                      p99 decisively under miss p99,
+#                                      the unique arm untaxed) — and a
+#                                      synthetic flat-hit-rate lane must
+#                                      FAIL it.  Row (failures: 0) lands
+#                                      in evidence/cache_smoke.json (the
+#                                      supervisor leg's done_file); the
+#                                      lane gate report in
+#                                      evidence/cache_gate.json.
 #   scripts/run_t1.sh --static         fast static gate (no jax): every
 #                                      .py byte-compiles, no bare
-#                                      'except:', and every mutation of a
+#                                      'except:', every mutation of a
 #                                      shared stats dict under serving/
-#                                      sits inside a lock-holding 'with'.
+#                                      sits inside a lock-holding 'with',
+#                                      and shared evidence curves are
+#                                      written only through evidence_io.
 #                                      Row (failures: 0) lands in
 #                                      evidence/static_check.json.
+#   scripts/run_t1.sh --list-legs      print the supervisor leg registry
+#                                      (scripts/t1_legs.json) one leg per
+#                                      line: name, command, done_file and
+#                                      done_pattern.  The registry's
+#                                      schema (every leg runs an existing
+#                                      script, evidence outputs unique,
+#                                      done_pattern iff done_file) is
+#                                      enforced by tests/test_t1_legs.py.
 #   scripts/run_t1.sh --serving-smoke  boot the in-process serving stack on
 #                                      the 8-virtual-device CPU mesh, push
 #                                      50 loadgen requests, exit nonzero on
@@ -329,9 +367,27 @@ if [ "${1:-}" = "--shard-smoke" ]; then
       --mesh 1x2 --out evidence/shard_smoke.json
 fi
 
+if [ "${1:-}" = "--cache-smoke" ]; then
+  exec timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    PCTPU_OBS=1 \
+    python scripts/cache_smoke.py --mesh 1x2 \
+      --out evidence/cache_smoke.json
+fi
+
 if [ "${1:-}" = "--static" ]; then
   exec timeout -k 10 120 \
     python scripts/static_check.py --out evidence/static_check.json
+fi
+
+if [ "${1:-}" = "--list-legs" ]; then
+  exec python - scripts/t1_legs.json <<'PYEOF'
+import json, sys
+for leg in json.load(open(sys.argv[1])):
+    done = (f"{leg['done_file']} ~ {leg['done_pattern']}"
+            if leg.get("done_file") else "-")
+    print(f"{leg['name']:16s} {' '.join(leg['cmd']):44s} {done}")
+PYEOF
 fi
 
 if [ "${1:-}" = "--chaos-smoke" ]; then

@@ -47,6 +47,8 @@ from pathlib import Path
 import _path  # noqa: F401  (repo root + JAX_PLATFORMS re-apply)
 from loadgen import poisson_arrivals  # the ONE open-loop arrival loop
 
+from parallel_convolution_tpu.utils.evidence_io import rewrite_shared_jsonl
+
 SCRIPTS = Path(__file__).resolve().parent
 
 
@@ -470,23 +472,12 @@ def main() -> int:
 
     # ---- evidence: the committed curve + the smoke's own perf gate.
     # The curve file is SHARED: rows carrying a "lane" field belong to
-    # other smokes (round 21's router_scale lane from shard_smoke.py)
-    # and must survive our rewrite — we own only the un-laned rows.
+    # other smokes (shard_smoke's router_scale lane, cache_smoke's
+    # cache_skew lane) and must survive our rewrite — we own only the
+    # un-laned rows.  evidence_io is the ONE sanctioned writer
+    # (static_check forbids direct open-for-write of shared curves).
     curve_path = Path(args.curve_out)
-    curve_path.parent.mkdir(parents=True, exist_ok=True)
-    foreign: list[str] = []
-    if curve_path.exists():
-        for line in curve_path.read_text().splitlines():
-            try:
-                if line.strip() and json.loads(line).get("lane"):
-                    foreign.append(line)
-            except ValueError:
-                continue
-    with open(curve_path, "w") as f:
-        for r in curve_rows:
-            f.write(json.dumps(r) + "\n")
-        for line in foreign:
-            f.write(line + "\n")
+    rewrite_shared_jsonl(curve_path, curve_rows, lane=None)
     out = Path(args.out)
     out.parent.mkdir(parents=True, exist_ok=True)
     out.write_text(json.dumps(row, indent=2))

@@ -60,6 +60,13 @@ def main() -> int:
                     '(repeatable), e.g. \'{"rows": 512, "cols": 512, '
                     '"mode": "rgb", "filter": "blur3", "iters": 10, '
                     '"backend": "pallas_sep"}\'')
+    ap.add_argument("--cache", action="store_true",
+                    help="enable the content-addressed result cache "
+                         "(serving/cache.py): byte-identical duplicates "
+                         "are served without touching the device")
+    ap.add_argument("--cache-dir", default=None, metavar="DIR",
+                    help="disk spill tier for the result cache "
+                         "(implies --cache)")
     args = ap.parse_args()
 
     if args.platform:
@@ -83,10 +90,15 @@ def main() -> int:
 
         mesh = mesh_from_spec(args.mesh)
 
+    cache = None
+    if args.cache or args.cache_dir:
+        from parallel_convolution_tpu.serving.cache import ResultCache
+
+        cache = ResultCache(disk_dir=args.cache_dir)
     service = ConvolutionService(
         mesh, capacity=args.capacity, max_batch=args.max_batch,
         max_delay_s=args.max_delay_ms / 1e3, max_queue=args.max_queue,
-        fallback=not args.no_fallback, plans=args.plans)
+        fallback=not args.no_fallback, plans=args.plans, cache=cache)
     warm_cfgs = [json.loads(w) for w in args.warm]
     if warm_cfgs:
         # The engine's plan cache was already armed by the constructor

@@ -55,6 +55,8 @@ from pathlib import Path
 
 import _path  # noqa: F401  (repo root + JAX_PLATFORMS re-apply)
 
+from parallel_convolution_tpu.utils.evidence_io import rewrite_shared_jsonl
+
 SCRIPTS = Path(__file__).resolve().parent
 
 
@@ -501,22 +503,10 @@ def main() -> int:
             rep.close()
 
     # ---- evidence: the shared curve file (we own ONLY our lane) -----------
+    # evidence_io preserves every foreign line (static_check forbids any
+    # other open-for-write of shared curve files).
     curve_path = Path(args.curve_out)
-    curve_path.parent.mkdir(parents=True, exist_ok=True)
-    kept: list[str] = []
-    if curve_path.exists():
-        for line in curve_path.read_text().splitlines():
-            try:
-                if (line.strip() and json.loads(line).get("lane")
-                        != "router_scale"):
-                    kept.append(line)
-            except ValueError:
-                continue
-    with open(curve_path, "w") as f:
-        for line in kept:
-            f.write(line + "\n")
-        for r in lane_rows:
-            f.write(json.dumps(r) + "\n")
+    rewrite_shared_jsonl(curve_path, lane_rows, lane="router_scale")
 
     # The scale-lane gate: 3-router RPS >= 2.4x the 1-router knee, p99
     # in band, zero lane failures — perf_gate owns the thresholds.

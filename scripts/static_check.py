@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Fast static gate: the ``run_t1.sh --static`` leg (round 19).
 
-Three checks, all stdlib, no jax import, a few seconds total:
+Four checks, all stdlib, no jax import, a few seconds total:
 
 1. **compileall** — every ``.py`` under ``parallel_convolution_tpu/``,
    ``scripts/``, and ``tests/`` byte-compiles (``py_compile`` to a
@@ -18,8 +18,18 @@ Three checks, all stdlib, no jax import, a few seconds total:
    names a lock (``_lock`` / ``_cv`` / ``lock``), or carry an explicit
    ``# stats-lock: held`` pragma naming where the lock is taken.
    AST-based (string matching can't see block structure).
+4. **no direct writes to shared evidence curves** — shared curve files
+   (``evidence/scale_curve.jsonl``) hold rows owned by SEVERAL smoke
+   legs; the only sanctioned writer is
+   ``parallel_convolution_tpu.utils.evidence_io.rewrite_shared_jsonl``
+   (it preserves foreign lanes atomically).  Any write-mode ``open()``,
+   ``Path.open()``, ``write_text``/``write_bytes`` whose target
+   expression names a shared curve file or a ``curve``-named handle —
+   outside the helper module itself — fails the leg.  The convention
+   this enforces: shared-curve handles are named ``curve_*``, and
+   nothing but evidence_io writes through them.
 
-Exit 0 and ``{"failures": 0}`` in ``--out`` iff all three hold.
+Exit 0 and ``{"failures": 0}`` in ``--out`` iff all four hold.
 """
 
 from __future__ import annotations
@@ -147,6 +157,73 @@ def check_stats_locking(files) -> list[str]:
     return problems
 
 
+# Shared evidence curves: multiple smoke legs co-own rows in these
+# files, so only evidence_io's lane-preserving rewrite may write them.
+_SHARED_CURVES = ("scale_curve.jsonl",)
+_CURVE_NAME = re.compile(r"\bcurve", re.IGNORECASE)
+_EVIDENCE_IO = "evidence_io.py"
+
+
+def _write_mode(call: ast.Call, pos: int) -> str:
+    """The mode string of an open()-style call, '' if not a literal."""
+    if len(call.args) > pos and isinstance(call.args[pos], ast.Constant):
+        v = call.args[pos].value
+        return v if isinstance(v, str) else ""
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            v = kw.value.value
+            return v if isinstance(v, str) else ""
+    return "r" if len(call.args) <= pos else ""
+
+
+def check_shared_curve_writes(files) -> list[str]:
+    """No write-mode open / write_text on a shared-curve target outside
+    evidence_io (the one lane-preserving writer)."""
+    problems = []
+    for f in files:
+        if f.name == _EVIDENCE_IO:
+            continue
+        src = f.read_text(encoding="utf-8")
+        # Prefilter: a curve-named handle OR a shared-curve basename
+        # anywhere in the file ("scale_curve" has no \b before "curve").
+        if not (_CURVE_NAME.search(src)
+                or any(b in src for b in _SHARED_CURVES)):
+            continue
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue  # check 1 reports it
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            target = mode = None
+            if (isinstance(fn, ast.Name) and fn.id == "open"
+                    and node.args):
+                target = ast.get_source_segment(src, node.args[0]) or ""
+                mode = _write_mode(node, 1)
+            elif isinstance(fn, ast.Attribute) and fn.attr == "open":
+                target = ast.get_source_segment(src, fn.value) or ""
+                mode = _write_mode(node, 0)
+            elif (isinstance(fn, ast.Attribute)
+                  and fn.attr in ("write_text", "write_bytes")):
+                target = ast.get_source_segment(src, fn.value) or ""
+                mode = "w"
+            if not target or not mode:
+                continue
+            if not any(c in mode for c in "wax+"):
+                continue
+            if (any(b in target for b in _SHARED_CURVES)
+                    or _CURVE_NAME.search(target)):
+                problems.append(
+                    f"{_rel(f)}:{node.lineno}: direct write to a shared "
+                    f"evidence curve target ({target[:60]!r}) — use "
+                    "parallel_convolution_tpu.utils.evidence_io."
+                    "rewrite_shared_jsonl, the one lane-preserving "
+                    "writer")
+    return problems
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--out", default="evidence/static_check.json")
@@ -158,9 +235,11 @@ def main() -> int:
     failures += check_compiles(files)
     failures += check_bare_except(files)
     failures += check_stats_locking(files)
+    failures += check_shared_curve_writes(files)
 
     row = {
-        "workload": "static-check compileall+bare-except+stats-lock",
+        "workload": "static-check compileall+bare-except+stats-lock"
+                    "+shared-curve-writes",
         "files_checked": len(files),
         "wall_s": round(time.time() - t0, 3),
         "failures": len(failures),

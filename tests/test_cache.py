@@ -1,0 +1,382 @@
+"""Content-addressed result cache (round 22): keys, tiers, WAL journal.
+
+The ISSUE 18 acceptance properties on the 8-virtual-device CPU mesh:
+
+* key contract — result keys fold the full compile identity (iters
+  changes the bytes, so it changes the key); converge keys fold the
+  fixed point's identity (tol/solver/mg_levels) but NOT max_iters/
+  check_every, which only change reporting cadence;
+* two tiers — memory LRU spills to CRC-validated content-addressed
+  disk files; a corrupt disk entry is a loud journaled-dead miss,
+  never served bytes; a memory-only eviction IS a journaled death;
+* never-resurrect — deaths are journaled write-ahead through the WAL's
+  ``cache`` record kind; a cache rebuilt over a recovered
+  ``WALState.cache_dead`` refuses surviving bytes, and a re-store
+  journals ``live`` to lift the tombstone;
+* service integration — duplicate submits are served stamped
+  ``cache: "hit"`` with the engine's compile/batch/image counters
+  exactly flat, byte-identical to the oracle; the cache is OFF unless
+  injected (existing batching semantics unchanged);
+* shared-evidence IO — the one sanctioned curve writer preserves
+  foreign lanes both ways, and the static gate demonstrably catches a
+  direct open-for-write of a shared curve.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from parallel_convolution_tpu.ops import filters, oracle
+from parallel_convolution_tpu.parallel import mesh as mesh_lib
+from parallel_convolution_tpu.serving import wal as wal_mod
+from parallel_convolution_tpu.serving.cache import (
+    ResultCache, converge_key, input_digest, result_key,
+)
+from parallel_convolution_tpu.serving.engine import WarmEngine
+from parallel_convolution_tpu.serving.service import (
+    ConvolutionService, Request,
+)
+from parallel_convolution_tpu.utils import imageio
+from parallel_convolution_tpu.utils.evidence_io import rewrite_shared_jsonl
+
+SCRIPTS = Path(__file__).resolve().parent.parent / "scripts"
+
+
+def _mesh(shape=(1, 2)):
+    return mesh_lib.make_grid_mesh(jax.devices()[: shape[0] * shape[1]],
+                                   shape)
+
+
+def _img(rows=24, cols=32, seed=5):
+    return imageio.generate_test_image(rows, cols, "grey", seed=seed)
+
+
+_KEY_ENGINE: list = []
+
+
+def _key(img, **kw):
+    kw.setdefault("filter_name", "blur3")
+    kw.setdefault("iters", 2)
+    if not _KEY_ENGINE:
+        _KEY_ENGINE.append(WarmEngine(_mesh()))   # key math only
+    return _KEY_ENGINE[0].key_for((1,) + img.shape, **kw)
+
+
+def _arrays(seed=0, n=64):
+    rng = np.random.default_rng(seed)
+    return {"image": rng.integers(0, 255, (1, 4, n // 4),
+                                  dtype=np.uint8)}
+
+
+# ------------------------------------------------------------- keys
+
+
+def test_input_digest_covers_dtype_shape_and_bytes():
+    a = np.arange(64, dtype=np.uint8).reshape(1, 8, 8)
+    assert input_digest(a) == input_digest(a.copy())
+    assert input_digest(a) != input_digest(a.reshape(8, 8, 1))
+    assert input_digest(a) != input_digest(a.astype(np.uint16))
+    b = a.copy()
+    b[0, 0, 0] ^= 1
+    assert input_digest(a) != input_digest(b)
+
+
+def test_result_key_folds_compile_identity():
+    img = _img()
+    d = input_digest(img[None])
+    assert result_key(d, _key(img)) == result_key(d, _key(img))
+    assert result_key(d, _key(img)) != result_key(d, _key(img, iters=3))
+    assert (result_key(d, _key(img))
+            != result_key(d, _key(img, filter_name="sharpen3")))
+
+
+def test_converge_key_is_fixed_point_identity_not_budget():
+    img = _img()
+    d = input_digest(img[None])
+    k1 = _key(img, iters=10)
+    k2 = _key(img, iters=50)   # check_every cadence rides in iters
+    assert (converge_key(d, tol=1e-3, solver="jacobi", mg_levels=None,
+                         engine_key=k1)
+            == converge_key(d, tol=1e-3, solver="jacobi", mg_levels=None,
+                            engine_key=k2))
+    assert (converge_key(d, tol=1e-3, solver="jacobi", mg_levels=None)
+            != converge_key(d, tol=1e-4, solver="jacobi", mg_levels=None))
+    assert (converge_key(d, tol=1e-3, solver="jacobi", mg_levels=None)
+            != converge_key(d, tol=1e-3, solver="multigrid", mg_levels=3))
+    # Converge and batch keys for the same digest never collide.
+    assert converge_key(d, tol=1e-3, solver="jacobi",
+                        mg_levels=None) != result_key(d, k1)
+
+
+# ------------------------------------------------------------- tiers
+
+
+def test_put_get_round_trip_copies_caller_buffer():
+    c = ResultCache()
+    arrs = _arrays()
+    orig = arrs["image"].copy()
+    c.put("k1", arrs, {"m": 1})
+    arrs["image"][:] = 0          # caller reuses its buffer
+    got = c.get("k1")
+    assert got is not None
+    np.testing.assert_array_equal(got[0]["image"], orig)
+    assert got[1] == {"m": 1}
+    assert c.get("nope") is None
+    s = c.snapshot()
+    assert s["hits_mem"] == 1 and s["misses"] == 1 and s["stores"] == 1
+
+
+def test_memory_only_eviction_is_journaled_death():
+    journal = []
+    c = ResultCache(capacity_entries=2,
+                    journal=lambda op, k: journal.append((op, k)))
+    for i in range(3):
+        c.put(f"k{i}", _arrays(i), {})
+    assert c.get("k0") is None            # LRU victim, no disk tier
+    assert ("dead", "k0") in journal
+    assert c.stats["evictions"] == 1
+    # The tombstone means even a racing writer's bytes can't revive it
+    # without a live record.
+    c.put("k0", _arrays(0), {})
+    assert ("live", "k0") in journal
+    assert c.get("k0") is not None
+
+
+def test_disk_spill_promote_and_crc_corruption(tmp_path):
+    journal = []
+    c = ResultCache(capacity_entries=1, disk_dir=tmp_path / "rc",
+                    journal=lambda op, k: journal.append((op, k)))
+    a0, a1 = _arrays(0), _arrays(1)
+    c.put("k0", a0, {"who": "k0"})
+    c.put("k1", a1, {"who": "k1"})       # spills k0 to disk
+    assert c.stats["spills"] == 1
+    files = list((tmp_path / "rc").glob("*.rc"))
+    assert [f.name for f in files] == ["k0.rc"]
+    got = c.get("k0")                     # disk hit, promoted
+    assert got is not None and got[1] == {"who": "k0"}
+    np.testing.assert_array_equal(got[0]["image"], a0["image"])
+    assert c.stats["hits_disk"] == 1
+    # Promotion re-evicted k1; corrupt its shard: loud journaled miss.
+    k1_file = tmp_path / "rc" / "k1.rc"
+    blob = bytearray(k1_file.read_bytes())
+    blob[-1] ^= 0xFF
+    k1_file.write_bytes(bytes(blob))
+    assert c.get("k1") is None
+    assert c.stats["corrupt_drops"] == 1
+    assert ("dead", "k1") in journal
+    assert not k1_file.exists()
+
+
+def test_adoption_skips_dead_and_keeps_live(tmp_path):
+    c = ResultCache(capacity_entries=1, disk_dir=tmp_path / "rc")
+    c.put("dead1", _arrays(0), {})
+    c.put("live1", _arrays(1), {})       # spills dead1
+    c.put("fill1", _arrays(2), {})       # spills live1
+    # Restart over a recovered dead set: dead1's surviving file must be
+    # unlinked at adoption, live1 adopted and served.
+    c2 = ResultCache(disk_dir=tmp_path / "rc", dead=["dead1"])
+    assert not (tmp_path / "rc" / "dead1.rc").exists()
+    assert c2.get("dead1") is None
+    assert c2.stats["dead_refusals"] == 1
+    assert c2.get("live1") is not None
+    assert sorted(c2.keys())[0] == "fill1" or "live1" in c2.keys()
+
+
+def test_invalidate_all_and_len():
+    c = ResultCache()
+    c.put("a", _arrays(0), {})
+    c.put("b", _arrays(1), {})
+    assert len(c) == 2 and set(c.keys()) == {"a", "b"}
+    c.invalidate_all()
+    assert len(c) == 0
+    assert c.get("a") is None and c.stats["dead_refusals"] >= 1
+
+
+# ------------------------------------------------------------- WAL
+
+
+def test_wal_state_folds_cache_records_and_round_trips():
+    st = wal_mod.WALState()
+    st.apply({"kind": "cache", "op": "dead", "ckey": "k1"})
+    st.apply({"kind": "cache", "op": "dead", "ckey": "k2"})
+    assert set(st.cache_dead) == {"k1", "k2"}
+    st.apply({"kind": "cache", "op": "live", "ckey": "k1"})
+    assert set(st.cache_dead) == {"k2"}
+    st2 = wal_mod.WALState()
+    st2.load_wire(st.to_wire())
+    assert set(st2.cache_dead) == {"k2"}
+
+
+def test_router_wal_replay_recovers_cache_dead(tmp_path):
+    w = wal_mod.RouterWAL(tmp_path / "ctl.wal", fsync=False)
+    w.append("cache", op="dead", ckey="gone")
+    w.append("cache", op="dead", ckey="back")
+    w.append("cache", op="live", ckey="back")
+    w.close()
+    w2 = wal_mod.RouterWAL(tmp_path / "ctl.wal", fsync=False)
+    assert set(w2.state.cache_dead) == {"gone"}
+    # The rebuilt cache refuses the recovered-dead key outright.
+    c = ResultCache(dead=w2.state.cache_dead)
+    assert c.get("gone") is None and c.stats["dead_refusals"] == 1
+    w2.close()
+
+
+# ------------------------------------------------- service integration
+
+
+def test_service_cache_default_off():
+    svc = ConvolutionService(_mesh(), max_delay_s=0.002)
+    try:
+        r = svc.submit(Request(image=_img(), iters=1, request_id="a"),
+                       timeout=120)
+        assert r.ok and r.cache == "off"
+        assert svc.snapshot()["cache"] is None
+    finally:
+        svc.close()
+
+
+def test_service_duplicate_hits_flat_engine_and_oracle_bytes():
+    svc = ConvolutionService(_mesh(), max_delay_s=0.002,
+                             cache=ResultCache())
+    img = _img(seed=9)
+    want = oracle.run_serial_u8(img, filters.get_filter("blur3"), 2)
+    try:
+        r0 = svc.submit(Request(image=img, iters=2, request_id="c0"),
+                        timeout=120)
+        assert r0.ok and r0.cache == "miss" and len(r0.digest) == 64
+        np.testing.assert_array_equal(r0.image, want)
+        eng = dict(svc.engine.stats)
+        for i in range(3):
+            r = svc.submit(Request(image=img, iters=2,
+                                   request_id=f"c{i + 1}"), timeout=120)
+            assert r.ok and r.cache == "hit" and r.digest == r0.digest
+            assert r.batch_size == 1
+            np.testing.assert_array_equal(r.image, want)
+        for k in ("compiles", "batches", "images"):
+            assert svc.engine.stats[k] == eng[k], k
+        assert svc.stats["cache_hits"] == 3
+        # A mutated hit copy must not poison the shared cached entry.
+        r.image[0, 0] ^= 1
+        r2 = svc.submit(Request(image=img, iters=2, request_id="c9"),
+                        timeout=120)
+        np.testing.assert_array_equal(r2.image, want)
+        # Different iters = different result key = real execution.
+        r3 = svc.submit(Request(image=img, iters=3, request_id="c10"),
+                        timeout=120)
+        assert r3.ok and r3.cache == "miss"
+    finally:
+        svc.close()
+
+
+def test_service_converge_final_cached_single_row_stream():
+    svc = ConvolutionService(_mesh(), max_delay_s=0.002,
+                             cache=ResultCache())
+    img = _img(seed=11)
+
+    def run(rid):
+        req = Request(image=img, iters=10, request_id=rid,
+                      quantize=False)
+        return list(svc.submit_progressive(req, tol=5.0, max_iters=200))
+
+    try:
+        rows1 = run("cv0")
+        assert rows1 and rows1[-1].final and rows1[-1].converged
+        assert rows1[-1].cache == "miss"
+        rows2 = run("cv1")
+        assert len(rows2) == 1
+        assert rows2[0].final and rows2[0].converged
+        assert rows2[0].cache == "hit"
+        np.testing.assert_array_equal(rows2[0].image, rows1[-1].image)
+        assert rows2[0].iters == rows1[-1].iters
+    finally:
+        svc.close()
+
+
+def test_reshape_invalidates_cache():
+    svc = ConvolutionService(_mesh((1, 2)), max_delay_s=0.002,
+                             cache=ResultCache())
+    img = _img(seed=13)
+    try:
+        svc.submit(Request(image=img, iters=1, request_id="r0"),
+                   timeout=120)
+        assert len(svc.cache) == 1
+        svc.reshape("2x2")
+        assert len(svc.cache) == 0
+        r = svc.submit(Request(image=img, iters=1, request_id="r1"),
+                       timeout=120)
+        assert r.ok and r.cache == "miss"   # stale-grid meta never served
+    finally:
+        svc.close()
+
+
+# ------------------------------------------------- shared-evidence IO
+
+
+def test_rewrite_shared_jsonl_unlaned_owner_preserves_lanes(tmp_path):
+    p = tmp_path / "curve.jsonl"
+    p.write_text(json.dumps({"lane": "other", "x": 1}) + "\n"
+                 + json.dumps({"old": True}) + "\n"
+                 + "not json\n")
+    kept = rewrite_shared_jsonl(p, [{"mine": 1}, {"mine": 2}], lane=None)
+    assert kept == 1
+    rows = [json.loads(ln) for ln in p.read_text().splitlines()]
+    assert rows[0] == {"mine": 1} and rows[1] == {"mine": 2}
+    assert rows[2] == {"lane": "other", "x": 1}
+    assert len(rows) == 3                    # old un-laned + torn dropped
+
+
+def test_rewrite_shared_jsonl_lane_owner_stamps_and_replaces(tmp_path):
+    p = tmp_path / "curve.jsonl"
+    rewrite_shared_jsonl(p, [{"a": 1}], lane=None)
+    rewrite_shared_jsonl(p, [{"b": 1}], lane="cache_skew")
+    rewrite_shared_jsonl(p, [{"c": 1}], lane="router_scale")
+    # Each lane owner replaces only its own rows.
+    rewrite_shared_jsonl(p, [{"b": 2}], lane="cache_skew")
+    rows = [json.loads(ln) for ln in p.read_text().splitlines()]
+    lanes = [r.get("lane") for r in rows]
+    assert lanes.count("cache_skew") == 1
+    assert {"lane": "cache_skew", "b": 2} in rows
+    assert {"lane": "router_scale", "c": 1} in rows
+    assert {"a": 1} in rows
+
+
+def _load_static_check():
+    spec = importlib.util.spec_from_file_location(
+        "static_check", SCRIPTS / "static_check.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_static_gate_catches_direct_shared_curve_write(tmp_path):
+    sc = _load_static_check()
+    bad = tmp_path / "bad_smoke.py"
+    bad.write_text(
+        "from pathlib import Path\n"
+        "curve_path = Path('evidence/scale_curve.jsonl')\n"
+        "with open(curve_path, 'w') as f:\n"
+        "    f.write('{}')\n")
+    probs = sc.check_shared_curve_writes([bad])
+    assert len(probs) == 1 and "evidence_io" in probs[0]
+    # write_text and Path.open('w') are writes too.
+    bad.write_text("from pathlib import Path\n"
+                   "Path('x/scale_curve.jsonl').write_text('')\n")
+    assert sc.check_shared_curve_writes([bad])
+    bad.write_text("curve = open('evidence/scale_curve.jsonl')\n")
+    assert not sc.check_shared_curve_writes([bad])   # read mode is fine
+    # The helper module itself is the one sanctioned writer.
+    helper = tmp_path / "evidence_io.py"
+    helper.write_text("curve_path = 'scale_curve.jsonl'\n"
+                      "f = open(curve_path, 'w')\n")
+    assert not sc.check_shared_curve_writes([helper])
+
+
+def test_repo_tree_passes_shared_curve_rule():
+    sc = _load_static_check()
+    assert sc.check_shared_curve_writes(sc.py_files()) == []

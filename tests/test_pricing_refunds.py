@@ -1,0 +1,185 @@
+"""Pricing refund semantics on cache hits, dead-end sheds, and hedges.
+
+ISSUE 18 satellite: the admission charge is settled so a tenant pays
+for device work EXACTLY once per device execution —
+
+* a response stamped ``cache: "hit"`` consumed no device time, so the
+  router refunds the admission charge down to ``WorkPricer.hit_units``
+  (the floor): a duplicate-heavy tenant's budget outlasts its naive
+  ``n_requests * cost`` ceiling;
+* a shed that did no work (``replica_unavailable`` et al.) refunds the
+  FULL charge — a dead replica set burns availability, never quota;
+* a hedged request admits ONE charge no matter how many dispatch
+  attempts race — hedging spends the operator's device time, not the
+  tenant's budget twice.
+
+All constructions use rate ≈ 0 buckets so the balance arithmetic is
+exact: whatever passes, passes on refunds alone, not on refill.
+"""
+
+from __future__ import annotations
+
+import base64
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from parallel_convolution_tpu.parallel import mesh as mesh_lib
+from parallel_convolution_tpu.serving.cache import ResultCache
+from parallel_convolution_tpu.serving.pricing import WorkPricer
+from parallel_convolution_tpu.serving.router import (
+    InProcessReplica, ReplicaRouter, TenantQuotas,
+)
+from parallel_convolution_tpu.serving.service import ConvolutionService
+from parallel_convolution_tpu.utils import imageio
+
+_NO_REFILL = 1e-9   # rate: bucket never meaningfully refills in-test
+
+
+def _mesh(shape=(1, 2)):
+    return mesh_lib.make_grid_mesh(jax.devices()[: shape[0] * shape[1]],
+                                   shape)
+
+
+def _img(rows=32, cols=48, seed=5):
+    return imageio.generate_test_image(rows, cols, "grey", seed=seed)
+
+
+def _body(img, **kw):
+    body = {"image_b64": base64.b64encode(
+        np.ascontiguousarray(img).tobytes()).decode("ascii"),
+        "rows": img.shape[0], "cols": img.shape[1], "mode": "grey",
+        "filter": "blur3", "iters": 1}
+    body.update(kw)
+    return body
+
+
+def _router(burst_units, *, pricer, n=1, cache=None, **kw):
+    def make():
+        return ConvolutionService(_mesh(), max_delay_s=0.002,
+                                  cache=cache)
+
+    reps = [InProcessReplica(make, name=f"r{i}") for i in range(n)]
+    return ReplicaRouter(
+        reps, quotas=TenantQuotas(rate=_NO_REFILL, burst=burst_units),
+        pricer=pricer, poll_interval_s=0.05, **kw)
+
+
+def _pricer():
+    # min_units must sit far below one real job's price: the refund
+    # under test is `cost - hit_units`, which the default 1e-4 floor
+    # could swallow for a tiny CPU job.
+    return WorkPricer(grid=(1, 2), min_units=1e-9)
+
+
+def test_hit_units_is_the_floor_and_prices_cache_hits():
+    p = _pricer()
+    body = _body(_img())
+    assert p.hit_units() == pytest.approx(1e-9)
+    assert p.price(body, cache_hit=True) == p.hit_units()
+    assert p.price(body) > 100 * p.hit_units()
+
+
+def test_cache_hits_refund_down_to_hit_units():
+    pricer = _pricer()
+    img = _img(seed=21)
+    cost = pricer.price(_body(img))
+    # Budget = 3 device executions.  10 duplicates cost ONE execution
+    # plus 9 hit floors under refund settlement; without the hit
+    # refund the 4th duplicate sheds tenant_quota.
+    router = _router(3 * cost, pricer=pricer, cache=ResultCache())
+    try:
+        for i in range(10):
+            status, wire = router.request(
+                _body(img, request_id=f"hit{i}"), timeout=120)
+            assert status == 200 and wire["ok"], (i, wire)
+            assert wire["cache"] == ("miss" if i == 0 else "hit"), i
+            assert wire["router"]["cost_units"] == round(cost, 6)
+        assert router.stats["rejected_tenant_quota"] == 0
+        bucket = router.quotas.bucket("default")
+        # One real execution + 9 floors: balance ≈ 2·cost remains.
+        assert bucket._tokens == pytest.approx(2 * cost, rel=1e-3)
+        # The refund is bounded: a MISS (new content) still pays full.
+        status, wire = router.request(
+            _body(_img(seed=99), request_id="fresh"), timeout=120)
+        assert status == 200 and wire["cache"] == "miss"
+        assert bucket._tokens == pytest.approx(cost, rel=1e-3)
+    finally:
+        router.close()
+
+
+def test_no_work_sheds_refund_full_charge():
+    pricer = _pricer()
+    img = _img(seed=22)
+    cost = pricer.price(_body(img))
+    # Budget = exactly ONE charge.  Against a dead replica set every
+    # attempt must come back replica_unavailable: if the dead-end shed
+    # kept the charge, attempt 2 would flip to tenant_quota — turning
+    # an operator outage into a tenant bill.
+    router = _router(cost, pricer=pricer)
+    try:
+        router.replica("r0").kill()
+        for i in range(5):
+            status, wire = router.request(
+                _body(img, request_id=f"dead{i}"), timeout=30)
+            assert status == 503, (i, wire)
+            assert wire["rejected"] == "replica_unavailable", i
+        assert router.stats["rejected_tenant_quota"] == 0
+        assert router.quotas.bucket("default")._tokens == pytest.approx(
+            cost, rel=1e-3)
+    finally:
+        router.close()
+
+
+def test_hedged_request_admits_exactly_one_charge():
+    pricer = _pricer()
+    img = _img(seed=23)
+    cost = pricer.price(_body(img))
+    # hedge_s=0 → the hedge fires on every request.  Budget 1.5·cost
+    # admits ONE charge with headroom but NOT two: a per-attempt charge
+    # would shed this request at its own second dispatch.
+    router = _router(1.5 * cost, pricer=pricer, n=2, hedge_s=0.0)
+    try:
+        status, wire = router.request(
+            _body(img, request_id="hedge0"), timeout=120)
+        assert status == 200 and wire["ok"], wire
+        assert router.stats["hedges"] >= 1
+        assert router.stats["rejected_tenant_quota"] == 0
+        assert router.quotas.bucket("default")._tokens == pytest.approx(
+            0.5 * cost, rel=1e-3)
+    finally:
+        router.close()
+
+
+def test_dedup_joiners_share_the_single_charge():
+    # Two submissions, ONE request_id, one replica: the replica-side
+    # idempotency ledger dedups them into one device execution, and the
+    # bucket shows exactly two admission charges were taken at the
+    # router (dedup is replica-side; each router-level request is a
+    # distinct admission) minus nothing — i.e. joiners are NOT free at
+    # admission, but no third hidden charge appears either.
+    pricer = _pricer()
+    img = _img(seed=24)
+    cost = pricer.price(_body(img))
+    router = _router(4 * cost, pricer=pricer)
+    try:
+        results = []
+
+        def go():
+            results.append(router.request(
+                _body(img, request_id="dup-join"), timeout=120))
+
+        ts = [threading.Thread(target=go) for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert all(s == 200 and w["ok"] for s, w in results)
+        svc = router.replica("r0").service
+        assert svc.engine.stats["images"] == 1   # one device execution
+        charged = 4 * cost - router.quotas.bucket("default")._tokens
+        assert charged == pytest.approx(2 * cost, rel=1e-3)
+    finally:
+        router.close()

@@ -1,0 +1,87 @@
+"""t1_legs.json schema gate (ISSUE 18 satellite).
+
+``scripts/t1_legs.json`` is the machine-readable registry the smoke
+driver and ``run_t1.sh --list-legs`` read. The contract enforced here:
+
+* every leg's ``cmd`` starts with an existing script, and any
+  ``--flag`` it passes to ``run_t1.sh`` is actually handled there;
+* leg names are unique; evidence ``done_file`` outputs are unique, so
+  two legs can never race on one artifact;
+* ``done_pattern`` is present iff ``done_file`` is (a pattern without
+  a file to grep — or a file nobody gates on — is a dead leg);
+* timeouts are positive ints, and legs that declare a done_file keep
+  it under ``evidence/``.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import subprocess
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+LEGS_PATH = ROOT / "scripts" / "t1_legs.json"
+
+
+def _legs():
+    return json.loads(LEGS_PATH.read_text())
+
+
+def test_registry_parses_and_is_nonempty():
+    legs = _legs()
+    assert isinstance(legs, list) and len(legs) >= 10
+    for leg in legs:
+        assert set(leg) <= {"name", "cmd", "done_file", "done_pattern",
+                            "timeout"}, leg
+        assert isinstance(leg["name"], str) and leg["name"]
+        assert isinstance(leg["cmd"], list) and leg["cmd"]
+        assert all(isinstance(a, str) for a in leg["cmd"])
+
+
+def test_leg_names_unique():
+    names = [leg["name"] for leg in _legs()]
+    assert len(names) == len(set(names))
+
+
+def test_cmds_reference_existing_scripts_and_real_flags():
+    driver = (ROOT / "scripts" / "run_t1.sh").read_text()
+    for leg in _legs():
+        cmd = leg["cmd"]
+        script = cmd[1] if cmd[0] in ("bash", "sh", "python") else cmd[0]
+        assert (ROOT / script).is_file(), f"{leg['name']}: {script}"
+        for arg in cmd[2:]:
+            if arg.startswith("--") and script.endswith("run_t1.sh"):
+                assert re.search(
+                    rf'"\$\{{1:-\}}" = "{re.escape(arg)}"',
+                    driver), f"{leg['name']}: {arg}"
+
+
+def test_done_file_unique_under_evidence_and_pattern_iff_file():
+    legs = _legs()
+    done_files = [leg["done_file"] for leg in legs if "done_file" in leg]
+    assert len(done_files) == len(set(done_files))
+    for leg in legs:
+        has_file = "done_file" in leg
+        assert has_file == ("done_pattern" in leg), leg["name"]
+        if has_file:
+            assert leg["done_file"].startswith("evidence/"), leg["name"]
+            assert isinstance(leg["done_pattern"], str)
+            assert leg["done_pattern"]
+    # The full-suite leg is the one sanctioned file-less entry.
+    bare = [leg["name"] for leg in legs if "done_file" not in leg]
+    assert bare == ["tier1"]
+
+
+def test_timeouts_positive_ints():
+    for leg in _legs():
+        assert isinstance(leg["timeout"], int) and leg["timeout"] > 0
+
+
+def test_list_legs_prints_every_leg():
+    out = subprocess.run(
+        ["bash", "scripts/run_t1.sh", "--list-legs"],
+        cwd=ROOT, capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    for leg in _legs():
+        assert leg["name"] in out.stdout
