@@ -167,6 +167,60 @@ def _resolve_perf_knobs(args, mesh) -> None:
     args.col_mode = None if cm == "auto" else cm
 
 
+def _run_volume(args, mesh) -> int:
+    """The ``--rank 3`` arm of ``run``: the input file is raw float32
+    ``(2, D, rows, cols)`` bytes (two interleaved fields — u/v for
+    Gray–Scott, u/u_prev for wave, field+rhs for the FD forms), the
+    output the same layout after ``loops`` sweeps (or a ``--converge``
+    run).  Volumes stay float end-to-end — no u8 quantization."""
+    from parallel_convolution_tpu.utils.config import (
+        VOLUME_FIELDS, VOLUME_SMOOTH_FORMS, VOLUME_PHYSICS_FORMS,
+    )
+    from parallel_convolution_tpu.volumes import driver
+
+    if args.depth is None or args.depth < 1:
+        print("--rank 3 requires --depth D (the resident volume depth)",
+              file=sys.stderr)
+        return 2
+    known = VOLUME_SMOOTH_FORMS + VOLUME_PHYSICS_FORMS
+    if args.filter_name not in known:
+        print(f"--rank 3 --filter must name a rank-3 form "
+              f"({', '.join(known)}), got {args.filter_name!r}",
+              file=sys.stderr)
+        return 2
+    if args.solver != "jacobi":
+        print(f"--rank 3 supports --solver jacobi only (got "
+              f"{args.solver}): rank-3 multigrid transfer ships as "
+              "registry forms, not a CLI solver", file=sys.stderr)
+        return 2
+    want = (VOLUME_FIELDS, args.depth, args.rows, args.cols)
+    raw = np.fromfile(args.image, dtype=np.float32)
+    if raw.size != int(np.prod(want)):
+        print(f"{args.image}: {raw.size} f32 values, expected "
+              f"{int(np.prod(want))} for {want}", file=sys.stderr)
+        return 2
+    vol = raw.reshape(want)
+    fuse = max(1, args.fuse or 1)
+    r, c = mesh.shape["x"], mesh.shape["y"]
+    if args.converge is not None:
+        out, iters, diff = driver.volume_converge(
+            vol, args.filter_name, tol=args.converge,
+            max_iters=args.loops, check_every=args.check_every,
+            mesh=mesh, boundary=args.boundary, fuse=fuse)
+        np.ascontiguousarray(out, dtype=np.float32).tofile(args.output)
+        print(f"volume converged after {iters} iters (diff {diff:.3g}, "
+              f"tol {args.converge}) on {r}x{c} mesh -> {args.output}")
+        return 0
+    out = driver.volume_iterate(vol, args.filter_name, args.loops,
+                                mesh=mesh, boundary=args.boundary,
+                                fuse=fuse)
+    np.ascontiguousarray(out, dtype=np.float32).tofile(args.output)
+    print(f"ran {args.loops} x {args.filter_name} on "
+          f"{args.depth}x{args.rows}x{args.cols} volume, {r}x{c} mesh "
+          f"-> {args.output}")
+    return 0
+
+
 def _mesh_from_flag(spec: str | None):
     from parallel_convolution_tpu.parallel.mesh import mesh_from_spec
 
@@ -196,6 +250,15 @@ def main(argv: list[str] | None = None) -> int:
                      choices=list(BOUNDARIES),
                      help="edge handling: zero ghost ring (the reference) "
                           "or periodic torus wrap")
+    run.add_argument("--rank", type=int, default=2, choices=[2, 3],
+                     help="workload rank: 2 = u8 images (the default), "
+                          "3 = (2, D, rows, cols) raw float32 volumes "
+                          "(two interleaved fields) through the rank-3 "
+                          "registry forms — fd7/fd25 FD Laplacians, "
+                          "wave leapfrog, Gray-Scott reaction-diffusion")
+    run.add_argument("--depth", type=int, default=None, metavar="D",
+                     help="volume depth (required with --rank 3): the "
+                          "resident D axis; rows/cols shard on the mesh")
     run.add_argument("--converge", type=float, default=None, metavar="TOL",
                      help="run to convergence (loops becomes max iters)")
     run.add_argument("--solver", default="jacobi", choices=list(SOLVERS),
@@ -384,6 +447,8 @@ def main(argv: list[str] | None = None) -> int:
 
     mesh = _mesh_from_flag(args.mesh)
     _resolve_perf_knobs(args, mesh)
+    if getattr(args, "rank", 2) == 3:
+        return _run_volume(args, mesh)
     if args.solver != "jacobi" and args.converge is None:
         print(f"--solver {args.solver} requires --converge TOL: without "
               "it the run is a fixed-count iterate and the solver choice "

@@ -43,7 +43,7 @@ from parallel_convolution_tpu.tuning import costmodel
 __all__ = [
     "exchange_rounds", "halo_bytes_per_round", "halo_bytes_total",
     "predicted_exchange_fraction", "predicted_exchange_split",
-    "record_drift", "record_step",
+    "record_drift", "record_step", "volume_face_bytes_per_round",
 ]
 
 DIRECTIONS = ("north", "south", "east", "west")
@@ -79,6 +79,26 @@ def halo_bytes_per_round(grid: tuple[int, int], block_hw: tuple[int, int],
     }
     out["total"] = sum(out.values())
     return out
+
+
+def volume_face_bytes_per_round(grid: tuple[int, int],
+                                block_hw: tuple[int, int], depth: int,
+                                radius: int, fuse: int, fields: int = 2,
+                                storage: str = "f32",
+                                boundary: str = "zero") -> dict:
+    """Per-direction bytes of ONE rank-3 6-face ghost exchange.
+
+    The ±D faces are a LOCAL pad (the depth axis is resident —
+    ``volumes.halo3``), so only the ±H/±W face slabs cross links, and
+    each slab carries the whole depth-padded field column: the rank-2
+    slab arithmetic at an effective channel count of
+    ``fields * (depth + 2d)``.  Same direction naming, same
+    zero-vs-periodic sender rule, same 1-long-axis elision as
+    :func:`halo_bytes_per_round`."""
+    d = int(radius) * max(1, int(fuse))
+    ch = max(1, int(fields)) * (max(1, int(depth)) + 2 * d)
+    return halo_bytes_per_round(grid, block_hw, radius, fuse, ch,
+                                storage, boundary)
 
 
 def exchange_rounds(iters: int, fuse: int) -> tuple[int, int]:

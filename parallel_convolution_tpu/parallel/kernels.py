@@ -51,7 +51,10 @@ __all__ = ["KernelForm", "clamp_overlap", "overlap_capable",
            "persistent_capable", "register", "registered_keys", "resolve"]
 
 # The stencil-form vocabulary (closed: dispatch code switches on it).
-STENCIL_FORMS = ("smooth", "restrict", "prolong")
+# "physics" (round 23) classes the time-dependent rank-3 forms (wave,
+# Gray–Scott): they iterate like smoothers but are NOT convergence
+# smoothers — converge admission keys off the class, not the name.
+STENCIL_FORMS = ("smooth", "restrict", "prolong", "physics")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -138,6 +141,7 @@ def _ensure_default_forms() -> None:
     are not, so they land lazily on the first miss."""
     from parallel_convolution_tpu.parallel import step  # noqa: F401
     from parallel_convolution_tpu.solvers import transfer  # noqa: F401
+    from parallel_convolution_tpu.volumes import forms  # noqa: F401
 
 
 def resolve(rank: int, name: str, boundary: str) -> KernelForm:

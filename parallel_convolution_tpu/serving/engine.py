@@ -99,6 +99,14 @@ class EngineKey:
     mg_levels: int | None = None     # multigrid level-count cap (part of
     #                                  the compile identity: it changes
     #                                  the level schedule)
+    rank: int = 2                    # stencil rank (utils.config.RANKS):
+    #                                  rank=3 keys a VOLUME config —
+    #                                  ``shape`` is then (D, H, W) of one
+    #                                  two-field volume, ``filter_name``
+    #                                  a registered rank-3 form, and the
+    #                                  executables come from
+    #                                  volumes.driver instead of
+    #                                  parallel.step
 
     def validate(self) -> None:
         """Terminal (ValueError) on any out-of-registry field — the typed
@@ -107,6 +115,14 @@ class EngineKey:
         ``backend="auto"`` never reaches here: :meth:`WarmEngine.key_for`
         resolves it to a concrete tier first, so two requests that tune
         to the same program share one key (and one executable)."""
+        from parallel_convolution_tpu.utils.config import RANKS
+
+        if self.rank not in RANKS:
+            raise ValueError(f"rank must be one of {RANKS}, "
+                             f"got {self.rank}")
+        if self.rank == 3:
+            self._validate_volume()
+            return
         get_filter(self.filter_name)  # raises on unknown names
         if self.backend not in BACKENDS:
             raise ValueError(f"unknown backend {self.backend!r} (auto is "
@@ -145,6 +161,41 @@ class EngineKey:
                 raise ValueError("solver='multigrid' requires "
                                  "storage='f32'")
 
+    def _validate_volume(self) -> None:
+        """Rank-3 key constraints.  ``shape`` is (D, H, W) of one
+        two-field volume; ``filter_name`` must resolve in the rank-3
+        registry (raises with the registered names on a miss).  Volumes
+        are float fields end to end, serve on the registry path (no
+        backend ladder, no Pallas tier, no overlap pipeline), and
+        converge through the chunked-jacobi driver."""
+        from parallel_convolution_tpu.parallel import (
+            kernels as kernel_forms,
+        )
+
+        if self.boundary not in BOUNDARIES:
+            raise ValueError(f"unknown boundary {self.boundary!r}")
+        kernel_forms.resolve(3, self.filter_name, self.boundary)
+        if self.backend not in BACKENDS:
+            raise ValueError(f"unknown backend {self.backend!r}")
+        if len(self.shape) != 3 or min(self.shape) < 1:
+            raise ValueError(f"bad volume shape {self.shape} "
+                             "(want (D, H, W))")
+        if self.iters < 1 or self.fuse < 1:
+            raise ValueError("iters and fuse must be >= 1")
+        if self.quantize or self.storage != "f32":
+            raise ValueError("rank-3 volumes are float fields: "
+                             "storage='f32' and quantize=False required")
+        if self.solver != "jacobi":
+            raise ValueError("rank-3 convergence is the chunked-jacobi "
+                             f"driver; solver={self.solver!r} is rank-2 "
+                             "only")
+        if self.tile is not None or self.overlap:
+            raise ValueError("rank-3 keys have no kernel tile or "
+                             "overlapped-halo form")
+        if self.col_mode != "packed":
+            raise ValueError("rank-3 keys use the canonical 'packed' "
+                             "column transport label")
+
 
 # Shape-bucket extent ladder for lane co-batching: dense at thumbnail
 # sizes (where request mixes cluster), sparse above, capped pad waste
@@ -182,6 +233,11 @@ def bucket_key(key):
     exact-key lane (same behavior as before this round).
     """
     if not isinstance(key, EngineKey):
+        return key
+    if key.rank != 2:
+        # A rank-3 volume's zero-pad margin changes the stencil's D-face
+        # geometry reading, and co-batching across (D, H, W) shapes was
+        # never proven byte-identical — volumes get exact-key lanes.
         return key
     if key.iters != 1 or key.boundary != "zero" or key.solver != "jacobi":
         return key
@@ -378,6 +434,23 @@ class WarmEngine:
 
         kw = dict(kw)
         plan_source = "explicit"
+        if int(kw.get("rank", 2)) == 3:
+            # Volumes have no tuning space (one registry path, no tile,
+            # no overlap, no column A/B): "auto" normalizes to the
+            # canonical shifted label and the knobs to their clamped
+            # values, so every spelling of a volume config shares one
+            # key.  Everything else is validated by the key itself.
+            if kw.get("backend") in (None, "auto"):
+                kw["backend"] = "shifted"
+            kw["overlap"] = bool(kw.get("overlap") or False)
+            kw["col_mode"] = ("packed" if kw.get("col_mode") in
+                              (None, "auto") else kw["col_mode"])
+            kw["fuse"] = max(1, int(kw.get("fuse") or 1))
+            key = EngineKey(shape=tuple(int(s) for s in shape),
+                            grid=grid_shape(self.mesh), **kw)
+            if key.fuse > max(1, key.iters):
+                key = dataclasses.replace(key, fuse=max(1, key.iters))
+            return key, "explicit"
         if kw.get("backend") == "auto":
             from parallel_convolution_tpu import tuning
 
@@ -521,6 +594,34 @@ class WarmEngine:
         from duplicating the work.
         """
         key.validate()
+        if key.rank == 3:
+            # No degrade walk and no tuning Workload: the volume path is
+            # one registry program per (form, boundary) — there is no
+            # lower tier to fall to, and a fault in it is terminal by
+            # design.  The cost-model stamp comes from the rank-3
+            # roofline so predicted-vs-measured visibility survives.
+            from parallel_convolution_tpu.tuning import costmodel
+            from parallel_convolution_tpu.utils.config import (
+                VOLUME_FIELDS, VOLUME_RADII,
+            )
+
+            dev0 = self.mesh.devices.flat[0]
+            hw = costmodel.hardware_for(
+                dev0.platform, getattr(dev0, "device_kind", "") or "")
+            D = key.shape[0]
+            predicted = costmodel.predict_gpx_per_chip(
+                costmodel.predict_volume_seconds_per_cell_iter(
+                    key.grid, self._block_hw(key), D,
+                    VOLUME_RADII[key.filter_name], key.fuse,
+                    key.filter_name, hw, fields=VOLUME_FIELDS))
+            plan_key = (f"vol|{key.filter_name}|{key.shape[0]}x"
+                        f"{key.shape[1]}x{key.shape[2]}|{key.boundary}"
+                        f"|grid={key.grid[0]}x{key.grid[1]}")
+            entry = _Entry(key, key.backend, plan_source="explicit",
+                           predicted_gpx=round(predicted, 3),
+                           plan_key=plan_key)
+            self._compile_batch(entry, 1)
+            return entry
         effective = key.backend
         if self.fallback:
             from parallel_convolution_tpu.resilience import degrade
@@ -565,6 +666,8 @@ class WarmEngine:
             fn = entry.fns.get(batch)
             if fn is not None:
                 return fn
+            if entry.key.rank == 3:
+                return self._compile_volume_batch(entry, batch)
             from parallel_convolution_tpu.parallel import step as step_lib
 
             key = entry.key
@@ -589,6 +692,35 @@ class WarmEngine:
             with self._lock:
                 self.stats["compiles"] += 1
             return fn
+
+    def _compile_volume_batch(self, entry: _Entry, batch: int):
+        """The rank-3 twin of the batch compile: ``batch`` volumes fold
+        their field pairs onto the leading axis — (B, F, D, H, W) →
+        (B*F, D, H, W), the volume driver's interleaved-field contract —
+        and the runner comes from ``volumes.driver``.  Caller holds
+        ``entry.lock``."""
+        import jax
+
+        from parallel_convolution_tpu.utils.config import VOLUME_FIELDS
+        from parallel_convolution_tpu.volumes import driver
+
+        key = entry.key
+        D, H, W = key.shape
+        F = batch * VOLUME_FIELDS
+        probe = np.zeros((F, D, H, W), np.float32)
+        xs, valid_hw = driver.prepare_volume(probe, self.mesh,
+                                             key.boundary)
+        _, block_hw, _ = driver._geometry((F, D, H, W), self.mesh,
+                                          key.boundary)
+        fn = driver._build_volume_iterate(
+            self.mesh, key.filter_name, key.iters, D, valid_hw,
+            block_hw, key.fuse, key.boundary)
+        jax.block_until_ready(fn(xs))
+        entry.fns[batch] = fn
+        entry.compiles += 1
+        with self._lock:
+            self.stats["compiles"] += 1
+        return fn
 
     # -- warmup -------------------------------------------------------------
     def warmup(self, keys) -> list[str]:
@@ -622,6 +754,8 @@ class WarmEngine:
         from parallel_convolution_tpu.parallel import step as step_lib
 
         t = timer or PhaseTimer()
+        if key.rank == 3:
+            return self._run_volume_batch(key, images, t)
         B, C, H, W = images.shape
         if (C, H, W) != key.shape:
             raise ValueError(
@@ -717,6 +851,106 @@ class WarmEngine:
         }
         return out, info
 
+    def _run_volume_batch(self, key: EngineKey, volumes: np.ndarray,
+                          t: PhaseTimer):
+        """The rank-3 arm of :meth:`run_batch`: ``volumes`` is
+        (B, 2, D, H, W) float32, ``key.shape`` its (D, H, W).  Returns
+        ``(out, info)`` with ``out`` the same shape float32 (no u8
+        quantization — volumes are float fields end to end) and the
+        same ``info`` stamps as rank 2; the exchange attribution comes
+        from the rank-3 face-bytes model
+        (``obs.attribution.volume_face_bytes_per_round``)."""
+        import jax
+
+        from parallel_convolution_tpu.utils.config import (
+            VOLUME_FIELDS, VOLUME_RADII,
+        )
+        from parallel_convolution_tpu.volumes import driver
+
+        if volumes.ndim != 5 or volumes.shape[1] != VOLUME_FIELDS:
+            raise ValueError(
+                f"volume batch must be (B, {VOLUME_FIELDS}, D, H, W), "
+                f"got {volumes.shape}")
+        B = volumes.shape[0]
+        if tuple(volumes.shape[2:]) != key.shape:
+            raise ValueError(
+                f"batch volume shape {tuple(volumes.shape[2:])} does "
+                f"not match key {key.shape}")
+        if key.grid != self.grid():
+            raise ValueError(
+                f"stale key grid {key.grid}: engine mesh is now "
+                f"{self.grid()} (resharded mid-process)")
+        D, H, W = key.shape
+        with t.phase("compile"):
+            with obs_trace.span("compile", backend=key.backend,
+                                batch=B, rank=3):
+                entry = self.entry(key)
+                fn = entry.fns.get(B) or self._compile_batch(entry, B)
+        with t.phase("copy_in"):
+            with obs_trace.span("copy_in", batch=B):
+                folded = np.ascontiguousarray(
+                    volumes.reshape(B * VOLUME_FIELDS, D, H, W)
+                    .astype(np.float32))
+                xs, valid_hw = driver.prepare_volume(
+                    folded, self.mesh, key.boundary)
+                jax.block_until_ready(xs)
+        with t.phase("device"):
+            with obs_trace.span("device", batch=B,
+                                backend=entry.effective_backend):
+                out = fn(xs)
+                jax.block_until_ready(out)
+        with t.phase("copy_out"):
+            with obs_trace.span("copy_out", batch=B):
+                out = np.asarray(out)[:, :, : valid_hw[0], : valid_hw[1]]
+                out = out.reshape(B, VOLUME_FIELDS, D, H, W)
+        with self._lock:
+            self.stats["batches"] += 1
+            self.stats["images"] += B
+        split = entry.splits.get(B)
+        if split is None:
+            # Model-attributed exchange share: the rank-3 roofline with
+            # and without its collective term (a 1x1 grid has none).
+            from parallel_convolution_tpu.tuning import costmodel
+
+            dev0 = self.mesh.devices.flat[0]
+            hw = costmodel.hardware_for(
+                dev0.platform, getattr(dev0, "device_kind", "") or "")
+            r = VOLUME_RADII[key.filter_name]
+            args = (self._block_hw(key), D, r, key.fuse, key.filter_name,
+                    hw)
+            total = costmodel.predict_volume_seconds_per_cell_iter(
+                key.grid, *args, fields=B * VOLUME_FIELDS)
+            local = costmodel.predict_volume_seconds_per_cell_iter(
+                (1, 1), *args, fields=B * VOLUME_FIELDS)
+            from parallel_convolution_tpu.obs import attribution
+
+            face = attribution.volume_face_bytes_per_round(
+                key.grid, self._block_hw(key), D, r, key.fuse,
+                fields=B * VOLUME_FIELDS, storage=key.storage,
+                boundary=key.boundary)
+            split = {
+                "exchange_fraction": max(0.0, 1.0 - local / total),
+                "exchange_hidden_fraction": 0.0,  # no overlapped form
+                "face_bytes": face["total"],
+            }
+            entry.splits[B] = split
+        info = {
+            "effective_backend": entry.effective_backend,
+            "effective_grid": f"{key.grid[0]}x{key.grid[1]}",
+            "plan_source": entry.plan_source,
+            "plan_key": entry.plan_key,
+            "predicted_gpx_per_chip": entry.predicted_gpx,
+            "batch_size": B,
+            "overlap": False,
+            "col_mode": "packed",
+            "exchange_fraction": round(split["exchange_fraction"], 4),
+            "exchange_hidden_fraction": 0.0,
+            "phases": {name: t.wall(name)
+                       for name in ("compile", "copy_in", "device",
+                                    "copy_out")},
+        }
+        return out, info
+
     def _record_batch_obs(self, entry: _Entry, B: int, filt,
                           dev_s: float) -> None:
         """Per-batch telemetry: halo/exchange attribution for THIS call's
@@ -753,6 +987,30 @@ class WarmEngine:
         with entry.lock:
             fn = entry.converge_fns.get(n)
             if fn is not None:
+                return fn
+            if entry.key.rank == 3:
+                import jax
+
+                from parallel_convolution_tpu.utils.config import (
+                    VOLUME_FIELDS,
+                )
+                from parallel_convolution_tpu.volumes import driver
+
+                key = entry.key
+                D, H, W = key.shape
+                probe = np.zeros((VOLUME_FIELDS, D, H, W), np.float32)
+                xs, valid_hw = driver.prepare_volume(
+                    probe, self.mesh, key.boundary)
+                _, block_hw, _ = driver._geometry(
+                    (VOLUME_FIELDS, D, H, W), self.mesh, key.boundary)
+                fn = driver.converge_chunk_fn(
+                    self.mesh, key.filter_name, n, D, valid_hw,
+                    block_hw, key.fuse, key.boundary)
+                jax.block_until_ready(fn(xs)[1])
+                entry.converge_fns[n] = fn
+                entry.compiles += 1
+                with self._lock:
+                    self.stats["compiles"] += 1
                 return fn
             import jax
 
@@ -816,6 +1074,17 @@ class WarmEngine:
         from parallel_convolution_tpu.parallel import step as step_lib
 
         entry = self.entry(key)
+        if key.rank == 3:
+            # ``image`` is one (2, D, H, W) float32 volume; the chunk
+            # executables come from volumes.driver through the same
+            # warm-entry cache, and the chunk math is identical — so
+            # resume tokens minted on check_every boundaries replay
+            # byte-stably exactly like rank 2.
+            yield from self._run_volume_converge(
+                entry, key, image, tol=tol, max_iters=max_iters,
+                check_every=check_every, start_done=start_done,
+                start_diff=start_diff)
+            return
         filt = get_filter(key.filter_name)
         if tuple(image.shape) != key.shape:
             raise ValueError(
@@ -878,6 +1147,43 @@ class WarmEngine:
             done += n
             yield (np.asarray(xs[:, : valid_hw[0], : valid_hw[1]]
                               .astype(jnp.float32)), done, diff, float(done))
+
+    def _run_volume_converge(self, entry: _Entry, key: EngineKey,
+                             volume: np.ndarray, *, tol: float,
+                             max_iters: int, check_every: int,
+                             start_done: int = 0,
+                             start_diff: float = float("inf")):
+        """The rank-3 arm of :meth:`run_converge`: yields
+        ``(volume_f32, done, diff, work_units)`` per chunk, volumes at
+        the valid extent."""
+        from parallel_convolution_tpu.utils.config import VOLUME_FIELDS
+        from parallel_convolution_tpu.volumes import driver
+
+        expect = (VOLUME_FIELDS,) + key.shape
+        if tuple(volume.shape) != expect:
+            raise ValueError(
+                f"volume shape {tuple(volume.shape)} does not match "
+                f"key (want {expect})")
+        if float(start_diff) < tol:
+            return
+        xs, valid_hw = driver.prepare_volume(
+            np.ascontiguousarray(volume, dtype=np.float32), self.mesh,
+            key.boundary)
+        check_every, max_iters = int(check_every), int(max_iters)
+        done, diff = int(start_done), float("inf")
+        while done < max_iters and diff >= tol:
+            if key.grid != self.grid():
+                raise ValueError(
+                    f"stale key grid {key.grid}: engine mesh is now "
+                    f"{self.grid()} (resharded mid-process)")
+            n = min(check_every, max_iters - done)
+            fn = self._converge_fn(entry, n)
+            xs, d = fn(xs)
+            diff = float(d)   # the readback fences the chunk
+            done += n
+            out = np.asarray(xs)[:, :, : valid_hw[0], : valid_hw[1]]
+            yield (out.astype(np.float32, copy=False), done, diff,
+                   float(done))
 
     # -- introspection ------------------------------------------------------
     def warm_key_count(self) -> int:

@@ -176,6 +176,8 @@ def decode_request(body: dict) -> Request:
         mode = body.get("mode", "grey")
         if rows < 1 or cols < 1:
             raise ValueError(f"bad image extent {rows}x{cols}")
+        if mode == "volume":
+            return _decode_volume_request(body, rows, cols)
         want = (rows, cols, 3) if mode == "rgb" else (rows, cols)
         framed = body.get("_frames") or {}
         if "image" in framed:
@@ -232,6 +234,60 @@ def decode_request(body: dict) -> Request:
         )
     except (KeyError, TypeError, ValueError) as e:
         raise ValueError(f"malformed request body: {e}") from e
+
+
+def _decode_volume_request(body: dict, rows: int, cols: int) -> Request:
+    """The rank-3 arm of :func:`decode_request` (``mode: "volume"``):
+    the body carries ``depth`` plus a (2, D, H, W) float32 volume — as
+    a ``volume`` tensor frame on the binary wire (the r20 envelope's
+    4-dim f32 frames carry it untouched) or ``volume_b64`` raw f32
+    bytes on JSON.  Raises the same typed ValueError family as the
+    rank-2 arm; the caller's except wraps it."""
+    from parallel_convolution_tpu.utils.config import VOLUME_FIELDS
+
+    depth = int(body["depth"])
+    if depth < 1:
+        raise ValueError(f"bad volume depth {depth}")
+    want = (VOLUME_FIELDS, depth, rows, cols)
+    framed = body.get("_frames") or {}
+    if "volume" in framed:
+        vol = framed["volume"]
+        if vol.dtype != np.float32:
+            raise ValueError(
+                f"volume frame must be float32, got {vol.dtype}")
+        if vol.shape != want:
+            raise ValueError(
+                f"volume frame is {vol.shape}, expected {want} for "
+                f"depth={depth} {rows}x{cols}")
+    else:
+        raw = base64.b64decode(body["volume_b64"])
+        n = int(np.prod(want)) * 4
+        if len(raw) != n:
+            raise ValueError(
+                f"volume_b64 carries {len(raw)} bytes, expected {n} "
+                f"for f32 {want}")
+        vol = np.frombuffer(raw, np.float32).reshape(want)
+    deadline_ms = body.get("deadline_ms")
+    return Request(
+        volume=vol,
+        filter_name=body.get("filter", "fd7"),
+        iters=int(body.get("iters", 1)),
+        backend=body.get("backend", "shifted"),
+        storage="f32",
+        fuse=(None if body.get("fuse", 1) is None
+              else int(body.get("fuse", 1))),
+        boundary=body.get("boundary", "zero"),
+        quantize=False,
+        overlap=(None if body.get("overlap") is None
+                 else bool(body.get("overlap"))),
+        col_mode=(None if body.get("col_mode") is None
+                  else str(body.get("col_mode"))),
+        deadline_s=(float(deadline_ms) / 1e3
+                    if deadline_ms is not None else None),
+        request_id=body.get("request_id"),
+        tenant=str(body.get("tenant") or ""),
+        solver=str(body.get("solver") or "jacobi"),
+    )
 
 
 def _response_parts(result) -> tuple[int, dict, dict]:
@@ -333,10 +389,11 @@ def decode_converge(body: dict) -> tuple[Request, dict]:
                 # state_b64's framed twin: the f32 carries arrive as a
                 # tensor frame; same shape/dtype contract, no base64.
                 state = np.asarray(framed_state)
-                if state.ndim != 3 or state.dtype != np.float32:
+                if state.ndim not in (3, 4) or state.dtype != np.float32:
                     raise ValueError(
-                        f"resume_state frame must be float32 (C, H, W), "
-                        f"got {state.dtype} {state.shape}")
+                        f"resume_state frame must be float32 (C, H, W) "
+                        f"or rank-3 (F, D, H, W), got {state.dtype} "
+                        f"{state.shape}")
                 state = np.ascontiguousarray(state)
             else:
                 state = jobs.state_from_wire(

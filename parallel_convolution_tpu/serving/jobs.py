@@ -54,7 +54,8 @@ TOKEN_FIELDS = ("iters", "diff", "work_units", "solver", "state_b64",
 
 
 def state_to_wire(state: np.ndarray) -> tuple[str, list[int]]:
-    """(state_b64, state_shape) for a (C, H, W) float32 field."""
+    """(state_b64, state_shape) for a (C, H, W) float32 field — or a
+    (F, D, H, W) rank-3 volume; the shape list's length carries rank."""
     arr = np.ascontiguousarray(state, dtype=np.float32)
     return (base64.b64encode(arr.tobytes()).decode("ascii"),
             [int(s) for s in arr.shape])
@@ -67,8 +68,10 @@ def state_from_wire(state_b64: str, state_shape) -> np.ndarray:
         shape = tuple(int(s) for s in state_shape)
     except (TypeError, ValueError) as e:
         raise ValueError(f"bad resume state_shape {state_shape!r}") from e
-    if len(shape) != 3 or min(shape) < 1:
-        raise ValueError(f"resume state must be (C, H, W), got {shape}")
+    if len(shape) not in (3, 4) or min(shape) < 1:
+        raise ValueError(
+            f"resume state must be (C, H, W) or rank-3 (F, D, H, W), "
+            f"got {shape}")
     try:
         raw = base64.b64decode(state_b64)
     except (TypeError, ValueError) as e:

@@ -113,12 +113,14 @@ class WorkPricer:
     def _cache_key(body: dict, converge: bool) -> tuple:
         fields = ("rows", "cols", "mode", "filter", "iters", "backend",
                   "storage", "fuse", "boundary", "quantize", "solver",
-                  "max_iters", "mg_levels")
+                  "max_iters", "mg_levels", "depth")
         return (converge,) + tuple(repr(body.get(k)) for k in fields)
 
     def _price_uncached(self, body: dict, converge: bool) -> float:
         from parallel_convolution_tpu.ops.filters import get_filter
 
+        if str(body.get("mode") or "") == "volume":
+            return self._price_volume(body, converge)
         rows = max(1, int(body.get("rows", 1)))
         cols = max(1, int(body.get("cols", 1)))
         channels = 3 if body.get("mode") == "rgb" else 1
@@ -159,3 +161,32 @@ class WorkPricer:
             backend, storage, fuse, None, shape, block_hw, self.grid,
             filt.size, filt.separable() is not None, quantize, self.hw)
         return spp * px * iters / n_dev
+
+    def _price_volume(self, body: dict, converge: bool) -> float:
+        """Rank-3 bodies (``mode="volume"``): predicted device-seconds
+        through the rank-3 roofline — ``rows``/``cols`` are the (H, W)
+        plane, ``depth`` the resident D axis, cells counted over the
+        two live fields."""
+        from parallel_convolution_tpu.utils.config import (
+            VOLUME_FIELDS, VOLUME_RADII,
+        )
+
+        rows = max(1, int(body.get("rows", 1)))
+        cols = max(1, int(body.get("cols", 1)))
+        depth = max(1, int(body.get("depth", 1)))
+        name = str(body.get("filter") or "fd7")
+        radius = VOLUME_RADII.get(name, 1)
+        try:
+            fuse = max(1, int(body.get("fuse") or 1))
+        except (TypeError, ValueError):
+            fuse = 1
+        R, Q = self.grid
+        block_hw = (max(1, -(-rows // R)), max(1, -(-cols // Q)))
+        n_dev = R * Q
+        cells = VOLUME_FIELDS * depth * rows * cols
+        iters = (max(1, int(body.get("max_iters", 500))) if converge
+                 else max(1, int(body.get("iters", 1))))
+        spc = costmodel.predict_volume_seconds_per_cell_iter(
+            self.grid, block_hw, depth, radius, fuse, name, self.hw,
+            fields=VOLUME_FIELDS)
+        return spc * cells * iters / n_dev

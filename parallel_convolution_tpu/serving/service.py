@@ -66,9 +66,17 @@ class Request:
     image contract.  ``deadline_s`` is a relative latency budget; a
     request still queued past it is shed with ``Rejected("deadline")``
     rather than served late.
+
+    A RANK-3 request (round 23) sets ``volume`` instead of ``image``: a
+    (2, D, H, W) float32 two-field volume (``utils.config.VOLUME_FIELDS``
+    interleaved fields — (u, f) for the FD smoothers, (u, u_prev) for
+    wave, (U, V) for Gray–Scott), with ``filter_name`` naming a
+    registered rank-3 form.  Volume responses carry the float32 fields
+    (never u8) and ride the same admission, batching, caching and
+    progressive machinery.
     """
 
-    image: np.ndarray
+    image: np.ndarray | None = None
     filter_name: str = "blur3"
     iters: int = 1
     backend: str = "shifted"         # or "auto": plan-cache/cost-model
@@ -98,6 +106,9 @@ class Request:
     #                                  "multigrid" as invalid — there is
     #                                  no fixed-count V-cycle workload)
     mg_levels: int | None = None     # multigrid level-count cap
+    volume: np.ndarray | None = None  # rank-3 payload: (2, D, H, W)
+    #                                  float32 fields (mutually exclusive
+    #                                  with ``image``)
 
 
 @dataclasses.dataclass
@@ -417,6 +428,10 @@ class ConvolutionService:
             raise ValueError(
                 f"solver={req.solver!r} is only valid for convergence "
                 "jobs (/v1/converge); the batch path is solver-less")
+        if req.volume is not None:
+            return self._validate_volume(req)
+        if req.image is None:
+            raise ValueError("request carries neither image nor volume")
         img = np.asarray(req.image)
         if img.dtype != np.uint8 or img.ndim not in (2, 3) or (
                 img.ndim == 3 and img.shape[-1] != 3):
@@ -446,6 +461,59 @@ class ConvolutionService:
                 planar.shape[1] % R or planar.shape[2] % C):
             raise ValueError(
                 "periodic boundary requires grid-divisible dimensions")
+        return key, plan_source, planar
+
+    def _validate_volume(self, req: Request) -> tuple[EngineKey, str,
+                                                      np.ndarray]:
+        """The rank-3 arm of :meth:`_validate`: ``planar`` is the
+        (2, D, H, W) float32 volume itself.  Quantize/storage are
+        CLAMPED (volumes are float fields — the u8 knobs have no rank-3
+        meaning, so every spelling of a volume request shares one key
+        rather than shedding on an inapplicable default)."""
+        from parallel_convolution_tpu.utils.config import (
+            VOLUME_FIELDS, VOLUME_RADII,
+        )
+
+        if req.image is not None:
+            raise ValueError("request carries both image and volume")
+        vol = np.asarray(req.volume)
+        if vol.ndim != 4 or vol.shape[0] != VOLUME_FIELDS:
+            raise ValueError(
+                f"volume must be ({VOLUME_FIELDS}, D, H, W) float32, "
+                f"got shape {vol.shape}")
+        if vol.dtype != np.float32:
+            raise ValueError(
+                f"volume must be float32, got {vol.dtype}")
+        if req.solver != "jacobi":
+            raise ValueError(
+                "rank-3 convergence is the chunked-jacobi driver; "
+                f"solver={req.solver!r} is rank-2 only")
+        planar = np.ascontiguousarray(vol, dtype=np.float32)
+        D, H, W = planar.shape[1:]
+        key, plan_source = self.engine.resolve_key(
+            (D, H, W), rank=3, filter_name=req.filter_name,
+            storage="f32", iters=int(req.iters),
+            fuse=1 if req.fuse is None else int(req.fuse),
+            boundary=req.boundary, quantize=False, backend=req.backend,
+            overlap=req.overlap, col_mode=req.col_mode,
+            solver=req.solver)
+        key.validate()
+        r = VOLUME_RADII[key.filter_name]
+        R, C = key.grid
+        if min(-(-H // R), -(-W // C)) < r * key.fuse:
+            raise ValueError(
+                f"per-device block smaller than radius*fuse "
+                f"({r}*{key.fuse}) for volume plane ({H}, {W}) on grid "
+                f"{key.grid}")
+        if key.boundary == "periodic":
+            if H % R or W % C:
+                raise ValueError(
+                    "periodic boundary requires grid-divisible "
+                    "dimensions")
+            if D < r * key.fuse:
+                raise ValueError(
+                    f"periodic depth wrap needs D >= radius*fuse "
+                    f"({r}*{key.fuse}), got D={D}")
         return key, plan_source, planar
 
     def submit(self, req: Request, wait: bool = True,
@@ -575,20 +643,29 @@ class ConvolutionService:
                 self._bump("cache_misses")
             deadline_at = (time.monotonic() + req.deadline_s
                            if req.deadline_s is not None else None)
+            if key.rank == 3:
+                price_body = {
+                    "rows": planar.shape[2], "cols": planar.shape[3],
+                    "depth": planar.shape[1], "mode": "volume",
+                    "filter": key.filter_name, "iters": key.iters,
+                    "fuse": key.fuse, "boundary": key.boundary}
+            else:
+                price_body = {
+                    "rows": planar.shape[1], "cols": planar.shape[2],
+                    "mode": "rgb" if req.image.ndim == 3 else "grey",
+                    "filter": key.filter_name, "iters": key.iters,
+                    "backend": key.backend, "storage": key.storage,
+                    "fuse": key.fuse, "boundary": key.boundary,
+                    "quantize": key.quantize}
             payload = {"planar": planar, "rid": rid,
-                       "rgb": req.image.ndim == 3,
+                       "rgb": (key.rank == 2 and req.image.ndim == 3),
+                       "rank": key.rank,
                        "digest": digest, "ckey": ckey,
                        "backend": req.backend, "plan_source": plan_source,
                        # Predicted device-seconds: the batcher's lane-
                        # priority input (cheap lanes flush first when
                        # several are due — anti head-of-line-blocking).
-                       "cost_units": self.pricer.price({
-                           "rows": planar.shape[1], "cols": planar.shape[2],
-                           "mode": "rgb" if req.image.ndim == 3 else "grey",
-                           "filter": key.filter_name, "iters": key.iters,
-                           "backend": key.backend, "storage": key.storage,
-                           "fuse": key.fuse, "boundary": key.boundary,
-                           "quantize": key.quantize}),
+                       "cost_units": self.pricer.price(price_body),
                        # The context the worker thread re-enters: queue
                        # span parent, batch-span link, response trace_id.
                        "trace": root}
@@ -756,15 +833,25 @@ class ConvolutionService:
                     effective_backend=info["effective_backend"],
                     plan_key=info.get("plan_key", ""))
             phases = dict(info["phases"])
-            u8 = np.clip(np.rint(out), 0.0, 255.0).astype(np.uint8)
+            if key.rank == 3:
+                # Volumes are float fields: no u8 quantization, no
+                # interleave — the (2, D, H, W) f32 block IS the
+                # response body.  Rank-3 lanes are exact-key (bucket_key
+                # identity), so the engine already cropped.
+                u8 = None
+            else:
+                u8 = np.clip(np.rint(out), 0.0, 255.0).astype(np.uint8)
             for i, it in enumerate(live):
-                # Crop back to the item's own geometry: a mixed-lane
-                # flush executed at the bucket extent; the pad margin is
-                # throwaway by the bucket_key invariant.
-                h0, w0 = it.payload["planar"].shape[1:]
-                plane = u8[i][:, :h0, :w0]
-                image = (imageio.planar_to_interleaved(plane)
-                         if it.payload["rgb"] else plane[0])
+                if key.rank == 3:
+                    image = np.ascontiguousarray(out[i], dtype=np.float32)
+                else:
+                    # Crop back to the item's own geometry: a mixed-lane
+                    # flush executed at the bucket extent; the pad margin
+                    # is throwaway by the bucket_key invariant.
+                    h0, w0 = it.payload["planar"].shape[1:]
+                    plane = u8[i][:, :h0, :w0]
+                    image = (imageio.planar_to_interleaved(plane)
+                             if it.payload["rgb"] else plane[0])
                 queue_s = start - it.enqueued_at
                 per = {"queue": round(queue_s, 6),
                        **{k: round(v, 6) for k, v in phases.items()},
@@ -1041,11 +1128,16 @@ class ConvolutionService:
         """The admitted job's generator (runs on the CONSUMER's thread)."""
         from parallel_convolution_tpu.utils import imageio
 
-        rgb = np.asarray(req.image).ndim == 3
+        rgb = (key.rank == 2
+               and np.asarray(req.image).ndim == 3)
         grid = f"{key.grid[0]}x{key.grid[1]}"
         tid = root.trace_id if root is not None else ""
 
         def to_u8(plane):
+            if key.rank == 3:
+                # Volumes stream as float fields: the (2, D, H, W) f32
+                # block passes through untouched (no u8, no interleave).
+                return np.ascontiguousarray(plane, dtype=np.float32)
             u8 = np.clip(np.rint(plane), 0.0, 255.0).astype(np.uint8)
             return imageio.planar_to_interleaved(u8) if rgb else u8[0]
 

@@ -55,8 +55,8 @@ from parallel_convolution_tpu.ops import conv
 from parallel_convolution_tpu.ops.filters import get_filter
 from parallel_convolution_tpu.parallel import halo, kernels as kernel_forms
 
-__all__ = ["FW_FILTER", "build_prolong_bilinear", "build_restrict_fw",
-           "coarse_extent"]
+__all__ = ["FW_FILTER", "build_prolong_bilinear", "build_prolong_trilinear",
+           "build_restrict_fw", "build_restrict_fw3", "coarse_extent"]
 
 # Full weighting IS the /16 pyramid stencil — the registry's blur3 taps.
 FW_FILTER = get_filter("blur3")
@@ -171,6 +171,129 @@ def build_prolong_bilinear(grid, valid_hw, block_hw, boundary: str = "zero"):
     return prolong
 
 
+# -- rank 3 (round 23): the same operators, one more axis ------------------
+# Full weighting stays the separable [1/4, 1/2, 1/4] tensor product and
+# trilinear prolongation its adjoint; the centering/extent contract is
+# UNCHANGED per axis (odd-centered zero, even-centered periodic).  The
+# depth axis is RESIDENT (volumes/halo3), so its coarsening needs no
+# shard_map uniformity: blocks carry depth/2 coarse planes with the
+# beyond-``coarse_extent`` tail masked to zero, exactly the rule the
+# sharded H/W axes follow via the global-coordinate mask.
+
+_FW_TAPS = (0.25, 0.5, 0.25)
+
+
+def _check_even_block3(depth: int, block_hw, op: str) -> None:
+    _check_even_block(block_hw, op)
+    if int(depth) % 2:
+        raise ValueError(
+            f"{op} needs an even depth (coarse-aligned planes), got "
+            f"D={depth}")
+
+
+def _interleave(a, b, axis: int):
+    """Alternate a/b along ``axis``: out[2i] = a[i], out[2i+1] = b[i]."""
+    stacked = jnp.stack([a, b], axis=axis + 1)
+    shape = list(a.shape)
+    shape[axis] *= 2
+    return stacked.reshape(shape)
+
+
+def _fw_axis(p, axis: int):
+    """One [1/4, 1/2, 1/4] smoothing pass along ``axis`` of a padded
+    (F, ...) array — consumes that axis's depth-1 ghost."""
+    n = p.shape[axis]
+    lo = [slice(None)] * p.ndim
+    cc = [slice(None)] * p.ndim
+    hi = [slice(None)] * p.ndim
+    lo[axis], cc[axis], hi[axis] = (
+        slice(0, n - 2), slice(1, n - 1), slice(2, n))
+    return (_FW_TAPS[0] * p[tuple(lo)] + _FW_TAPS[1] * p[tuple(cc)]
+            + _FW_TAPS[2] * p[tuple(hi)])
+
+
+def build_restrict_fw3(grid, depth: int, valid_hw, block_hw,
+                       boundary: str = "zero"):
+    """Per-block rank-3 full weighting ``(F, D, bh, bw) → (F, D/2,
+    bh/2, bw/2)`` for use inside ``shard_map`` on the fine level's mesh.
+
+    One depth-1 6-face exchange (``volumes.halo3``), three separable FW
+    passes (the 3×3×3 tensor-product stencil), the centering subsample
+    per axis, then the masks: the coarse (H, W) validity mask rank 2
+    uses, plus a LOCAL depth mask zeroing coarse planes beyond
+    ``coarse_extent(D)`` (the resident axis has no pad-to-multiple rim,
+    but the odd-centered zero coarsening still drops the last plane of
+    an even depth one fine cell inside the boundary).
+    """
+    from parallel_convolution_tpu.volumes import halo3
+    from parallel_convolution_tpu.volumes.forms import _valid_mask3
+
+    _check_even_block3(depth, block_hw, "restrict_fw(rank 3)")
+    periodic = boundary == "periodic"
+    cvalid = (coarse_extent(valid_hw[0], boundary),
+              coarse_extent(valid_hw[1], boundary))
+    cblock = (block_hw[0] // 2, block_hw[1] // 2)
+    cdepth, cvalid_d = int(depth) // 2, coarse_extent(depth, boundary)
+    needs_mask = not periodic and (
+        cvalid[0] != cblock[0] * grid[0] or cvalid[1] != cblock[1] * grid[1])
+    off = 0 if periodic else 1
+
+    def restrict(v):
+        p = halo3.volume_halo_exchange(v, 1, grid, boundary)
+        for axis in (1, 2, 3):
+            p = _fw_axis(p, axis)
+        c = p[:, off::2, off::2, off::2]
+        if needs_mask:
+            c = c * _valid_mask3(cvalid, cblock).astype(c.dtype)
+        if cvalid_d < cdepth:
+            dmask = (jnp.arange(cdepth) < cvalid_d).astype(c.dtype)
+            c = c * dmask[None, :, None, None]
+        return c.astype(v.dtype)
+
+    return restrict
+
+
+def build_prolong_trilinear(grid, depth: int, valid_hw, block_hw,
+                            boundary: str = "zero"):
+    """Per-block trilinear prolongation ``(F, D/2, bh/2, bw/2) → (F, D,
+    bh, bw)`` on the FINE level's mesh — three interleave passes over
+    the depth-1 6-face-padded coarse block, one per axis, each the
+    rank-2 centering rule verbatim (odd-centered zero reads the coarse
+    ghost as 0 — the fine boundary line; even-centered periodic
+    wraps)."""
+    from parallel_convolution_tpu.volumes import halo3
+    from parallel_convolution_tpu.volumes.forms import _valid_mask3
+
+    _check_even_block3(depth, block_hw, "prolong_trilinear")
+    periodic = boundary == "periodic"
+    m = (int(depth) // 2, block_hw[0] // 2, block_hw[1] // 2)
+    needs_mask = not periodic and (
+        valid_hw[0] != block_hw[0] * grid[0]
+        or valid_hw[1] != block_hw[1] * grid[1])
+
+    def prolong(c):
+        p = halo3.volume_halo_exchange(c, 1, grid, boundary)
+        for axis in (1, 2, 3):
+            n = m[axis - 1]
+            sl_a = [slice(None)] * 4
+            sl_b = [slice(None)] * 4
+            if periodic:
+                # Even-centered: fine 2k = coarse k; 2k+1 = mean(k, k+1).
+                sl_a[axis], sl_b[axis] = slice(1, n + 1), slice(2, n + 2)
+                a, b = p[tuple(sl_a)], p[tuple(sl_b)]
+                p = _interleave(a, (a + b) * 0.5, axis)
+            else:
+                # Odd-centered: fine 2k+1 = coarse k; 2k = mean(k-1, k).
+                sl_a[axis], sl_b[axis] = slice(0, n), slice(1, n + 1)
+                a, b = p[tuple(sl_a)], p[tuple(sl_b)]
+                p = _interleave((a + b) * 0.5, b, axis)
+        if needs_mask:
+            p = p * _valid_mask3(valid_hw, block_hw).astype(p.dtype)
+        return p.astype(c.dtype)
+
+    return prolong
+
+
 def _register_transfer_forms() -> None:
     from parallel_convolution_tpu.utils.config import BOUNDARIES
 
@@ -182,6 +305,14 @@ def _register_transfer_forms() -> None:
         name="prolong_bilinear", rank=2, stencil_form="prolong",
         boundaries=tuple(BOUNDARIES), overlap_capable=False,
         build=build_prolong_bilinear))
+    kernel_forms.register(kernel_forms.KernelForm(
+        name="restrict_fw", rank=3, stencil_form="restrict",
+        boundaries=tuple(BOUNDARIES), overlap_capable=False,
+        build=build_restrict_fw3))
+    kernel_forms.register(kernel_forms.KernelForm(
+        name="prolong_trilinear", rank=3, stencil_form="prolong",
+        boundaries=tuple(BOUNDARIES), overlap_capable=False,
+        build=build_prolong_trilinear))
 
 
 _register_transfer_forms()

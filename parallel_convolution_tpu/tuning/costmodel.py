@@ -410,6 +410,65 @@ def predict_gpx_per_chip(seconds_per_px_iter: float) -> float:
     return 1.0 / (seconds_per_px_iter * 1e9)
 
 
+# -- rank-3 volumes (round 23) ---------------------------------------------
+# Per-axis star taps of one registered rank-3 form application: the FD
+# smoothers touch 6r neighbors + rhs + diagonal scale; the physics forms
+# are 7-point-Laplacian updates with a handful of pointwise reaction
+# terms.  A jax-free mirror of volumes.forms (drift-guarded in
+# tests/test_volumes.py).
+VOLUME_FORM_TAPS = {
+    "fd7": 8, "fd7_stack": 8, "fd25": 26, "fd25_stack": 26,
+    "wave": 10, "grayscott": 24,
+}
+
+
+def volume_bytes_per_cell_iter(storage: str = "f32",
+                               fields: int = 2) -> float:
+    """Predicted HBM bytes per CELL (one field-pair grid point) per
+    iteration of a rank-3 form.
+
+    The volume path is the XLA shifted-add tier generalized by one axis:
+    the 6-face ghost pad is materialized (read + write), the padded
+    block is streamed once and the output written once — the same 4B
+    accounting as the rank-2 XLA tiers, times the live fields.
+    Fuse-invariant for the same reason rank 2 is: fusion saves
+    collective rounds, not HBM traffic."""
+    return 4.0 * STORAGE_BYTES[storage] * max(1, int(fields))
+
+
+def predict_volume_seconds_per_cell_iter(
+        grid: tuple[int, int], block_hw: tuple[int, int], depth: int,
+        radius: int, fuse: int, name: str, hw: HardwareModel,
+        fields: int = 2, storage: str = "f32") -> float:
+    """Roofline time per cell-iteration of one rank-3 form.
+
+    ``max(bandwidth, compute) + exchange``: bytes from
+    :func:`volume_bytes_per_cell_iter`, FMA slots from
+    :data:`VOLUME_FORM_TAPS`, and the exchange term priced through the
+    rank-2 slab arithmetic at an effective channel count of
+    ``fields * (depth + 2d)`` — the ±H/±W face slabs carry the whole
+    depth-padded column (the ±D faces are a local pad, zero bytes), so
+    a volume's face bytes ARE the rank-2 formula at that channel width.
+    """
+    T = max(1, int(fuse))
+    d = radius * T
+    depth = max(1, int(depth))
+    bh, bw = block_hw
+    cells = max(1, depth * bh * bw)
+    t_hbm = volume_bytes_per_cell_iter(storage, fields) / (hw.hbm_gbps * 1e9)
+    taps = VOLUME_FORM_TAPS.get(name, 8)
+    t_flop = 2.0 * taps * max(1, int(fields)) / (hw.flop_gops * 1e9)
+    t_roof = max(t_hbm, t_flop)
+    if grid[0] * grid[1] == 1:
+        return t_roof
+    B = STORAGE_BYTES[storage]
+    ch = max(1, int(fields)) * (depth + 2 * d)
+    slab_bytes = ch * d * (2.0 * bw + 2.0 * (bh + 2 * d)) * B
+    per_round = (2.0 * hw.exchange_lat_s + 2.0 * EXCHANGE_SETUP_S
+                 + slab_bytes / (hw.ici_gbps * 1e9))
+    return t_roof + per_round / (T * cells)
+
+
 def predict_vcycle_seconds(
         terms: list[tuple[float, int, int]]) -> float:
     """Price of one multigrid V-cycle: the SUM of its per-level sweeps.

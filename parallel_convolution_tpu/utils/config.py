@@ -32,6 +32,23 @@ BOUNDARIES = ("zero", "periodic")
 # wire schema share one source.
 SOLVERS = ("jacobi", "multigrid")
 
+# Rank-3 volumetric registries (round 23).  The kernel-form registry
+# keys rank-3 programs by (3, name, boundary); these jax-free tuples are
+# the canonical name sets the CLI, serving validation, and the pinned
+# key-set test all read.  ``smooth`` forms are Jacobi relaxations a
+# converge loop may drive; ``physics`` forms are time-dependent
+# integrators (fixed-step only).  Every rank-3 form carries TWO fields
+# stacked leading: (u, f) for the FD forms, (u, u_prev) for wave,
+# (U, V) for Gray–Scott.
+RANKS = (2, 3)
+VOLUME_SMOOTH_FORMS = ("fd7", "fd7_stack", "fd25", "fd25_stack")
+VOLUME_PHYSICS_FORMS = ("wave", "grayscott")
+VOLUME_FORMS = VOLUME_SMOOTH_FORMS + VOLUME_PHYSICS_FORMS
+VOLUME_FIELDS = 2
+# Ghost radius per rank-3 form (fd25 is the 8th-order star).
+VOLUME_RADII = {"fd7": 1, "fd7_stack": 1, "fd25": 4, "fd25_stack": 4,
+                "wave": 1, "grayscott": 1}
+
 # Column-slab transports of the RDMA kernels (round 16, the
 # derived-datatypes A/B): "packed" stages the strided slab through a
 # contiguous buffer and moves ONE dense RDMA; "strided" issues the
@@ -56,6 +73,9 @@ class RunConfig:
 
     rows: int
     cols: int
+    rank: int = 2                  # 2 = planar (C, H, W); 3 = volume
+    #                                (F, D, H, W) through volumes/
+    depth: int | None = None       # D extent (rank 3 only)
     mode: str = "grey"            # grey | rgb
     filter_name: str = "blur3"
     iters: int = 100
@@ -88,6 +108,22 @@ class RunConfig:
     #                         compile/launch failure (resilience.degrade)
 
     def __post_init__(self) -> None:
+        if self.rank not in RANKS:
+            raise ValueError(f"rank must be one of {RANKS}, got {self.rank}")
+        if self.rank == 3:
+            if self.depth is None or int(self.depth) < 1:
+                raise ValueError(
+                    f"rank=3 needs a positive depth, got {self.depth}")
+            if self.filter_name not in VOLUME_FORMS:
+                raise ValueError(
+                    f"rank-3 form must be one of {VOLUME_FORMS}, got "
+                    f"{self.filter_name!r}")
+            if self.quantize or self.storage != "f32":
+                raise ValueError(
+                    "rank=3 runs float carries: quantize=False, "
+                    "storage='f32'")
+        elif self.depth is not None:
+            raise ValueError("depth is a rank-3 knob (set rank=3)")
         if self.mode not in ("grey", "rgb"):
             raise ValueError(f"mode must be grey|rgb, got {self.mode!r}")
         if self.storage not in STORAGES:

@@ -1,0 +1,27 @@
+"""Rank-3 volumetric subsystem: (D, H, W) volumes on the 2D mesh.
+
+Round 23.  The kernel-form registry (``parallel/kernels.py``) was built
+so rank-3 workloads "register without touching dispatch" — this package
+is that claim cashed in:
+
+* ``halo3``  — 6-face ghost exchange for (F, D, h, w) blocks.  The mesh
+  stays 2D ('x', 'y') and shards (H, W); the depth axis D rides WHOLE on
+  every device, so its two faces are a local pad (zeros or wrap) and the
+  ±H/±W faces reuse ``parallel.halo.halo_pad_axis`` — the exact slab
+  machinery rank 2 exchanges through, one extra leading dim.
+* ``forms``  — the rank-3 kernel forms, registered under
+  ``(3, name, boundary)`` keys: 7-point and 25-point (8th-order star)
+  FD Laplacian Jacobi relaxations (each with a ``_stack`` twin — the
+  same fixed-order arithmetic through a different XLA program, the
+  byte-identity proof pair), plus two time-dependent ``physics`` forms
+  (wave leapfrog, Gray–Scott reaction–diffusion), every one carrying
+  TWO stacked fields.
+* ``driver`` — the sharded entry points (prepare / iterate / converge
+  stream), mirroring ``parallel/step.py``'s shard_map + temporal-fusion
+  schedule for rank 3.
+* ``oracle3`` — an INDEPENDENT numpy oracle (np.pad/np.roll, float64
+  accumulation) the tests and the volume smoke compare against.
+
+Zero new dispatch ladders: everything resolves through
+``kernels.resolve(3, name, boundary)``.
+"""

@@ -1,0 +1,237 @@
+"""Sharded rank-3 drivers: prepare / iterate / converge on volumes.
+
+The rank-3 twin of ``parallel/step.py``'s entry layer, kept deliberately
+thin: every stencil program comes out of the kernel-form registry
+(``kernels.resolve(3, name, boundary)`` — no backend ladder lives here),
+and the compiled artifacts mirror rank 2 exactly:
+
+* state is (F, D, H, W) float32, F interleaved fields (2 per volume,
+  ``2B`` for a folded batch), sharded ``P(None, None, 'x', 'y')`` — the
+  (H, W) plane on the mesh, D resident;
+* (H, W) pad to block multiples + per-level masking (the forms own the
+  mask rule); D never pads;
+* fixed-count iterate = fori_loop over fused chunks + remainder tail;
+* converge chunk = n−1 iterations (fused where legal) + ONE single step
+  forming the (prev, cur) pair, ``diff = pmax(max|cur − prev|)`` — the
+  same chunk math the serving stream and checkpoint/resume logic rely
+  on for byte-stable resumes.
+
+Compiled runners are ``lru_cache``d per (mesh, form, geometry, fuse),
+``jax.jit(donate_argnums=0)`` like every other runner in the tree.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from parallel_convolution_tpu.parallel import kernels as kernel_forms
+from parallel_convolution_tpu.parallel.mesh import (
+    AXES, grid_shape, make_grid_mesh, padded_extent,
+)
+from parallel_convolution_tpu.utils.config import VOLUME_RADII
+from parallel_convolution_tpu.utils.jax_compat import shard_map
+
+__all__ = ["converge_chunk_fn", "prepare_volume", "volume_converge",
+           "volume_converge_stream", "volume_iterate", "volume_sharding"]
+
+
+def volume_sharding(mesh: Mesh) -> NamedSharding:
+    """(F, D, H, W) over the 2D grid: P(None, None, 'x', 'y')."""
+    return NamedSharding(mesh, P(None, None, *AXES))
+
+
+def _geometry(state_shape, mesh: Mesh, boundary: str):
+    """(valid_hw, block_hw, padded_hw) of a (F, D, H, W) volume on
+    ``mesh`` — the one geometry rule (periodic must divide, zero pads
+    and masks), shared by every entry point."""
+    F, D, H, W = (int(s) for s in state_shape)
+    if F < 2 or F % 2:
+        raise ValueError(
+            f"rank-3 state carries interleaved field pairs: leading "
+            f"extent must be even >= 2, got {F}")
+    R, C = grid_shape(mesh)
+    if boundary == "periodic" and (H % R or W % C):
+        raise ValueError(
+            f"periodic volumes need grid-divisible extents: "
+            f"{H}x{W} on {R}x{C}")
+    Hp, Wp = padded_extent(H, R), padded_extent(W, C)
+    return (H, W), (Hp // R, Wp // C), (Hp, Wp)
+
+
+def prepare_volume(state, mesh: Mesh, boundary: str = "zero"):
+    """Pad a host (F, D, H, W) float32 volume to block multiples and
+    place it sharded; returns ``(device_state, valid_hw)``."""
+    state = jnp.asarray(state, jnp.float32)
+    if state.ndim != 4:
+        raise ValueError(
+            f"volume state must be (F, D, H, W), got {state.shape}")
+    valid_hw, _, (Hp, Wp) = _geometry(state.shape, mesh, boundary)
+    H, W = valid_hw
+    if (Hp, Wp) != (H, W):
+        state = jnp.pad(
+            state, ((0, 0), (0, 0), (0, Hp - H), (0, Wp - W)))
+    return jax.device_put(state, volume_sharding(mesh)), valid_hw
+
+
+def _resolve_step(name: str, boundary: str, grid, depth, valid_hw,
+                  block_hw, fuse: int):
+    """One per-block step through the registry — the ONLY dispatch."""
+    form = kernel_forms.resolve(3, name, boundary)
+    return form.build(grid, depth, valid_hw, block_hw, fuse, boundary)
+
+
+def _check_fuse(name: str, block_hw, fuse: int) -> None:
+    # Unknown names fall through (radius 1): resolution raises the
+    # registry's typed error naming the registered forms, not a KeyError.
+    d = VOLUME_RADII.get(name, 1) * max(1, int(fuse))
+    if min(block_hw) < d:
+        raise ValueError(
+            f"fuse={fuse} needs blocks >= {d} for form {name!r}, got "
+            f"{block_hw}")
+
+
+@lru_cache(maxsize=64)
+def _build_volume_iterate(mesh: Mesh, name: str, iters: int, depth: int,
+                          valid_hw, block_hw, fuse: int, boundary: str):
+    """Compile the fixed-count volume runner for one (mesh, config)."""
+    grid = grid_shape(mesh)
+    fuse = max(1, min(int(fuse), iters or 1))
+    _check_fuse(name, block_hw, fuse)
+    chunk = _resolve_step(name, boundary, grid, depth, valid_hw,
+                          block_hw, fuse)
+    n_chunks, rem = divmod(int(iters), fuse)
+    tail = (_resolve_step(name, boundary, grid, depth, valid_hw,
+                          block_hw, rem) if rem else None)
+
+    def body(block):
+        block = lax.fori_loop(0, n_chunks, lambda _, v: chunk(v), block)
+        if tail is not None:
+            block = tail(block)
+        return block
+
+    sharded = shard_map(
+        body, mesh=mesh, in_specs=P(None, None, *AXES),
+        out_specs=P(None, None, *AXES),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=0)
+
+
+@lru_cache(maxsize=64)
+def _build_volume_converge_chunk(mesh: Mesh, name: str, n: int,
+                                 depth: int, valid_hw, block_hw,
+                                 fuse: int, boundary: str):
+    """Compile ONE volume convergence chunk: ``n`` iterations + the
+    (prev, cur) max-abs diff — the same chunk math as rank 2's
+    ``_build_converge_chunk``, so host-driven chunk loops (the serving
+    stream) resume byte-stably on check_every boundaries."""
+    grid = grid_shape(mesh)
+    fuse = max(1, min(int(fuse), max(1, n - 1)))
+    _check_fuse(name, block_hw, fuse)
+    step = _resolve_step(name, boundary, grid, depth, valid_hw,
+                         block_hw, 1)
+    fused = (_resolve_step(name, boundary, grid, depth, valid_hw,
+                           block_hw, fuse)
+             if fuse > 1 and n > 1 else None)
+
+    def body(block):
+        if fused is None:
+            prev = lax.fori_loop(0, n - 1, lambda _, v: step(v), block)
+        else:
+            prev = lax.fori_loop(0, (n - 1) // fuse,
+                                 lambda _, v: fused(v), block)
+            prev = lax.fori_loop(0, (n - 1) % fuse,
+                                 lambda _, v: step(v), prev)
+        cur = step(prev)
+        delta = jnp.abs(cur - prev)
+        diff = lax.pmax(jnp.max(delta), AXES)
+        return cur, diff
+
+    sharded = shard_map(
+        body, mesh=mesh, in_specs=P(None, None, *AXES),
+        out_specs=(P(None, None, *AXES), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=0)
+
+
+def converge_chunk_fn(mesh: Mesh, name: str, n: int, depth: int,
+                      valid_hw, block_hw, fuse: int, boundary: str):
+    """Public cached-compile surface for chunk drivers (the serving
+    engine): ``fn(xs) -> (xs, diff)``."""
+    return _build_volume_converge_chunk(
+        mesh, str(name), int(n), int(depth), tuple(valid_hw),
+        tuple(block_hw), int(fuse), str(boundary))
+
+
+def _crop(state, valid_hw) -> np.ndarray:
+    H, W = valid_hw
+    return np.asarray(jax.device_get(state))[:, :, :H, :W]
+
+
+def volume_iterate(state, name: str, iters: int, *, mesh: Mesh | None = None,
+                   boundary: str = "zero", fuse: int = 1) -> np.ndarray:
+    """Run ``iters`` applications of rank-3 form ``name`` on a host
+    (F, D, H, W) volume; returns the host float32 result at the valid
+    extent.  The one-call CLI/test surface."""
+    mesh = mesh if mesh is not None else make_grid_mesh()
+    if int(iters) < 1:
+        return np.asarray(state, np.float32)
+    xs, valid_hw = prepare_volume(state, mesh, boundary)
+    _, block_hw, _ = _geometry(
+        (xs.shape[0], xs.shape[1], valid_hw[0], valid_hw[1]), mesh,
+        boundary)
+    fn = _build_volume_iterate(
+        mesh, str(name), int(iters), int(xs.shape[1]), valid_hw,
+        block_hw, int(fuse), str(boundary))
+    xs = fn(xs)
+    jax.block_until_ready(xs)
+    return _crop(xs, valid_hw)
+
+
+def volume_converge_stream(state, name: str, *, tol: float,
+                           max_iters: int, check_every: int = 10,
+                           mesh: Mesh | None = None,
+                           boundary: str = "zero", fuse: int = 1):
+    """Host-driven chunked convergence: yields ``(state, iters, diff)``
+    per chunk (state cropped to the valid extent, host float32), the
+    last yield being the converged/budget-exhausted field — rank 2's
+    ``sharded_converge_stream`` shape, for volumes."""
+    mesh = mesh if mesh is not None else make_grid_mesh()
+    xs, valid_hw = prepare_volume(state, mesh, boundary)
+    depth = int(xs.shape[1])
+    _, block_hw, _ = _geometry(
+        (xs.shape[0], depth, valid_hw[0], valid_hw[1]), mesh, boundary)
+    done = 0
+    check_every = max(1, int(check_every))
+    max_iters = max(1, int(max_iters))
+    while done < max_iters:
+        n = min(check_every, max_iters - done)
+        fn = converge_chunk_fn(mesh, name, n, depth, valid_hw, block_hw,
+                               fuse, boundary)
+        xs, d = fn(xs)
+        done += n
+        diff = float(jax.device_get(d))
+        yield _crop(xs, valid_hw), done, diff
+        if diff < tol:
+            return
+
+
+def volume_converge(state, name: str, *, tol: float, max_iters: int,
+                    check_every: int = 10, mesh: Mesh | None = None,
+                    boundary: str = "zero", fuse: int = 1):
+    """The terminal row of :func:`volume_converge_stream`:
+    ``(state, iters, diff)``."""
+    out = None
+    for out in volume_converge_stream(
+            state, name, tol=tol, max_iters=max_iters,
+            check_every=check_every, mesh=mesh, boundary=boundary,
+            fuse=fuse):
+        pass
+    return out

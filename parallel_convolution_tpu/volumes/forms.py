@@ -1,0 +1,278 @@
+"""Rank-3 kernel forms: FD Laplacian smoothers + time-dependent physics.
+
+Every rank-3 form operates on a block of TWO stacked fields — the field
+contract (DESIGN.md "Volumetric workloads"):
+
+* ``fd7`` / ``fd25``       — ``(u, f)``: damped-Jacobi relaxation of the
+  discrete Poisson problem ``-∇²u = f`` (``f`` pre-scaled by ``h²`` by
+  the caller; the forms are spacing-free).  ``fd7`` is the classic
+  7-point star; ``fd25`` the 8th-order 25-point star (3 axes × 8
+  off-center taps + center) — the wafer-scale stencil paper's marquee
+  kernel.  Per-axis taps at distance k: ``8/5, -1/5, 8/315, -1/560``;
+  diagonal ``3·205/72 = 205/24``.
+* ``wave``                 — ``(u, u_prev)``: 2nd-order leapfrog of the
+  wave equation, ``u_next = 2u - u_prev + c²dt²·∇²₇u``.
+* ``grayscott``            — ``(U, V)``: Gray–Scott reaction–diffusion,
+  two coupled fields through the 7-point Laplacian.
+
+``fd7_stack`` / ``fd25_stack`` are byte-identity proof twins: the SAME
+weighted terms accumulated in the SAME fixed order, but routed through a
+``jnp.stack`` + re-slice — a genuinely different XLA program that must
+(and does — gated by scripts/volume_smoke.py) produce identical bytes.
+
+Fields arrive INTERLEAVED on the leading axis — ``(2B, D, h, w)`` with
+field k of batch item b at index ``2b + k`` — so a batched volume folds
+to one shard_map call exactly like rank 2's channel fold, and the forms
+vectorize over the batch for free (``p[0::2]`` / ``p[1::2]``).
+
+The build contract (owned by this module, resolved through the
+registry): ``build(grid, depth, valid_hw, block_hw, fuse, boundary) ->
+step``, where ``step`` maps one device's UNPADDED (F, D, h, w) block to
+the next — one 6-face exchange at ghost depth ``radius*fuse``, then
+``fuse`` stencil applications with per-level re-masking (H/W through
+the rank-2 global-coordinate mask rule; the resident D ghost ring
+re-zeroed locally), exactly rank 2's temporal-fusion schedule.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+from jax import lax
+
+from parallel_convolution_tpu.parallel import kernels as kernel_forms
+from parallel_convolution_tpu.utils.config import (
+    VOLUME_PHYSICS_FORMS, VOLUME_RADII, VOLUME_SMOOTH_FORMS,
+)
+from parallel_convolution_tpu.volumes import halo3
+
+__all__ = ["FD25_COEFFS", "FD25_DIAG", "FD25_OMEGA", "FD7_COEFFS",
+           "FD7_DIAG",
+           "GS_PARAMS", "WAVE_C2DT2", "build_volume_step", "form_radius"]
+
+# Per-axis off-center taps at distance k = 1..r (Jacobi sign convention:
+# u_new = (f + Σ c_k · neighbors) / diag) and the star's diagonal.
+FD7_COEFFS = (1.0,)
+FD7_DIAG = 6.0
+FD25_COEFFS = (8.0 / 5.0, -1.0 / 5.0, 8.0 / 315.0, -1.0 / 560.0)
+FD25_DIAG = 205.0 / 24.0
+# The 25-point star's Jacobi damping: the mixed-sign taps sum (in
+# absolute phase) past the diagonal at high frequency, so the UNDAMPED
+# iteration diverges; ω = 0.8 bounds every mode below 1 while barely
+# touching the smooth-mode rate the convergence gate measures.
+FD25_OMEGA = 0.8
+
+# Wave leapfrog Courant factor c²dt²/h² — inside the 3D CFL bound (1/3).
+WAVE_C2DT2 = 0.2
+# Gray–Scott constants (Du, Dv, F, k, dt) — the classic "solitons" spot.
+GS_PARAMS = (0.16, 0.08, 0.060, 0.062, 1.0)
+
+
+def form_radius(name: str) -> int:
+    """Ghost radius of one application of a registered rank-3 form."""
+    return VOLUME_RADII[name]
+
+
+def _split(p):
+    """Interleaved fields of a (2B, ...) block → two (B, ...) views."""
+    return p[0::2], p[1::2]
+
+
+def _merge(a, b):
+    """Re-interleave two (B, ...) fields → (2B, ...)."""
+    return jnp.stack([a, b], axis=1).reshape((-1,) + tuple(a.shape[1:]))
+
+
+def _center(u, r):
+    """The interior crop of a padded (B, d, h, w) field at radius r."""
+    return (slice(None),) + tuple(slice(r, s - r) for s in u.shape[1:])
+
+
+def _star_views(u, r):
+    """Cropped shifted views of padded ``u`` in the canonical fixed
+    order — for k = 1..r, for axis (D, H, W): the −k then +k view.
+    Every consumer (plain and ``_stack``) accumulates in exactly this
+    order; the order IS the byte-identity contract."""
+    views = []
+    for k in range(1, r + 1):
+        for ax in (1, 2, 3):
+            lo = list(_center(u, r))
+            hi = list(_center(u, r))
+            lo[ax] = slice(r - k, u.shape[ax] - r - k)
+            hi[ax] = slice(r + k, u.shape[ax] - r + k)
+            views.append(u[tuple(lo)])
+            views.append(u[tuple(hi)])
+    return views
+
+
+def _fixed_sum(terms, stacked: bool):
+    """Left-to-right accumulation; the ``stacked`` arm routes the same
+    terms through one jnp.stack and re-slices — a different program, the
+    same adds in the same association."""
+    if stacked:
+        st = jnp.stack(terms)
+        s = st[0]
+        for i in range(1, len(terms)):
+            s = s + st[i]
+        return s
+    s = terms[0]
+    for t in terms[1:]:
+        s = s + t
+    return s
+
+
+def _jacobi_apply(p, r: int, coeffs, inv_diag, stacked: bool,
+                  omega=None):
+    """One damped-Jacobi sweep of an FD star: (u, f) interleaved, padded
+    by r on (D, H, W) → interleaved interior.
+
+    ``omega`` is the damping factor: ``u + ω(u_jacobi − u)``.  The
+    7-point star converges plain (ω absent: the historical bytes), but
+    the 8th-order star's mixed-sign taps put the undamped iteration
+    matrix above 1 at high frequency (|Σ taps(π)| > diag), so fd25
+    REQUIRES damping to be a convergent smoother — ω = 0.8 keeps every
+    Dirichlet mode strictly inside the unit circle."""
+    u, f = _split(p)
+    cc = _center(u, r)
+    terms = [f[cc]]
+    views = _star_views(u, r)
+    for k in range(1, r + 1):
+        c = coeffs[k - 1]
+        for i in range(6):
+            terms.append(c * views[(k - 1) * 6 + i])
+    s = _fixed_sum(terms, stacked)
+    if omega is None:
+        return _merge(s * inv_diag, f[cc])
+    return _merge(u[cc] + omega * (s * inv_diag - u[cc]), f[cc])
+
+
+def _lap7(u, cc):
+    """7-point Laplacian of a padded (B, d, h, w) field at its interior:
+    fixed-order neighbor sum minus 6·center."""
+    views = _star_views(u, 1)
+    s = views[0]
+    for v in views[1:]:
+        s = s + v
+    return s - 6.0 * u[cc]
+
+
+def _wave_apply(p):
+    """Leapfrog: (u, u_prev) → (2u − u_prev + c²dt²·∇²u, u)."""
+    u, v = _split(p)
+    cc = _center(u, 1)
+    u_next = (2.0 * u[cc] - v[cc]) + WAVE_C2DT2 * _lap7(u, cc)
+    return _merge(u_next, u[cc])
+
+
+def _gs_apply(p):
+    """Gray–Scott: (U, V) coupled through the 7-point Laplacian."""
+    du, dv, feed, kill, dt = GS_PARAMS
+    ua, va = _split(p)
+    cc = _center(ua, 1)
+    uc, vc = ua[cc], va[cc]
+    uvv = uc * vc * vc
+    u_new = uc + (du * _lap7(ua, cc) - uvv + feed * (1.0 - uc)) * dt
+    v_new = vc + (dv * _lap7(va, cc) + uvv - (feed + kill) * vc) * dt
+    return _merge(u_new, v_new)
+
+
+_APPLY = {
+    "fd7": functools.partial(_jacobi_apply, r=1, coeffs=FD7_COEFFS,
+                             inv_diag=jnp.float32(1.0 / FD7_DIAG),
+                             stacked=False),
+    "fd7_stack": functools.partial(_jacobi_apply, r=1, coeffs=FD7_COEFFS,
+                                   inv_diag=jnp.float32(1.0 / FD7_DIAG),
+                                   stacked=True),
+    "fd25": functools.partial(_jacobi_apply, r=4, coeffs=FD25_COEFFS,
+                              inv_diag=jnp.float32(1.0 / FD25_DIAG),
+                              stacked=False,
+                              omega=jnp.float32(FD25_OMEGA)),
+    "fd25_stack": functools.partial(_jacobi_apply, r=4,
+                                    coeffs=FD25_COEFFS,
+                                    inv_diag=jnp.float32(1.0 / FD25_DIAG),
+                                    stacked=True,
+                                    omega=jnp.float32(FD25_OMEGA)),
+    "wave": _wave_apply,
+    "grayscott": _gs_apply,
+}
+
+
+def _valid_mask3(valid_hw, block_hw, margin: int = 0):
+    """Rank-3 twin of ``step._valid_mask``: globally-in-volume cells of
+    one block's (H, W) plane as (1, 1, h+2m, w+2m) f32.  D never pads to
+    a multiple (it is resident), so depth needs no global mask — the
+    ghost-ring re-zero in the fused schedule handles its boundary."""
+    H, W = valid_hw
+    bh, bw = block_hw
+    m = int(margin)
+    row0 = lax.axis_index("x") * bh - m
+    col0 = lax.axis_index("y") * bw - m
+    shape = (bh + 2 * m, bw + 2 * m)
+    rows = row0 + lax.broadcasted_iota(jnp.int32, shape, 0)
+    cols = col0 + lax.broadcasted_iota(jnp.int32, shape, 1)
+    ok = (rows >= 0) & (rows < H) & (cols >= 0) & (cols < W)
+    return ok[None, None].astype(jnp.float32)
+
+
+def build_volume_step(name: str, grid, depth: int, valid_hw, block_hw,
+                      fuse: int, boundary: str):
+    """The registered build: one per-block step of form ``name``.
+
+    ``step`` maps (F, depth, bh, bw) → same shape: one 6-face exchange
+    at ghost depth ``radius*fuse``, then ``fuse`` applications with the
+    rank-2 re-masking rule per intermediate level — the H/W mask speaks
+    global coordinates (so the pad-to-multiple rim and the image edge
+    re-zero), and the resident D ghost ring re-zeroes by a local re-pad
+    (zero boundary only; periodic wraps exactly and never masks).
+    """
+    r = form_radius(name)
+    fuse = max(1, int(fuse))
+    d = r * fuse
+    bh, bw = (int(b) for b in block_hw)
+    depth = int(depth)
+    if bh < d or bw < d:
+        raise ValueError(
+            f"form {name!r} at fuse={fuse} needs ghost depth {d} <= "
+            f"block ({bh}, {bw}); shrink fuse or the mesh")
+    periodic = boundary == "periodic"
+    apply_fn = _APPLY[name]
+    needs_mask = (not periodic) and (
+        valid_hw[0] < bh * grid[0] or valid_hw[1] < bw * grid[1])
+
+    def step(block):
+        p = halo3.volume_halo_exchange(block, d, grid, boundary)
+        for t in range(fuse):
+            margin = d - r * (t + 1)
+            p = apply_fn(p)
+            if not periodic and (needs_mask or margin > 0):
+                p = p * _valid_mask3(valid_hw, (bh, bw), margin)
+                if margin > 0:
+                    # Re-impose the zero D faces on the shrinking ghost
+                    # ring (the temporal-fusion boundary rule, D arm).
+                    core = p[:, margin:margin + depth]
+                    p = jnp.pad(
+                        core, ((0, 0), (margin, margin), (0, 0), (0, 0)))
+        return p
+
+    return step
+
+
+def _register_volume_forms() -> None:
+    from parallel_convolution_tpu.utils.config import BOUNDARIES
+
+    for name in VOLUME_SMOOTH_FORMS:
+        kernel_forms.register(kernel_forms.KernelForm(
+            name=name, rank=3, stencil_form="smooth",
+            boundaries=tuple(BOUNDARIES), overlap_capable=False,
+            persistent_capable=False,
+            build=functools.partial(build_volume_step, name)))
+    for name in VOLUME_PHYSICS_FORMS:
+        kernel_forms.register(kernel_forms.KernelForm(
+            name=name, rank=3, stencil_form="physics",
+            boundaries=tuple(BOUNDARIES), overlap_capable=False,
+            persistent_capable=False,
+            build=functools.partial(build_volume_step, name)))
+
+
+_register_volume_forms()

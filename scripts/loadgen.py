@@ -104,7 +104,8 @@ def poisson_arrivals(rps: float, fire, *, duration_s: float | None = None,
 
 def _frames_profile(body: dict, img) -> tuple[dict, bytes]:
     """Split a JSON request body into ``(header, raw_frame_bytes)`` for
-    the binary wire: the image crosses as a typed frame, everything else
+    the binary wire: the tensor (u8 image, or f32 volume on a
+    ``mode: "volume"`` body) crosses as a typed frame, everything else
     stays in the envelope's JSON header.  The split is done ONCE per
     profile — per request the (tiny) header is restamped with its
     request_id and re-joined around the same frame bytes
@@ -112,8 +113,10 @@ def _frames_profile(body: dict, img) -> tuple[dict, bytes]:
     exists for."""
     from parallel_convolution_tpu.serving import frames as frames_mod
 
-    header = {k: v for k, v in body.items() if k != "image_b64"}
-    env = frames_mod.encode_envelope(dict(header), {"image": img})
+    tensor_key = "volume" if "volume_b64" in body else "image"
+    header = {k: v for k, v in body.items()
+              if k not in ("image_b64", "volume_b64")}
+    env = frames_mod.encode_envelope(dict(header), {tensor_key: img})
     fheader, raw = frames_mod.split_envelope(env)
     return fheader, bytes(raw)
 
@@ -402,6 +405,14 @@ def main() -> int:
     ap.add_argument("--rows", type=int, default=48)
     ap.add_argument("--cols", type=int, default=64)
     ap.add_argument("--mode", default="grey", choices=["grey", "rgb"])
+    ap.add_argument("--volume", default=None, metavar="DxHxW",
+                    help="rank-3 volume body mode: each request carries "
+                         "one seeded (2, D, H, W) float32 volume "
+                         "(mode: \"volume\" on the wire) instead of a "
+                         "u8 image — pair with a rank-3 --filter "
+                         "(fd7/fd25/wave/grayscott); overrides "
+                         "--rows/--cols/--mode and excludes "
+                         "--mixed-sizes/--zipf/--check")
     ap.add_argument("--filter", default="blur3", dest="filter_name")
     ap.add_argument("--iters", type=int, default=2)
     ap.add_argument("--backend", default="shifted")
@@ -489,16 +500,48 @@ def main() -> int:
 
     from parallel_convolution_tpu.utils import imageio
 
-    img = imageio.generate_test_image(args.rows, args.cols, args.mode,
-                                      seed=args.seed)
-    body = {
-        "image_b64": base64.b64encode(
-            np.ascontiguousarray(img).tobytes()).decode("ascii"),
-        "rows": args.rows, "cols": args.cols, "mode": args.mode,
-        "filter": args.filter_name, "iters": args.iters,
-        "backend": args.backend, "storage": args.storage,
-        "fuse": args.fuse, "boundary": args.boundary,
-    }
+    vol_shape = None
+    if args.volume is not None:
+        try:
+            vol_shape = tuple(int(v) for v in args.volume.split("x"))
+            if len(vol_shape) != 3 or min(vol_shape) < 1:
+                raise ValueError
+        except ValueError:
+            ap.error(f"--volume must be DxHxW positive ints, got "
+                     f"{args.volume!r}")
+        for flag, name in ((args.mixed_sizes, "--mixed-sizes"),
+                           (args.zipf is not None, "--zipf"),
+                           (args.check, "--check"),
+                           (args.warm, "--warm")):
+            if flag:
+                ap.error(f"--volume and {name} are exclusive (volumes "
+                         "are single-profile f32 bodies)")
+    if vol_shape is not None:
+        # Bounded [0, 1] fields: safe for every rank-3 form including
+        # Gray-Scott's cubic uvv term (unbounded data diverges).
+        D, H, W = vol_shape
+        rng = np.random.default_rng(args.seed)
+        img = np.ascontiguousarray(
+            rng.random((2, D, H, W), dtype=np.float32))
+        args.rows, args.cols = H, W
+        body = {
+            "volume_b64": base64.b64encode(img.tobytes()).decode("ascii"),
+            "rows": H, "cols": W, "depth": D, "mode": "volume",
+            "filter": args.filter_name, "iters": args.iters,
+            "backend": args.backend,
+            "fuse": args.fuse, "boundary": args.boundary,
+        }
+    else:
+        img = imageio.generate_test_image(args.rows, args.cols, args.mode,
+                                          seed=args.seed)
+        body = {
+            "image_b64": base64.b64encode(
+                np.ascontiguousarray(img).tobytes()).decode("ascii"),
+            "rows": args.rows, "cols": args.cols, "mode": args.mode,
+            "filter": args.filter_name, "iters": args.iters,
+            "backend": args.backend, "storage": args.storage,
+            "fuse": args.fuse, "boundary": args.boundary,
+        }
     if args.deadline_ms is not None:
         body["deadline_ms"] = args.deadline_ms
     if args.tenant:
@@ -820,6 +863,13 @@ def main() -> int:
     channels = 3 if args.mode == "rgb" else 1
     # Per-profile pixel areas: mixed-size runs account each completion
     # at ITS profile's size (selection is deterministic by index).
+    # Volume bodies account CELLS (2 fields x D x H x W) and their
+    # responses carry f32 (4 bytes/cell), not u8.
+    if vol_shape is not None:
+        channels = 2 * vol_shape[0]
+        elem_bytes = 4
+    else:
+        elem_bytes = 1
     area_of = [b["rows"] * b["cols"] for b, _ in profiles]
     ok_rows = [(i, r) for i, _, _, s, r in results
                if s == 200 and r.get("ok")]
@@ -831,7 +881,7 @@ def main() -> int:
     bad_bytes = sum(
         1 for i, r in ok_rows
         if len(base64.b64decode(r["image_b64"]))
-        != area_of[pick(i)] * channels)
+        != area_of[pick(i)] * channels * elem_bytes)
     non_rejected_failures = len(failures) + mismatches + bad_bytes
 
     lats = sorted(lat for lat, _ in completed)
@@ -883,14 +933,18 @@ def main() -> int:
     # client-observable proof the negotiated wire was honored.
     wires_seen = sorted({r.get("wire", "") for _, r in completed} - {""})
     row = {
-        "workload": (f"serve {args.filter_name} {args.rows}x{args.cols}"
-                     + ("+1920x2520" if args.mixed_sizes else "")
-                     + f"x{channels} "
+        "workload": (f"serve {args.filter_name} "
+                     + (f"volume {args.volume}" if vol_shape is not None
+                        else f"{args.rows}x{args.cols}"
+                        + ("+1920x2520" if args.mixed_sizes else "")
+                        + f"x{channels}")
+                     + " "
                      + (f"converge tol={args.converge}"
                         if args.converge is not None
                         else f"{args.iters} iters")
                      + (f" zipf={args.zipf}" if args.zipf is not None
                         else "")),
+        **({"rank": 3} if vol_shape is not None else {}),
         "wire": args.wire,
         **({"wires_seen": wires_seen} if wires_seen else {}),
         "loop": ("open-poisson" if args.rps

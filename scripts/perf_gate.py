@@ -91,6 +91,17 @@ def row_key(row: dict) -> str:
     solver = row.get("solver")
     if solver and f"solver={solver}" not in key:
         key += f"|solver={solver}"
+    # Rank keying (round 23): rank-3 volume rows get their own history
+    # lane — a (D,H,W) cells/s number must never be judged against a
+    # rank-2 pixels/s baseline for a coincidentally-equal plan_key.
+    # Rank-2 rows (and every pre-rank history line) stay unsuffixed, so
+    # the committed history remains continuous.
+    rank = row.get("rank")
+    try:
+        if rank is not None and int(rank) != 2:
+            key += f"|rank={int(rank)}"
+    except (TypeError, ValueError):
+        pass
     # Topology keying (r17, ROADMAP item 1 pulled forward): multi-host /
     # multi-slice rows get their own history lane so they are never
     # judged against single-host baselines.  Single-host rows keep their

@@ -183,6 +183,30 @@
 #                                      supervisor leg's done_file); the
 #                                      lane gate report in
 #                                      evidence/cache_gate.json.
+#   scripts/run_t1.sh --volume-smoke   rank-3 volumetric subsystem (round
+#                                      23) end-to-end on the 2x4 CPU
+#                                      mesh: every registered rank-3 form
+#                                      (fd7/fd25 + _stack twins, wave,
+#                                      grayscott) vs the independent
+#                                      float64 numpy oracle, the _stack
+#                                      twins and the 1x1-vs-2x4 runs
+#                                      byte-identical (the decomposition
+#                                      invisible); the 8th-order 25-point
+#                                      star's equal-accuracy convergence
+#                                      win on the periodic manufactured
+#                                      Poisson problem (sweep ratio >
+#                                      1.5x, measured ~5x); a volume
+#                                      served on both wires (JSON +
+#                                      binary frames, byte-identical)
+#                                      plus a Gray-Scott converge
+#                                      stream vs the oracle; and the
+#                                      rank-3-stamped throughput rows
+#                                      folded through perf_gate.py
+#                                      (row_key lanes them via |rank=3)
+#                                      against the smoke's own history.
+#                                      Row (failures: 0) lands in
+#                                      evidence/volume_smoke.json (the
+#                                      supervisor leg's done_file).
 #   scripts/run_t1.sh --static         fast static gate (no jax): every
 #                                      .py byte-compiles, no bare
 #                                      'except:', every mutation of a
@@ -373,6 +397,13 @@ if [ "${1:-}" = "--cache-smoke" ]; then
     PCTPU_OBS=1 \
     python scripts/cache_smoke.py --mesh 1x2 \
       --out evidence/cache_smoke.json
+fi
+
+if [ "${1:-}" = "--volume-smoke" ]; then
+  exec timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python scripts/volume_smoke.py --mesh 2x4 \
+      --out evidence/volume_smoke.json
 fi
 
 if [ "${1:-}" = "--static" ]; then

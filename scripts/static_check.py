@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Fast static gate: the ``run_t1.sh --static`` leg (round 19).
 
-Four checks, all stdlib, no jax import, a few seconds total:
+Five checks, all stdlib, no jax import, a few seconds total:
 
 1. **compileall** — every ``.py`` under ``parallel_convolution_tpu/``,
    ``scripts/``, and ``tests/`` byte-compiles (``py_compile`` to a
@@ -28,8 +28,13 @@ Four checks, all stdlib, no jax import, a few seconds total:
    outside the helper module itself — fails the leg.  The convention
    this enforces: shared-curve handles are named ``curve_*``, and
    nothing but evidence_io writes through them.
+5. **no new dispatch ladders in ``parallel/step.py``** — the rank-3
+   volume subsystem (round 23) landed as kernel-registry entries with
+   ZERO new ``rank ==`` / ``backend ==`` arms in the step dispatcher;
+   this check freezes those counts at the baseline so the next variant
+   does too.
 
-Exit 0 and ``{"failures": 0}`` in ``--out`` iff all four hold.
+Exit 0 and ``{"failures": 0}`` in ``--out`` iff all five hold.
 """
 
 from __future__ import annotations
@@ -224,6 +229,38 @@ def check_shared_curve_writes(files) -> list[str]:
     return problems
 
 
+# Dispatch-ladder freeze for parallel/step.py (round 23): new kernel
+# variants land as REGISTRY entries (parallel/kernels.py — the rank-3
+# volume forms did), never as another `if rank == ...` / `if backend ==
+# ...` arm in the step dispatcher.  The baselines pin the seed's counts:
+# exactly one historical `backend ==` comparison (the pallas_sep
+# separability flag) and zero `rank ==`.  A count above baseline fails
+# the leg; BELOW baseline is fine (someone refactored a ladder away).
+_LADDER_FILE = Path("parallel_convolution_tpu") / "step.py"
+_LADDER_BASELINE = {"rank ==": 0, "backend ==": 1}
+
+
+def check_dispatch_ladders(files) -> list[str]:
+    """``parallel/step.py`` must not grow ``rank ==`` / ``backend ==``
+    comparison ladders beyond the frozen baseline."""
+    step = next((f for f in files
+                 if f.parts[-2:] == ("parallel", "step.py")), None)
+    if step is None:
+        return ["parallel/step.py missing: the dispatch-ladder freeze "
+                "has nothing to check"]
+    src = step.read_text(encoding="utf-8")
+    problems = []
+    for needle, allowed in _LADDER_BASELINE.items():
+        count = src.count(needle)
+        if count > allowed:
+            problems.append(
+                f"{_rel(step)}: {count} '{needle}' comparisons "
+                f"(baseline {allowed}) — new kernel variants register "
+                "through parallel/kernels.py forms, not another "
+                "dispatch arm in step.py")
+    return problems
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--out", default="evidence/static_check.json")
@@ -236,10 +273,11 @@ def main() -> int:
     failures += check_bare_except(files)
     failures += check_stats_locking(files)
     failures += check_shared_curve_writes(files)
+    failures += check_dispatch_ladders(files)
 
     row = {
         "workload": "static-check compileall+bare-except+stats-lock"
-                    "+shared-curve-writes",
+                    "+shared-curve-writes+dispatch-ladders",
         "files_checked": len(files),
         "wall_s": round(time.time() - t0, 3),
         "failures": len(failures),

@@ -1,0 +1,296 @@
+#!/usr/bin/env python
+"""Volumetric smoke: the rank-3 subsystem's claims, end-to-end on CPU.
+
+The ``run_t1.sh --volume-smoke`` leg.  Gates, in order:
+
+1. FORMS vs ORACLE + BYTE IDENTITY — a seeded 3D Poisson state run
+   through every registered rank-3 form on the ``--mesh`` grid must
+   match the independent float64 numpy oracle (``volumes.oracle3`` —
+   global np.pad ghosting, different arithmetic); the _stack twins must
+   be BYTE-identical to their planar siblings; and the 2x4 result must
+   be byte-identical to the single-device (1x1) run — the decomposition
+   is invisible.
+2. EQUAL-ACCURACY CONVERGENCE WIN — the 8th-order 25-point star on an
+   N=16 cube must reach the same manufactured-solution error
+   (``--target-err``) in measurably fewer damped-Jacobi sweeps than the
+   7-point star needs on the N=48 cube its 2nd-order accuracy demands:
+   sweep ratio > ``--min-ratio`` (measured ~5x at the defaults).
+   Manufactured problem: u* = sin(2 pi x)sin(2 pi y)sin(2 pi z) on the
+   PERIODIC unit cube, f = 3 (2 pi)^2 u* h^2, x = i h, h = 1/N.
+   Periodic ghosts are exact, so each star converges at its full
+   interior order — with zero-ghost Dirichlet faces the radius-4 star
+   drops below 8th order at the rim and the coarse grid can't reach
+   the target (the reason this gate is periodic).
+3. SERVING ROUND-TRIP — a typed volume request through the in-process
+   service: the JSON and r20 binary-frame wires byte-identical, the
+   response matching the oracle; plus a Gray-Scott converge stream
+   whose final row matches the oracle at the same iteration count.
+4. PERF SENTRY FOLD — the sweep-throughput rows (stamped ``rank: 3``,
+   so ``perf_gate.row_key`` lanes them apart from every rank-2 row)
+   seed and re-gate the smoke's OWN history through perf_gate.py.
+
+One summary row lands in ``--out`` (``evidence/volume_smoke.json``, the
+supervisor leg's done_file) with ``"failures": 0`` iff every gate held.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import _path  # noqa: F401  (repo root + JAX_PLATFORMS re-apply)
+
+SCRIPTS = Path(__file__).resolve().parent
+
+
+def _poisson_state(n: int):
+    """(state, u*) of the manufactured PERIODIC problem on an N^3
+    cube: u0 = 0, rhs plane f = 3 (2 pi)^2 u* h^2, x = i h, h = 1/N."""
+    import numpy as np
+
+    h = 1.0 / n
+    x = np.arange(n) * h
+    s = np.sin(2.0 * np.pi * x)
+    ustar = np.einsum("i,j,k->ijk", s, s, s)
+    f = 3.0 * (2.0 * np.pi) ** 2 * ustar * h * h
+    state = np.stack([np.zeros_like(ustar), f]).astype(np.float32)
+    return state, ustar.astype(np.float64)
+
+
+def _sweeps_to_err(driver, state, ustar, name, mesh, target, cap,
+                   chunk=25):
+    """(sweeps, final_err, cells_per_s) running ``name`` until the
+    solution error vs u* reaches ``target`` (or the sweep cap)."""
+    import numpy as np
+
+    sweeps, err = 0, float("inf")
+    cells = 2 * int(np.prod(state.shape[1:]))
+    t0 = time.perf_counter()
+    while sweeps < cap:
+        n = min(chunk, cap - sweeps)
+        state = driver.volume_iterate(state, name, n, mesh=mesh,
+                                      boundary="periodic")
+        sweeps += n
+        err = float(np.abs(state[0].astype(np.float64) - ustar).max())
+        if err <= target:
+            break
+    dt = max(time.perf_counter() - t0, 1e-9)
+    return sweeps, err, cells * sweeps / dt
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--mesh", default="2x4")
+    ap.add_argument("--depth", type=int, default=6)
+    ap.add_argument("--rows", type=int, default=24)
+    ap.add_argument("--cols", type=int, default=40)
+    ap.add_argument("--iters", type=int, default=3,
+                    help="fixed-count iterations for the oracle/byte "
+                         "gates")
+    ap.add_argument("--n7", type=int, default=48,
+                    help="7-point Poisson cube extent")
+    ap.add_argument("--n25", type=int, default=16,
+                    help="25-point Poisson cube extent (equal accuracy)")
+    ap.add_argument("--target-err", type=float, default=0.013,
+                    help="manufactured-solution error both stars must "
+                         "reach (above the N=48 7-point "
+                         "discretization floor)")
+    ap.add_argument("--min-ratio", type=float, default=1.5,
+                    help="required 7-point/25-point sweep ratio")
+    ap.add_argument("--sweep-cap", type=int, default=2000)
+    ap.add_argument("--oracle-tol", type=float, default=2e-5)
+    ap.add_argument("--out", default="evidence/volume_smoke.json")
+    ap.add_argument("--history",
+                    default="evidence/volume_smoke_history.jsonl",
+                    help="the smoke's OWN perf history, seeded fresh "
+                         "each run; never the committed "
+                         "evidence/perf_history.jsonl")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from parallel_convolution_tpu.parallel.mesh import mesh_from_spec
+    from parallel_convolution_tpu.utils.config import VOLUME_FORMS
+    from parallel_convolution_tpu.volumes import driver, oracle3
+
+    failures: list[str] = []
+    mesh = mesh_from_spec(args.mesh)
+    mesh1 = mesh_from_spec("1x1")
+    D, H, W = args.depth, args.rows, args.cols
+    rng = np.random.default_rng(0)
+    # Bounded [0, 1): the Gray-Scott cubic term needs bounded fields.
+    vol = rng.random((2, D, H, W), dtype=np.float32)
+
+    # ---- 1: every form vs the oracle; twins + meshes byte-identical.
+    outs: dict[str, np.ndarray] = {}
+    for name in VOLUME_FORMS:
+        try:
+            got = driver.volume_iterate(vol, name, args.iters, mesh=mesh,
+                                        boundary="zero")
+        except Exception as e:  # noqa: BLE001 — smoke gate, report all
+            failures.append(f"form {name} failed on {args.mesh}: {e}")
+            continue
+        outs[name] = got
+        want = oracle3.run_oracle(vol, name, args.iters, "zero")
+        diff = float(np.abs(got.astype(np.float64) - want).max())
+        if diff > args.oracle_tol:
+            failures.append(
+                f"form {name} drifted from the numpy oracle: "
+                f"max|diff| = {diff:.3g} > {args.oracle_tol}")
+        solo = driver.volume_iterate(vol, name, args.iters, mesh=mesh1,
+                                     boundary="zero")
+        if solo.tobytes() != got.tobytes():
+            failures.append(
+                f"form {name} not byte-identical across 1x1 vs "
+                f"{args.mesh} — the decomposition leaked")
+    for base in ("fd7", "fd25"):
+        twin = base + "_stack"
+        if base in outs and twin in outs and (
+                outs[base].tobytes() != outs[twin].tobytes()):
+            failures.append(
+                f"{twin} not byte-identical to {base} — the twins must "
+                "route the same weighted terms in the same order")
+
+    # ---- 2: the 25-point equal-accuracy convergence win (1x1 mesh).
+    st7, u7 = _poisson_state(args.n7)
+    st25, u25 = _poisson_state(args.n25)
+    s7, e7, cps7 = _sweeps_to_err(
+        driver, st7, u7, "fd7", mesh1, args.target_err, args.sweep_cap)
+    s25, e25, cps25 = _sweeps_to_err(
+        driver, st25, u25, "fd25", mesh1, args.target_err,
+        args.sweep_cap)
+    for tag, sw, err in (("fd7", s7, e7), ("fd25", s25, e25)):
+        if err > args.target_err:
+            failures.append(
+                f"{tag} never reached err <= {args.target_err} "
+                f"({err:.4g} after {sw} sweeps)")
+    ratio = s7 / max(1, s25)
+    if ratio <= args.min_ratio:
+        failures.append(
+            f"25-point convergence win too small: {s7}/{s25} = "
+            f"{ratio:.2f}x <= {args.min_ratio}x")
+
+    # ---- 3: serving round-trip, both wires, plus a physics stream.
+    from parallel_convolution_tpu.serving import frames as frames_mod
+    from parallel_convolution_tpu.serving.frontend import InProcessClient
+    from parallel_convolution_tpu.serving.service import ConvolutionService
+
+    svc = ConvolutionService(mesh, max_delay_s=0.002)
+    try:
+        client = InProcessClient(svc)
+        body = {"rows": H, "cols": W, "depth": D, "mode": "volume",
+                "filter": "fd7", "iters": args.iters, "boundary": "zero",
+                "volume_b64": base64.b64encode(vol.tobytes()).decode()}
+        status, resp = client.request(dict(body))
+        if status != 200:
+            failures.append(f"serving volume batch rejected: {resp}")
+        else:
+            out = np.frombuffer(base64.b64decode(resp["image_b64"]),
+                                np.float32).reshape(vol.shape)
+            want = oracle3.run_oracle(vol, "fd7", args.iters, "zero")
+            diff = float(np.abs(out.astype(np.float64) - want).max())
+            if diff > args.oracle_tol:
+                failures.append(
+                    f"served volume drifted from the oracle: "
+                    f"{diff:.3g} > {args.oracle_tol}")
+            raw = frames_mod.encode_envelope(
+                {k: v for k, v in body.items() if k != "volume_b64"},
+                {"volume": vol})
+            fstatus, data = client.request_frames(raw)
+            if fstatus != 200:
+                failures.append(f"frames-wire volume rejected: {fstatus}")
+            else:
+                hdr, arrs = frames_mod.decode_envelope(data)
+                if not hdr.get("ok") or (
+                        np.asarray(arrs["image"]).tobytes()
+                        != out.tobytes()):
+                    failures.append(
+                        "frames wire not byte-identical to the JSON arm")
+        # The classic Gray-Scott start: U=1, V=0, a perturbed center
+        # blob, a whisper of noise.  Raw amplitude-1 noise is OUTSIDE
+        # the reaction's stable basin at dt=1 (the cubic term blows up
+        # in a handful of steps), so this gate seeds properly.
+        gs = np.zeros((2, D, H, W), np.float32)
+        gs[0] = 1.0
+        gs[0, :, H // 2 - 3:H // 2 + 3, W // 2 - 4:W // 2 + 4] = 0.5
+        gs[1, :, H // 2 - 3:H // 2 + 3, W // 2 - 4:W // 2 + 4] = 0.25
+        gs += 0.01 * rng.random(gs.shape, dtype=np.float32)
+        cbody = {"rows": H, "cols": W, "depth": D, "mode": "volume",
+                 "filter": "grayscott", "boundary": "periodic",
+                 "volume_b64": base64.b64encode(gs.tobytes()).decode(),
+                 "tol": 0.0, "max_iters": 8, "check_every": 4}
+        cstatus, rows = client.converge(dict(cbody))
+        rows = list(rows) if cstatus == 200 else []
+        finals = [r for r in rows if r.get("kind") == "final"]
+        if cstatus != 200 or len(finals) != 1:
+            failures.append(
+                f"grayscott converge stream failed: status={cstatus}, "
+                f"{len(finals)} finals")
+        else:
+            fin = np.frombuffer(
+                base64.b64decode(finals[0]["image_b64"]),
+                np.float32).reshape(finals[0]["image_shape"])
+            want = oracle3.run_oracle(gs, "grayscott", 8, "periodic")
+            diff = float(np.abs(fin.astype(np.float64) - want).max())
+            # NaN-safe: a non-finite diff must FAIL, not slide past >.
+            if not diff <= 1e-4:
+                failures.append(
+                    f"served grayscott final drifted from the oracle: "
+                    f"{diff:.3g} > 1e-4")
+    finally:
+        svc.close()
+
+    # ---- 4: perf sentry fold — rank-3 rows in their own history lane.
+    bench_rows = [
+        {"workload": f"volume-smoke fd7 poisson {args.n7}^3",
+         "plan_key": f"vol|fd7|{args.n7}x{args.n7}x{args.n7}"
+                     "|periodic|grid=1x1",
+         "backend": "xla", "mesh": "1x1", "solver": "jacobi", "rank": 3,
+         "sweeps_to_err": s7, "gpixels_per_s": cps7 / 1e9},
+        {"workload": f"volume-smoke fd25 poisson {args.n25}^3",
+         "plan_key": f"vol|fd25|{args.n25}x{args.n25}x{args.n25}"
+                     "|periodic|grid=1x1",
+         "backend": "xla", "mesh": "1x1", "solver": "jacobi", "rank": 3,
+         "sweeps_to_err": s25, "gpixels_per_s": cps25 / 1e9},
+    ]
+    out_path = Path(args.out)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    rows_path = out_path.with_suffix(".rows.json")
+    rows_path.write_text(json.dumps(bench_rows))
+    hist = Path(args.history)
+    hist.parent.mkdir(parents=True, exist_ok=True)
+    hist.write_text("")   # the smoke's OWN history: truncate per run
+    gate = [sys.executable, str(SCRIPTS / "perf_gate.py"),
+            "--history", str(hist), "--row", str(rows_path), "--quiet"]
+    rc_seed = subprocess.run([*gate, "--update"], check=False).returncode
+    rc_pass = subprocess.run(gate, check=False).returncode
+    if rc_seed != 0:
+        failures.append(f"perf_gate seed run exited {rc_seed}")
+    if rc_pass != 0:
+        failures.append(f"perf_gate re-gate exited {rc_pass}")
+
+    row = {
+        "workload": f"volume-smoke {D}x{H}x{W} mesh={args.mesh} "
+                    f"iters={args.iters}",
+        "rank": 3,
+        "forms_checked": list(VOLUME_FORMS),
+        "sweeps_fd7": s7, "err_fd7": e7,
+        "sweeps_fd25": s25, "err_fd25": e25,
+        "sweep_ratio": round(ratio, 2),
+        "min_ratio_gate": args.min_ratio,
+        "target_err": args.target_err,
+        "failures": len(failures),
+        "failure_detail": failures[:10],
+    }
+    out_path.write_text(json.dumps(row, indent=2))
+    print(json.dumps(row), flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

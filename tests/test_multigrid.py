@@ -38,6 +38,7 @@ from parallel_convolution_tpu.solvers import multigrid as mg
 from parallel_convolution_tpu.solvers import transfer
 from parallel_convolution_tpu.utils.config import (
     BACKENDS, BOUNDARIES, SOLVERS,
+    VOLUME_PHYSICS_FORMS, VOLUME_SMOOTH_FORMS,
 )
 from parallel_convolution_tpu.utils.jax_compat import shard_map
 
@@ -53,23 +54,37 @@ def _mesh(shape=(2, 2)):
 
 
 def test_registry_smoother_keys_match_old_ladder_exactly():
-    # The pinned migration proof: exactly the six historical backends,
-    # each under exactly the two historical boundaries — the old
-    # if-ladder as a key set, no more, no less.
+    # The pinned migration proof: exactly the six historical rank-2
+    # backends plus the four rank-3 volume smoothers (round 23), each
+    # under exactly the two historical boundaries — no more, no less.
     want = frozenset((2, b, bd) for b in BACKENDS for bd in BOUNDARIES)
+    want |= frozenset((3, n, bd) for n in VOLUME_SMOOTH_FORMS
+                      for bd in BOUNDARIES)
     assert kernel_forms.registered_keys("smooth") == want
 
 
 def test_registry_transfer_forms_registered_under_own_classes():
     assert kernel_forms.registered_keys("restrict") == frozenset(
-        (2, "restrict_fw", bd) for bd in BOUNDARIES)
+        {(2, "restrict_fw", bd) for bd in BOUNDARIES}
+        | {(3, "restrict_fw", bd) for bd in BOUNDARIES})
     assert kernel_forms.registered_keys("prolong") == frozenset(
-        (2, "prolong_bilinear", bd) for bd in BOUNDARIES)
+        {(2, "prolong_bilinear", bd) for bd in BOUNDARIES}
+        | {(3, "prolong_trilinear", bd) for bd in BOUNDARIES})
     # and the full set is the union: nothing else snuck in
     assert kernel_forms.registered_keys() == (
         kernel_forms.registered_keys("smooth")
         | kernel_forms.registered_keys("restrict")
-        | kernel_forms.registered_keys("prolong"))
+        | kernel_forms.registered_keys("prolong")
+        | kernel_forms.registered_keys("physics"))
+
+
+def test_registry_rank3_physics_forms_pinned_exactly():
+    # The time-dependent volume forms live under their own stencil
+    # class — converge admission keys off "physics", not the name —
+    # and the set is pinned exactly: wave + Gray-Scott, both
+    # boundaries, nothing else, and no rank-2 physics.
+    assert kernel_forms.registered_keys("physics") == frozenset(
+        (3, n, bd) for n in VOLUME_PHYSICS_FORMS for bd in BOUNDARIES)
 
 
 def test_registry_unknown_form_fails_at_resolution():

@@ -1,0 +1,235 @@
+"""Rank-3 volumes through the serving plane (round 23).
+
+The subsystem's serving contract, end to end:
+
+1. BATCH — a typed volume request (JSON ``volume_b64`` and the r20
+   binary tensor-frame wire) round-trips through admission → pricing →
+   micro-batching → the warm engine, matches the independent float64
+   oracle, is byte-identical across the two wires, and hits the
+   content-addressed result cache on resubmission.
+2. CONVERGE — wave and Gray–Scott stream best-so-far snapshots whose
+   final row matches the oracle at the same iteration count; rows carry
+   the jacobi solver stamp with ``work_units == iters`` (a volume's
+   fine-grid work IS its iteration count).
+3. FAILOVER — the soak-style mid-stream drills: (a) a stream interrupted
+   after its first snapshot resumes from that row's resume token to a
+   byte-identical final; (b) a stream caught by the r10 mesh ladder
+   sheds typed-retryable and the retry completes on the NEW grid with
+   byte-identical finals (rank-3 forms are bitwise mesh-invariant).
+4. TYPED INVALIDS — rank-2 filter names, wrong dtype/shape, image+volume
+   both set, rank-2-only solvers, and periodic indivisibility all fail
+   admission as ``invalid``, never inside a trace.
+"""
+
+from __future__ import annotations
+
+import base64
+
+import jax
+import numpy as np
+import pytest
+
+from parallel_convolution_tpu.parallel import mesh as mesh_lib
+from parallel_convolution_tpu.serving import frames as frames_mod
+from parallel_convolution_tpu.serving import jobs
+from parallel_convolution_tpu.serving.frontend import InProcessClient
+from parallel_convolution_tpu.serving.service import (
+    ConvolutionService, Rejected, Request, Snapshot,
+)
+from parallel_convolution_tpu.volumes import oracle3
+
+
+def _mesh(shape=(2, 2)):
+    return mesh_lib.make_grid_mesh(jax.devices()[: shape[0] * shape[1]],
+                                   shape)
+
+
+def _svc(**kw):
+    kw.setdefault("max_delay_s", 0.002)
+    return ConvolutionService(kw.pop("mesh", _mesh()), **kw)
+
+
+def _vol(seed=7, d=4, h=16, w=16):
+    return np.random.default_rng(seed).random(
+        (2, d, h, w), dtype=np.float32)
+
+
+def _body(vol, **kw):
+    b = {"rows": vol.shape[2], "cols": vol.shape[3],
+         "depth": vol.shape[1], "mode": "volume",
+         "volume_b64": base64.b64encode(vol.tobytes()).decode()}
+    b.update(kw)
+    return b
+
+
+def _decode_final(row, shape=None):
+    out = np.frombuffer(base64.b64decode(row["image_b64"]), np.float32)
+    return out.reshape(shape if shape is not None else row["image_shape"])
+
+
+# ------------------------------------------------------------------ batch
+
+
+def test_volume_batch_both_wires_byte_identical_and_cached():
+    svc = _svc(cache=True)
+    try:
+        client = InProcessClient(svc)
+        vol = _vol(1)
+        body = _body(vol, filter="fd7", iters=5, boundary="zero")
+        status, resp = client.request(dict(body))
+        assert status == 200, resp
+        out = _decode_final(resp, vol.shape)
+        want = oracle3.run_oracle(vol, "fd7", 5, "zero")
+        np.testing.assert_allclose(out, want, rtol=0, atol=2e-5)
+        assert resp["plan_key"].startswith("vol|fd7|4x16x16|zero|")
+
+        # the r20 binary frame wire: same request, same BYTES back
+        raw = frames_mod.encode_envelope(
+            {k: v for k, v in body.items() if k != "volume_b64"},
+            {"volume": vol})
+        status, data = client.request_frames(raw)
+        assert status == 200
+        hdr, arrs = frames_mod.decode_envelope(data)
+        assert hdr["ok"], hdr
+        framed = np.asarray(arrs["image"])
+        assert framed.dtype == np.float32
+        assert framed.tobytes() == out.tobytes()
+
+        # content-addressed cache: identical resubmission is a hit
+        status, resp2 = client.request(dict(body))
+        assert status == 200 and resp2["cache"] == "hit"
+        assert resp2["image_b64"] == resp["image_b64"]
+    finally:
+        svc.close()
+
+
+def test_volume_batch_fd25_smooth_form_serves():
+    svc = _svc()
+    try:
+        vol = _vol(2)   # blocks 8x8 >= fd25's radius 4 on 2x2
+        status, resp = InProcessClient(svc).request(
+            _body(vol, filter="fd25", iters=2, boundary="zero"))
+        assert status == 200, resp
+        want = oracle3.run_oracle(vol, "fd25", 2, "zero")
+        np.testing.assert_allclose(_decode_final(resp, vol.shape), want,
+                                   rtol=0, atol=2e-5)
+    finally:
+        svc.close()
+
+
+# --------------------------------------------------------------- converge
+
+
+@pytest.mark.parametrize("name", ["wave", "grayscott"])
+def test_physics_converge_stream_matches_oracle(name):
+    svc = _svc()
+    try:
+        vol = _vol(3)
+        rows = list(svc.submit_progressive(
+            Request(volume=vol, filter_name=name, boundary="periodic"),
+            tol=0.0, max_iters=12, check_every=4))
+        assert all(isinstance(r, Snapshot) for r in rows)
+        final = rows[-1]
+        assert final.final and not final.converged and final.iters == 12
+        assert final.image.dtype == np.float32
+        # a volume's solver-comparable work IS its iteration count
+        for r in rows:
+            assert r.solver == "jacobi"
+            assert r.work_units == float(r.iters)
+        want = oracle3.run_oracle(vol, name, 12, "periodic")
+        np.testing.assert_allclose(final.image, want, rtol=0, atol=2e-4)
+    finally:
+        svc.close()
+
+
+def test_volume_converge_resume_token_byte_identical_final():
+    # Failover drill (a): interrupt after the first snapshot, carry its
+    # resume token into a fresh request, land on the same final bytes.
+    svc = _svc()
+    try:
+        client = InProcessClient(svc)
+        vol = _vol(4)
+        body = _body(vol, filter="wave", boundary="periodic",
+                     tol=0.0, max_iters=12, check_every=4,
+                     resume_state=True)
+        status, rows = client.converge(dict(body))
+        assert status == 200
+        rows = list(rows)
+        final = [r for r in rows if r.get("kind") == "final"][0]
+
+        tok = jobs.token_from_row(rows[0])
+        assert tok is not None and tok["iters"] == 4
+        body2 = dict(body)
+        body2["resume"] = tok
+        status, rows2 = client.converge(body2)
+        assert status == 200
+        fin2 = [r for r in rows2 if r.get("kind") == "final"][0]
+        assert fin2["image_b64"] == final["image_b64"]
+        assert fin2["iters"] == final["iters"] == 12
+    finally:
+        svc.close()
+
+
+def test_volume_converge_survives_reshape_with_typed_shed():
+    # Failover drill (b): the r10 mesh ladder interrupts a rank-3
+    # stream; the shed is typed retryable, and the retry's final on the
+    # NEW grid is byte-identical (bitwise mesh invariance, served).
+    svc = _svc()
+    try:
+        vol = _vol(5)
+        req = Request(volume=vol, filter_name="fd7", boundary="zero")
+        want = list(svc.submit_progressive(
+            req, tol=0.0, max_iters=12, check_every=4))[-1]
+        assert isinstance(want, Snapshot) and want.final
+
+        stream = iter(svc.submit_progressive(
+            req, tol=0.0, max_iters=12, check_every=4))
+        first = next(stream)
+        assert isinstance(first, Snapshot) and first.iters == 4
+        info = svc.reshape("1x2")
+        assert info["grid"] == (1, 2)
+        tail = list(stream)
+        assert tail, "interrupted stream must end with a typed row"
+        shed = tail[-1]
+        assert isinstance(shed, Rejected), shed
+        assert shed.reason == "resharding" and shed.retryable
+        assert all(isinstance(r, Snapshot) for r in tail[:-1])
+
+        final = list(svc.submit_progressive(
+            req, tol=0.0, max_iters=12, check_every=4))[-1]
+        assert isinstance(final, Snapshot) and final.final
+        assert final.effective_grid == "1x2"
+        assert final.iters == want.iters
+        assert final.image.tobytes() == want.image.tobytes()
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------- typed invalids
+
+
+def test_volume_invalid_requests_are_typed():
+    svc = _svc()
+    try:
+        vol = _vol(6)
+        cases = [
+            Request(volume=vol, filter_name="blur3"),        # rank-2 form
+            Request(volume=vol.astype(np.float64)),          # dtype
+            Request(volume=vol[0]),                          # rank
+            Request(volume=vol[:1]),                         # field count
+            Request(volume=vol, solver="multigrid"),         # rank-2 only
+            Request(volume=vol,
+                    image=np.zeros((8, 8), np.uint8)),       # both set
+            Request(volume=_vol(6, h=15), boundary="periodic"),  # 15 % 2
+        ]
+        for req in cases:
+            r = svc.submit(req)
+            assert isinstance(r, Rejected) and r.reason == "invalid", req
+        # ... and the wire surface agrees (no depth -> typed 400)
+        client = InProcessClient(svc)
+        body = _body(vol, filter="fd7")
+        del body["depth"]
+        status, resp = client.request(body)
+        assert status == 400 and resp["rejected"] == "invalid"
+    finally:
+        svc.close()
