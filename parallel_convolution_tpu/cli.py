@@ -231,14 +231,15 @@ def _mesh_from_flag(spec: str | None):
 
 
 def main(argv: list[str] | None = None) -> int:
-    from parallel_convolution_tpu.resilience import faults
+    from parallel_convolution_tpu.resilience import diskio, faults
     from parallel_convolution_tpu.utils.config import BOUNDARIES, SOLVERS
     from parallel_convolution_tpu.utils.platform import apply_platform_env
 
     apply_platform_env()
-    # Honor PCTPU_FAULTS so injected-fault drills run end-to-end through
-    # the real CLI (no-op unless the env var is set).
+    # Honor PCTPU_FAULTS / PCTPU_DISK_MODES so injected-fault drills run
+    # end-to-end through the real CLI (no-op unless the env vars are set).
     faults.install_from_env()
+    diskio.install_from_env()
     ap = argparse.ArgumentParser(prog="pconv-tpu", description=__doc__)
     sub = ap.add_subparsers(dest="cmd", required=True)
 

@@ -40,6 +40,15 @@ import time
 from pathlib import Path
 
 from parallel_convolution_tpu.obs import metrics as _metrics
+from parallel_convolution_tpu.resilience import diskio as _diskio
+from parallel_convolution_tpu.resilience.faults import InjectedFault
+
+# Reentrancy guard for the ``events_emit`` fault site: the fault plan
+# itself emits a ``fault_trigger`` event when a site fires, so the
+# inner emit must NOT consult again — under ``events_emit:*`` that
+# would recurse without bound.  Thread-local because two threads'
+# emits are independent consults.
+_EMIT_GUARD = threading.local()
 
 __all__ = [
     "EVENTS_ENV", "EventLog", "KINDS", "configure", "emit", "get_log",
@@ -111,6 +120,11 @@ class EventLog:
         self._seq = 0
         self._size = 0
         self._fh = None
+        # Lines lost to disk failure (round 24): the event log is
+        # telemetry, and telemetry IO must never raise into the
+        # serving path — a failed write COUNTS here instead (the seq
+        # it consumed becomes the documented in-stream gap).
+        self.dropped = 0
         self.path.parent.mkdir(parents=True, exist_ok=True)
 
     def _open(self) -> None:
@@ -176,16 +190,35 @@ class EventLog:
         self._open()
 
     def emit(self, kind: str, **fields) -> dict:
-        """Append one event; returns the record written (tests assert on
-        it).  Raises ValueError on an unknown kind or a reserved field."""
+        """Append one event; returns the record built (tests assert on
+        it).  Raises ValueError on an unknown kind or a reserved field.
+        DISK failure (real or via the ``events_emit`` fault site) never
+        raises: the line is counted dropped — its consumed seq is the
+        in-stream gap readers already know how to interpret."""
         if kind not in KINDS:
             raise ValueError(
                 f"unknown event kind {kind!r}; known: {sorted(KINDS)}")
         bad = set(fields) & set(_REQUIRED)
         if bad:
             raise ValueError(f"fields {sorted(bad)} are reserved")
+        # Consult OUTSIDE self._lock: a firing site emits its own
+        # fault_trigger event through this very log, and that inner
+        # emit must be able to take the (non-reentrant) lock.  The
+        # guard keeps the inner emit from consulting again.
+        failed = False
+        if not getattr(_EMIT_GUARD, "active", False):
+            _EMIT_GUARD.active = True
+            try:
+                _diskio.consult("events_emit")
+            except (OSError, InjectedFault):
+                # The telemetry ladder: the site's documented contract
+                # is "counts a dropped line instead of raising into
+                # the serving path" — both for translated disk modes
+                # and for the raw injected fault.
+                failed = True
+            finally:
+                _EMIT_GUARD.active = False
         with self._lock:
-            self._ensure_live()
             self._seq += 1
             rec = {"seq": self._seq, "ts": round(time.time(), 6),
                    "perf": round(time.perf_counter(), 6),
@@ -195,11 +228,23 @@ class EventLog:
             # len(line) counts characters, which under-counts any
             # non-ASCII field and lets the file overshoot max_bytes.
             nbytes = len(line.encode("utf-8"))
-            if self._size + nbytes > self.max_bytes and self._size > 0:
-                self._rotate_locked()
-            self._fh.write(line)
-            self._fh.flush()
-            self._size += nbytes
+            try:
+                if failed:
+                    raise OSError("injected events_emit failure")
+                self._ensure_live()
+                if (self._size + nbytes > self.max_bytes
+                        and self._size > 0):
+                    self._rotate_locked()
+                self._fh.write(line)
+                self._fh.flush()
+                self._size += nbytes
+            except OSError:
+                self.dropped += 1
+                if _metrics.enabled():
+                    _metrics.counter(
+                        "pctpu_events_dropped_total",
+                        "event lines lost to disk failure (the log "
+                        "keeps its seq gap; serving unaffected)").inc()
         return rec
 
     def close(self) -> None:

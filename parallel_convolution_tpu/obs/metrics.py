@@ -499,5 +499,5 @@ def reset() -> None:
 
 def dump(path) -> None:
     """Write the snapshot JSON (evidence files / obs_report input)."""
-    with open(path, "w") as f:
+    with open(path, "w") as f:  # diskio: exempt — exit-time snapshot
         json.dump(snapshot(), f, indent=2)

@@ -1,0 +1,291 @@
+"""Guarded disk IO: storage-shaped failure injection for every durable
+surface (round 24).
+
+Rounds 18–21 drilled PROCESS death (chaos transport, fenced WAL
+takeover); every disk-backed subsystem still assumed the filesystem
+beneath it was healthy and fast.  This module closes that gap the same
+way ``serving.chaos`` closed the network one: the proven, seeded
+``PCTPU_FAULTS`` machinery decides WHEN a disk surface fails (hit
+counters / ranges / probabilities — replayable bit-for-bit), and a
+per-site **disk mode** map decides WHAT the failure looks like — the
+ways real disks actually fail:
+
+* ``enospc``     — ``OSError(ENOSPC)`` before any byte lands (full disk);
+* ``eio``        — ``OSError(EIO)`` (dying device / dead file handle);
+* ``torn_write`` — a PREFIX of the payload lands, then ``EIO`` (power
+  loss mid-write: the bytes on disk are garbage a reader must detect);
+* ``slow_write`` — the operation succeeds after a seeded stall (a
+  saturated device: latency, not loss).
+
+With no mode installed for a triggered site the raw
+:class:`~.faults.InjectedFault` re-raises untranslated — every drill
+written before this module behaves exactly as it did.  With no fault
+plan installed at all, each guard is one global load + ``is None`` test
+plus a plain write — safe on hot paths.
+
+Owners route their write-mode IO through the guards
+(:func:`guarded_write` / :func:`guarded_fsync` / :func:`guarded_open` /
+:func:`guarded_replace`), or consult :func:`consult` around IO they must
+shape themselves; ``scripts/static_check.py`` check 6 pins the
+convention (any write-mode ``open``/``os.replace`` under ``serving/``,
+``obs/``, ``utils/`` lives in an allowlisted guarded owner).
+
+stdlib-only, import-light, jax-free.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import threading
+import time
+
+from parallel_convolution_tpu.resilience.faults import (
+    InjectedFault, fault_point,
+)
+
+__all__ = ["DISK_SITES", "consult", "deferred_consult", "guarded_fsync",
+           "guarded_open", "guarded_replace", "guarded_write",
+           "injected_counts", "install_modes", "installed_modes",
+           "modes_from_env", "modes_from_spec", "uninstall_modes"]
+
+# site -> the disk failure shapes it can take.  Every site here is a
+# KNOWN_SITES member (faults.SITE_TABLE is the one registry); the mode
+# list bounds what a spec may ask for, so a typo'd mode can't silently
+# never fire.  torn_write is only offered where a partial payload can
+# actually land (buffered writes), not on fsync barriers.
+DISK_SITES = {
+    "wal_write": ("enospc", "eio", "torn_write", "slow_write"),
+    "wal_fsync": ("enospc", "eio", "slow_write"),
+    "checkpoint_write_shard": ("enospc", "eio", "torn_write",
+                               "slow_write"),
+    "checkpoint_write_meta": ("enospc", "eio", "torn_write",
+                              "slow_write"),
+    "cache_spill": ("enospc", "eio", "torn_write", "slow_write"),
+    "cache_promote": ("eio", "slow_write"),
+    "events_emit": ("enospc", "eio", "slow_write"),
+    "evidence_write": ("enospc", "eio", "torn_write", "slow_write"),
+}
+
+# Literal consults per site — the fault-site drift guard
+# (tests/test_chaos.py) greps the tree for literal site-name consults,
+# so the documented registry can never silently lose a consult hidden
+# behind a variable.  The four NEW round-24 sites live only here; their
+# owners (cache, events, evidence_io) consult through this table.
+_CONSULTS = {
+    "wal_write": lambda: fault_point("wal_write"),
+    "wal_fsync": lambda: fault_point("wal_fsync"),
+    "checkpoint_write_shard":
+        lambda: fault_point("checkpoint_write_shard"),
+    "checkpoint_write_meta":
+        lambda: fault_point("checkpoint_write_meta"),
+    "cache_spill": lambda: fault_point("cache_spill"),
+    "cache_promote": lambda: fault_point("cache_promote"),
+    "events_emit": lambda: fault_point("events_emit"),
+    "evidence_write": lambda: fault_point("evidence_write"),
+}
+
+# Mean injected stall for slow_write (the actual sleep is deterministic
+# per hit — storage drills assert wall-clock floors, not jitter shapes).
+SLOW_WRITE_S = 0.05
+
+# The process-global mode map, installed next to the fault plan (specs
+# ride PCTPU_DISK_MODES in the env, "site=mode,..." from drills).  Read
+# without a lock: installed before the workload starts, and a torn read
+# can only see a fully constructed dict (CPython attribute store is
+# atomic) — the faults._PLAN rule.
+_MODES: dict[str, str] = {}
+_COUNTS: dict[tuple[str, str], int] = {}   # (site, mode) -> injections
+_COUNTS_LOCK = threading.Lock()
+
+
+def modes_from_spec(spec: str) -> dict[str, str]:
+    """Parse ``site=mode,site=mode``; raises ValueError on unknown
+    sites/modes so a typo can't silently noop (the chaos-mode rule)."""
+    out: dict[str, str] = {}
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        if "=" not in part:
+            raise ValueError(
+                f"bad disk mode {part!r}: expected site=mode")
+        site, mode = (s.strip() for s in part.split("=", 1))
+        if site not in DISK_SITES:
+            raise ValueError(
+                f"unknown disk site {site!r}; known: "
+                f"{sorted(DISK_SITES)}")
+        if mode not in DISK_SITES[site]:
+            raise ValueError(
+                f"unknown disk mode {mode!r} for {site}; known: "
+                f"{DISK_SITES[site]}")
+        out[site] = mode
+    return out
+
+
+def install_modes(modes: dict[str, str] | str | None) -> None:
+    """Install the process-global disk-mode map (validated); None or an
+    empty spec clears it."""
+    global _MODES
+    if isinstance(modes, str):
+        modes = modes_from_spec(modes)
+    if modes:
+        # Re-validate dict input the same way a spec is validated.
+        bad = [(s, m) for s, m in modes.items()
+               if s not in DISK_SITES or m not in DISK_SITES.get(s, ())]
+        if bad:
+            raise ValueError(f"unknown disk site/mode pair(s) {bad}")
+    _MODES = dict(modes or {})
+
+
+def uninstall_modes() -> None:
+    global _MODES
+    _MODES = {}
+
+
+def installed_modes() -> dict[str, str]:
+    return dict(_MODES)
+
+
+def modes_from_env(env: dict | None = None) -> dict[str, str]:
+    """``PCTPU_DISK_MODES`` → a validated mode map (empty when unset)."""
+    env = os.environ if env is None else env
+    spec = (env.get("PCTPU_DISK_MODES") or "").strip()
+    return modes_from_spec(spec) if spec else {}
+
+
+def install_from_env(env: dict | None = None) -> dict[str, str]:
+    """Install ``PCTPU_DISK_MODES`` (scripts call this at boot);
+    returns what was installed."""
+    modes = modes_from_env(env)
+    install_modes(modes)
+    return modes
+
+
+def injected_counts() -> dict[str, int]:
+    """``"site=mode" -> count`` of injections actually translated here
+    (drill asserts; the raw trigger counts live on the fault plan)."""
+    with _COUNTS_LOCK:
+        return {f"{s}={m}": n for (s, m), n in sorted(_COUNTS.items())}
+
+
+def _note(site: str, mode: str) -> None:
+    with _COUNTS_LOCK:
+        _COUNTS[(site, mode)] = _COUNTS.get((site, mode), 0) + 1
+    # Metrics only — no obs event here: FaultPlan.check already emitted
+    # the fault_trigger event, and the events_emit site consulting back
+    # into the event log is exactly the recursion this avoids.
+    from parallel_convolution_tpu.obs import metrics
+
+    if metrics.enabled():
+        metrics.counter(
+            "pctpu_disk_faults_total",
+            "storage-shaped failures injected by resilience.diskio",
+            ("site", "mode")).inc(site=site, mode=mode)
+
+
+def _trigger(site: str) -> str | None:
+    """Consult the site; returns the installed disk mode when the plan
+    fires (counted), None when it doesn't.  A triggered site with NO
+    installed mode re-raises the raw InjectedFault (pre-round-24
+    drills keep their exact semantics)."""
+    try:
+        _CONSULTS[site]()
+        return None
+    except InjectedFault:
+        mode = _MODES.get(site)
+        if mode is None:
+            raise
+        _note(site, mode)
+        return mode
+
+
+def _raise_mode(site: str, mode: str) -> None:
+    if mode == "enospc":
+        raise OSError(errno.ENOSPC,
+                      f"injected ENOSPC at {site} (disk full)")
+    raise OSError(errno.EIO, f"injected EIO at {site} ({mode})")
+
+
+def consult(site: str) -> None:
+    """Bare guard for IO the caller shapes itself (reads, renames,
+    probes): ENOSPC/EIO/torn all raise their ``OSError`` here (a torn
+    read surface can't half-succeed), slow_write stalls then returns."""
+    mode = _trigger(site)
+    if mode is None:
+        return
+    if mode == "slow_write":
+        time.sleep(SLOW_WRITE_S)
+        return
+    _raise_mode(site, "eio" if mode == "torn_write" else mode)
+
+
+def deferred_consult(site: str) -> str | None:
+    """Like :func:`consult`, but returns ``"torn_write"`` instead of
+    raising it, so the caller can land the torn prefix at its REAL
+    write site (the WAL shape: the garbage must hit the journal tail,
+    where the reader's CRC check is the thing under test).  Every
+    other mode behaves as in :func:`consult`; returns None when
+    nothing fired."""
+    mode = _trigger(site)
+    if mode is None:
+        return None
+    if mode == "slow_write":
+        time.sleep(SLOW_WRITE_S)
+        return None
+    if mode == "torn_write":
+        return "torn_write"
+    _raise_mode(site, mode)
+
+
+def guarded_write(site: str, fh, data):
+    """``fh.write(data)`` under the site's guard.  torn_write lands a
+    PREFIX of the payload and flushes it before raising — the bytes a
+    power loss leaves behind, which the reader's CRC/length checks must
+    catch."""
+    mode = _trigger(site)
+    if mode is not None:
+        if mode == "slow_write":
+            time.sleep(SLOW_WRITE_S)
+        elif mode == "torn_write":
+            fh.write(data[:max(1, len(data) // 2)])
+            fh.flush()
+            raise OSError(errno.EIO,
+                          f"injected torn write at {site}")
+        else:
+            _raise_mode(site, mode)
+    return fh.write(data)
+
+
+def guarded_fsync(site: str, fh) -> None:
+    """``os.fsync(fh)`` under the site's guard (the record may be
+    WRITTEN but not durable when this fires — the wal_fsync shape)."""
+    mode = _trigger(site)
+    if mode is not None:
+        if mode == "slow_write":
+            time.sleep(SLOW_WRITE_S)
+        else:
+            _raise_mode(site, mode)
+    os.fsync(fh.fileno() if hasattr(fh, "fileno") else fh)
+
+
+def guarded_open(site: str, path, mode: str = "r", **kw):
+    """``open(path, mode)`` under the site's guard (a failed open is how
+    a dead directory/quota surfaces before any byte is written)."""
+    m = _trigger(site)
+    if m is not None:
+        if m == "slow_write":
+            time.sleep(SLOW_WRITE_S)
+        else:
+            _raise_mode(site, "eio" if m == "torn_write" else m)
+    return open(path, mode, **kw)
+
+
+def guarded_replace(site: str, src, dst) -> None:
+    """``os.replace(src, dst)`` under the site's guard.  torn_write on a
+    rename surface means the METADATA operation died (EIO) — rename is
+    atomic, so no half-state is modeled; the src file simply stays."""
+    mode = _trigger(site)
+    if mode is not None:
+        if mode == "slow_write":
+            time.sleep(SLOW_WRITE_S)
+        else:
+            _raise_mode(site, "eio" if mode == "torn_write" else mode)
+    os.replace(src, dst)

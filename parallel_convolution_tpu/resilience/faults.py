@@ -77,6 +77,21 @@ SITE_TABLE = {
                               "via serving.chaos.router_kill_due and "
                               "convert the verdict into an abandoned "
                               "stream + a WAL takeover)",
+    "cache_spill":            "before spilling one result-cache entry to "
+                              "its disk tier (serving/cache.py — a failed "
+                              "spill demotes the disk tier, never serves "
+                              "bad bytes)",
+    "cache_promote":          "before reading one disk-tier cache entry "
+                              "back on a hit (a failed promote is a loud "
+                              "journaled miss, never a stale serve)",
+    "events_emit":            "before writing one obs event line "
+                              "(obs/events.py — a failed write counts a "
+                              "dropped line instead of raising into the "
+                              "serving path)",
+    "evidence_write":         "before writing/replacing an evidence file "
+                              "(utils/evidence_io.py — smoke legs surface "
+                              "the failure typed instead of tearing a "
+                              "shared curve)",
 }
 KNOWN_SITES = frozenset(SITE_TABLE)
 

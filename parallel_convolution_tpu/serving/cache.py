@@ -44,16 +44,20 @@ accelerator attached, same rule as the WAL).
 from __future__ import annotations
 
 import dataclasses
+import errno
 import hashlib
 import json
 import os
 import tempfile
 import threading
+import time
 import zlib
 from collections import OrderedDict
 from pathlib import Path
 
 import numpy as np
+
+from parallel_convolution_tpu.resilience import diskio
 
 __all__ = ["ResultCache", "converge_key", "input_digest", "result_key"]
 
@@ -118,7 +122,9 @@ class ResultCache:
     def __init__(self, *, capacity_entries: int = 256,
                  capacity_bytes: int = 256 << 20,
                  disk_dir=None, disk_capacity_entries: int = 1024,
-                 journal=None, dead=None, shard: str | None = None):
+                 journal=None, dead=None, shard: str | None = None,
+                 demote_after: int = 2, reprobe_s: float = 5.0,
+                 clock=time.monotonic):
         if capacity_entries < 1:
             raise ValueError("capacity_entries must be >= 1")
         self.capacity_entries = int(capacity_entries)
@@ -128,6 +134,18 @@ class ResultCache:
         self.shard = None if shard is None else str(shard)
         self._journal = journal
         self._lock = threading.Lock()
+        # Disk-tier degrade ladder (round 24): ``demote_after``
+        # consecutive spill failures demote the tier to memory-only
+        # (a journaled ``tier_demoted`` transition — the WAL shows WHEN
+        # the cross-restart spill surface went dark); while demoted,
+        # one spill attempt per ``reprobe_s`` re-probes the disk, and
+        # the first success journals ``tier_restored`` and re-arms.
+        self.demote_after = max(1, int(demote_after))
+        self.reprobe_s = float(reprobe_s)
+        self._clock = clock
+        self._spill_fail_streak = 0
+        self._disk_demoted = False
+        self._reprobe_at = 0.0
         # ckey -> (arrays, meta, nbytes)
         self._mem: OrderedDict[str, tuple] = OrderedDict()
         self._mem_bytes = 0
@@ -142,6 +160,8 @@ class ResultCache:
             "hits_mem": 0, "hits_disk": 0, "misses": 0, "stores": 0,
             "spills": 0, "evictions": 0, "invalidations": 0,
             "corrupt_drops": 0, "dead_refusals": 0, "journal_errors": 0,
+            "spill_failures": 0, "tier_demotions": 0,
+            "tier_restores": 0, "reprobes": 0,
         }
         if self.disk_dir is not None:
             self.disk_dir.mkdir(parents=True, exist_ok=True)
@@ -206,7 +226,20 @@ class ResultCache:
 
     def _spill_locked(self, ckey: str, arrays: dict, meta: dict) -> None:
         """Memory -> disk: content-addressed file, atomic write, CRC32
-        over header and body (the checkpoint-shard discipline)."""
+        over header and body (the checkpoint-shard discipline).  The
+        ``cache_spill`` fault site guards the write (ENOSPC / EIO /
+        torn / slow via ``resilience.diskio``); failures feed the
+        demote ladder — the entry leaves the cache (journaled dead,
+        never servable-stale) and a failure streak takes the whole
+        tier memory-only until a re-probe heals it."""
+        if self._disk_demoted:
+            if self._clock() < self._reprobe_at:
+                # Tier is dark and the probe window hasn't opened:
+                # leaving memory IS leaving the cache.
+                self._kill_locked(ckey, reason="evictions")
+                return
+            self._reprobe_at = self._clock() + self.reprobe_s
+            self.stats["reprobes"] += 1  # stats-lock: held by caller (_locked suffix)
         names = sorted(arrays)
         body = b"".join(np.ascontiguousarray(arrays[n]).tobytes()
                         for n in names)
@@ -221,20 +254,45 @@ class ResultCache:
         hcrc = zlib.crc32(hjson.encode()) & 0xFFFFFFFF
         blob = f"{hcrc:08x} {hjson}\n".encode() + body
         path = self._disk_path(ckey)
-        fd, tmp = tempfile.mkstemp(dir=str(self.disk_dir),
-                                   prefix=".rc-", suffix=".tmp")
+        tmp = None
         try:
+            # torn_write is deferred so the torn bytes actually get
+            # PUBLISHED (tmp + replace, then the error): the shape an
+            # unsynced page loss leaves behind, which the read path's
+            # CRC must refuse.
+            torn = diskio.deferred_consult("cache_spill") == "torn_write"
+            fd, tmp = tempfile.mkstemp(dir=str(self.disk_dir),
+                                       prefix=".rc-", suffix=".tmp")
             with os.fdopen(fd, "wb") as fh:
-                fh.write(blob)
+                fh.write(blob[:max(1, len(blob) // 2)] if torn else blob)
             os.replace(tmp, path)
+            tmp = None
+            if torn:
+                raise OSError(errno.EIO,
+                              "injected torn write at cache_spill")
         except OSError:
+            if tmp is not None:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+            # Spill failure: the entry leaves the cache entirely —
+            # including any bytes the failure left at its final path
+            # (a torn publish must not await adoption).
             try:
-                os.unlink(tmp)
+                os.unlink(path)
             except OSError:
                 pass
-            # Spill failure: the entry leaves the cache entirely.
             self._kill_locked(ckey, reason="evictions")
+            self.stats["spill_failures"] += 1  # stats-lock: held by caller (_locked suffix)
+            self._spill_fail_streak += 1
+            if (not self._disk_demoted
+                    and self._spill_fail_streak >= self.demote_after):
+                self._demote_tier_locked()
             return
+        self._spill_fail_streak = 0
+        if self._disk_demoted:
+            self._restore_tier_locked()
         self._disk.pop(ckey, None)
         self._disk[ckey] = path
         self.stats["spills"] += 1  # stats-lock: held by caller (_locked suffix)
@@ -242,11 +300,30 @@ class ResultCache:
             self._kill_locked(next(iter(self._disk)),
                               reason="evictions")
 
+    def _demote_tier_locked(self) -> None:
+        """Disk tier -> memory-only (journaled, so the WAL's record
+        stream shows when the cross-restart spill surface went dark).
+        Resident disk entries stay servable — their bytes landed
+        before the device degraded, and every read re-verifies CRC."""
+        self._disk_demoted = True
+        self._reprobe_at = self._clock() + self.reprobe_s
+        self.stats["tier_demotions"] += 1  # stats-lock: held by caller (_locked suffix)
+        self._journal_locked("tier_demoted", "disk")
+
+    def _restore_tier_locked(self) -> None:
+        self._disk_demoted = False
+        self._spill_fail_streak = 0
+        self.stats["tier_restores"] += 1  # stats-lock: held by caller (_locked suffix)
+        self._journal_locked("tier_restored", "disk")
+
     def _read_disk_locked(self, ckey: str):
         path = self._disk.get(ckey)
         if path is None:
             return None
         try:
+            # cache_promote guard: a failed disk read on a hit is a
+            # loud journaled miss (killed below), never a stale serve.
+            diskio.consult("cache_promote")
             blob = path.read_bytes()
             nl = blob.index(b"\n")
             line = blob[:nl].decode("utf-8")

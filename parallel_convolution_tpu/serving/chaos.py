@@ -45,7 +45,7 @@ from parallel_convolution_tpu.resilience.faults import (
 )
 
 __all__ = ["ChaosTransport", "DEFAULT_MODES", "corrupt_frame_bytes",
-           "modes_from_spec", "router_kill_due"]
+           "modes_from_spec", "router_kill_due", "truncate_frame_bytes"]
 
 
 def corrupt_frame_bytes(raw, *, seed: int = 0) -> bytes:
@@ -70,6 +70,23 @@ def corrupt_frame_bytes(raw, *, seed: int = 0) -> bytes:
     return bytes(data)
 
 
+def truncate_frame_bytes(raw, *, seed: int = 0) -> bytes:
+    """Deterministically cut a framed payload SHORT — the mid-stream
+    truncation sibling of :func:`corrupt_frame_bytes`.
+
+    Drops between 1 and 64 trailing bytes (seeded), never the whole
+    buffer, so the decoder sees a structurally plausible PREFIX whose
+    declared lengths overrun the bytes present — the torn-socket shape
+    ``frames.BadFrame``'s truncation checks exist for.  ``seed`` varies
+    the cut depth so a sweep can prove detection isn't positional
+    luck."""
+    data = bytes(raw)
+    if len(data) <= 1:
+        return b""
+    cut = 1 + (seed % min(64, len(data) - 1))
+    return data[:-cut]
+
+
 def router_kill_due() -> bool:
     """Consult the ``router_kill`` fault site: True when the seeded
     plan says the router process dies NOW.  Crash drills
@@ -91,7 +108,7 @@ def router_kill_due() -> bool:
 SITE_MODES = {
     "transport_send": ("drop", "latency", "blackhole"),
     "transport_recv": ("drop", "corrupt"),
-    "transport_stream": ("disconnect", "corrupt"),
+    "transport_stream": ("disconnect", "corrupt", "truncate"),
     "readyz_probe": ("flap",),
 }
 
@@ -257,6 +274,27 @@ class ChaosTransport:
             if mode == "corrupt":
                 raise CorruptReplicaBody(
                     f"chaos: corrupt stream row from {self.name}")
+            if mode == "truncate":
+                # Round 24: run the REAL codec path — encode this row
+                # as a PCTE envelope, tear its tail, and let the
+                # decoder's own truncation check produce the typed
+                # error the router resumes from.  If the torn prefix
+                # somehow decoded, that would be a codec hole — still
+                # surfaced typed, never silently served.
+                from parallel_convolution_tpu.serving import frames
+
+                raw = truncate_frame_bytes(
+                    frames.encode_envelope(dict(row)),
+                    seed=self._rng.randrange(1 << 16))
+                try:
+                    frames.decode_envelope(raw)
+                except frames.BadFrame as e:
+                    raise CorruptReplicaBody(
+                        f"chaos: truncated stream envelope from "
+                        f"{self.name}: {e}") from None
+                raise CorruptReplicaBody(
+                    f"chaos: truncated stream envelope from "
+                    f"{self.name} decoded clean (codec hole)")
             if mode is not None:
                 raise ConnectionError(
                     f"chaos: mid-stream disconnect from {self.name}")

@@ -285,6 +285,22 @@ class JobLedger:
                 self._finalized.popitem(last=False)
             return len(self._jobs)
 
+    def export(self) -> tuple[dict, list[str]]:
+        """The ledger's LIVE image in :meth:`restore`'s shape:
+        (``jobs`` mapping lid → {key, token, resume_count,
+        resumed_from}, ``finalized`` lid list).  The degraded-
+        durability re-arm reads this (round 24): WAL appends that
+        failed during a degraded window never reached the folded
+        state, so the re-arm compaction snapshot must be built from
+        the structures that kept serving — this ledger — not from the
+        journal's stale image."""
+        with self._lock:
+            jobs = {lid: {"key": j.route_key, "token": j.token,
+                          "resume_count": j.resume_count,
+                          "resumed_from": list(j.resumed_from)}
+                    for lid, j in self._jobs.items()}
+            return jobs, list(self._finalized)
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._jobs)

@@ -760,7 +760,8 @@ class ReplicaRouter:
             "hedges": 0, "rejected_tenant_quota": 0,
             "rejected_unavailable": 0, "progressive": 0, "resumes": 0,
             "mid_stream_failovers": 0, "wal_records": 0,
-            "wal_write_errors": 0,
+            "wal_write_errors": 0, "wal_degraded_windows": 0,
+            "wal_rearms": 0,
         })
         # Crash-safe control plane (round 19): a write-ahead journal of
         # admissions / newest resume tokens / finals / ring membership /
@@ -773,6 +774,20 @@ class ReplicaRouter:
         # property of the durable deployment).
         self.wal = None
         self.epoch = 0
+        # Durability degrade ladder (round 24): ``wal_degrade_threshold``
+        # CONSECUTIVE append failures flip the router into a
+        # ``durability: degraded`` window — it keeps serving (the r19
+        # rule: durability failure is never an outage), stamps the
+        # window on every ``router:`` block, and the first append that
+        # succeeds again triggers a RE-ARM: a compaction snapshot built
+        # from the LIVE structures (job ledger, ring, quotas), because
+        # the WAL's own folded state missed everything that happened
+        # during the window — replaying it would resurrect stale bytes.
+        self.wal_degrade_threshold = 3
+        self._wal_fail_streak = 0
+        self._durability_degraded = False
+        self._rearming = False
+        self._wal_need_rearm = False
         # Sharded control plane (round 21): when this router owns one
         # shard of a partitioned ring, ``shard`` is its label — stamped
         # on every outbound body (``router_shard``) so replica-side
@@ -803,13 +818,27 @@ class ReplicaRouter:
         full, injected ``wal_write``/``wal_fsync`` fault) is a LOUD
         counter + event, not a serving outage — the stream keeps
         flowing and recovery falls back to the newest record that DID
-        land (an older boundary: more recompute, same bytes)."""
+        land (an older boundary: more recompute, same bytes).
+
+        Round 24 adds the degrade LADDER on top: a failure streak of
+        ``wal_degrade_threshold`` flips the ``durability: degraded``
+        window (stamped, evented, gauged); the first success after a
+        window re-arms with a live-state compaction snapshot."""
         if self.wal is None:
             return
         try:
             self.wal.append(kind, **fields)
         except Exception as e:  # noqa: BLE001 — durability degrades loudly
             self._bump("wal_write_errors")
+            with self._lock:
+                self._wal_fail_streak += 1
+                degraded_now = (
+                    not self._durability_degraded
+                    and self._wal_fail_streak
+                    >= self.wal_degrade_threshold)
+                if degraded_now:
+                    self._durability_degraded = True
+                    self.stats["wal_degraded_windows"] += 1
             if obs_metrics.enabled():
                 obs_metrics.counter(
                     "pctpu_wal_append_errors_total",
@@ -818,8 +847,114 @@ class ReplicaRouter:
                     kind=kind)
                 obs_events.emit("wal", event="append_failed",
                                 record_kind=kind, error=repr(e)[:200])
+                if degraded_now:
+                    obs_metrics.gauge(
+                        "pctpu_wal_durability_degraded",
+                        "1 while the router serves inside a degraded-"
+                        "durability window (sustained WAL append "
+                        "failure), 0 when armed").set(1)
+                    obs_events.emit(
+                        "wal", event="durability_degraded",
+                        streak=self._wal_fail_streak,
+                        record_kind=kind)
         else:
             self._bump("wal_records")
+            with self._lock:
+                self._wal_fail_streak = 0
+                # The heal signal only SETS a flag: this append may be
+                # running under a quota-bucket or ledger lock (the debt
+                # journal hook), and the re-arm's compaction snapshot
+                # re-reads those very structures — re-arming inline
+                # here deadlocks.  The serving paths drain the flag at
+                # their next lock-free point (_maybe_rearm).
+                if self._durability_degraded and not self._rearming:
+                    self._wal_need_rearm = True
+
+    def _maybe_rearm(self) -> None:
+        """Drain a pending re-arm at a point where the caller holds no
+        quota/ledger locks (request admission, the converge row loop).
+        A failed re-arm keeps the window open; the next healthy append
+        re-raises the flag."""
+        if not self._wal_need_rearm:
+            return
+        with self._lock:
+            if not self._wal_need_rearm:
+                return
+            self._wal_need_rearm = False
+        self._rearm_wal()
+
+    def _live_state_image(self):
+        """A :class:`~.wal.WALState` built from the structures that
+        KEPT SERVING through a degraded window — the job ledger, the
+        live ring, the quota buckets, the current epoch — merged with
+        the folded state's charge identities and cache tombstones.
+        This is what the re-arm compaction snapshot carries: the
+        journal's own folded image is the pre-window world and
+        replaying it would resurrect stale tokens and un-finalized
+        jobs whose finals already went out."""
+        from parallel_convolution_tpu.serving.wal import WALState
+
+        state = WALState()
+        state.epoch = self.epoch
+        jobs, finalized = self.jobs.export()
+        old = self.wal.state
+        for lid, job in jobs.items():
+            prior = old.jobs.get(lid)
+            # Charge identity (cost/budget/wu_start) rides only the
+            # WAL admit record, so the folded copy is its one source;
+            # a job admitted DURING the window never journaled one and
+            # stays refund-less across a later crash (documented
+            # trade-off — the window was loud).
+            if prior is not None and prior.get("key") == job["key"]:
+                for k in ("cost", "budget", "wu_start"):
+                    job[k] = prior.get(k)
+        state.jobs = jobs
+        state.finalized = {lid: True for lid in finalized}
+        state.ring = set(self.ring.members())
+        state.ring_ever = set(old.ring_ever) | state.ring
+        # Cache tombstones: keep the folded set — deaths journaled
+        # during the window were lost, but the cache's own CRC + the
+        # journaled-transition rule mean a stale ENTRY can still never
+        # serve stale BYTES (DESIGN.md "Storage fault domains").
+        state.cache_dead = dict(old.cache_dead)
+        if self.quotas is not None:
+            state.debts = {t: float(lvl)
+                           for t, lvl in self.quotas.snapshot().items()}
+        else:
+            state.debts = dict(old.debts)
+        return state
+
+    def _rearm_wal(self) -> None:
+        """Leave the degraded window: rotate the WAL behind a
+        compaction snapshot of the LIVE state.  Failure keeps the
+        window open (the heal was premature); success flips the stamp
+        back to ``ok`` and counts a re-arm."""
+        with self._lock:
+            if not self._durability_degraded or self._rearming:
+                return
+            self._rearming = True
+        try:
+            image = self._live_state_image()
+            self.wal.compact(image)
+        except Exception as e:  # noqa: BLE001 — still degraded
+            if obs_metrics.enabled():
+                obs_events.emit("wal", event="rearm_failed",
+                                error=repr(e)[:200])
+            return
+        finally:
+            with self._lock:
+                self._rearming = False
+        with self._lock:
+            self._durability_degraded = False
+            self.stats["wal_rearms"] += 1
+        if obs_metrics.enabled():
+            obs_metrics.gauge(
+                "pctpu_wal_durability_degraded",
+                "1 while the router serves inside a degraded-"
+                "durability window (sustained WAL append failure), "
+                "0 when armed").set(0)
+            obs_events.emit("wal", event="durability_rearmed",
+                            jobs=len(self.jobs), epoch=self.epoch)
 
     def _refund(self, tenant: str, amount: float) -> None:
         """Quota refund + its WAL debt record (one path; the journal
@@ -1025,6 +1160,13 @@ class ReplicaRouter:
         version — the trace/attribution identity of the router life
         that served the request."""
         fields["epoch"] = self.epoch
+        if self.wal is not None:
+            # Degraded-durability honesty (round 24): every response
+            # and NDJSON row served inside a degraded window says so —
+            # a client that cares about crash-safety can tell these
+            # results were produced while the journal was dark.
+            fields["durability"] = ("degraded" if self._durability_degraded
+                                    else "ok")
         if self.shard is not None:
             fields["shard"] = self.shard
             fields["map_version"] = self.map_version
@@ -1294,6 +1436,10 @@ class ReplicaRouter:
                     home="", replica="", attempts=0, failovers=0,
                     spills=0)
                 return status, wire
+            # Admission's debt record may just have healed a degraded
+            # window; the bucket lock is released now, so the re-arm
+            # can run — this very response then stamps ``ok``.
+            self._maybe_rearm()
             key = route_key(body)
             self._observe_config(key, body)
             sp.set(key=key)
@@ -1757,6 +1903,7 @@ class ReplicaRouter:
                         wu_last = max(wu_last, float(
                             row.get("work_units", 0.0) or 0.0))
                         rows_flowed += 1
+                        self._maybe_rearm()
                         stamp = self._stamp(replica=rep.name)
                         n_res, res_from = self.jobs.resume_info(lid)
                         if n_res:
@@ -2054,7 +2201,9 @@ class ReplicaRouter:
             "epoch": self.epoch,
             **({"shard": self.shard, "map_version": self.map_version}
                if self.shard is not None else {}),
-            **({"wal": self.wal.snapshot()}
+            **({"wal": self.wal.snapshot(),
+                "durability": ("degraded" if self._durability_degraded
+                               else "ok")}
                if self.wal is not None else {}),
             **({"tenants": self.quotas.snapshot()}
                if self.quotas is not None else {}),

@@ -37,9 +37,19 @@ Design points, in the ``obs/events.py`` atomic-rotation discipline:
   state serialized.
 * **Fault sites** ``wal_write`` / ``wal_fsync``
   (``resilience.faults.SITE_TABLE``): consulted before each append and
-  each fsync, so the chaos drills can fail durability without failing
-  serving (the router treats a WAL append error as a loud counter, not
-  an outage).
+  each fsync THROUGH ``resilience.diskio``, so the chaos drills can
+  fail durability without failing serving — and the round-24 disk
+  modes can shape the failure (ENOSPC / EIO / a torn write that lands
+  garbage bytes / a slow write that stalls).  A failed append HEALS
+  its own tail: partial bytes from the failed record are amputated so
+  the next successful append lands on a clean record boundary instead
+  of turning a survivable torn tail into mid-log corruption.
+* **Degraded-window re-arm** (:meth:`RouterWAL.compact`): appends that
+  failed never folded into ``self.state``, so after a degraded window
+  the folded image is STALE.  The router re-arms by handing a fresh
+  state image built from its LIVE structures; ``compact`` rotates
+  immediately so the new generation's head snapshot carries that live
+  image and replay can never resurrect the pre-window world.
 
 Record vocabulary (see DESIGN.md "Durable control plane"):
 
@@ -68,6 +78,7 @@ with no accelerator attached.
 from __future__ import annotations
 
 import contextlib
+import errno
 import json
 import os
 import threading
@@ -80,7 +91,7 @@ try:
 except ImportError:  # non-unix: lineage fencing stays inode-only
     fcntl = None
 
-from parallel_convolution_tpu.resilience.faults import fault_point
+from parallel_convolution_tpu.resilience import diskio
 
 __all__ = ["RECORD_KINDS", "RouterWAL", "WALCorrupt", "WALFenced",
            "WALState", "encode_record", "parse_line", "read_wal"]
@@ -246,9 +257,16 @@ class WALState:
             # never be served after recovery) or "live" (a re-store of
             # the same key after a later miss re-executed it — lifts
             # the tombstone so the fresh bytes are servable again).
+            # "tier_demoted"/"tier_restored" (round 24) journal the
+            # disk tier's degrade-ladder transitions: durable TRACE
+            # records, not tombstones — the rebuilt cache re-probes
+            # its own disk at startup anyway.  Any other op tombstones
+            # conservatively (an unknown future op must not serve).
             op = rec.get("op", "dead")
             ckey = str(rec["ckey"])
-            if op == "live":
+            if op in ("tier_demoted", "tier_restored"):
+                pass
+            elif op == "live":
                 self.cache_dead.pop(ckey, None)
             else:
                 # Re-insert at the end: recency-ordered so the cap
@@ -432,6 +450,7 @@ class RouterWAL:
         self._size = 0
         self._seq = 0
         self.records_written = 0
+        self.tail_heals = 0
         self.state = WALState()
         self.recovery_report: dict = {}
         with self._file_lock():
@@ -601,19 +620,40 @@ class RouterWAL:
                 "router (live inode changed); this writer is fenced")
 
     def _write_locked(self, kind: str, fields: dict,
-                      prebuilt: tuple[dict, str] | None = None) -> dict:
+                      prebuilt: tuple[dict, str] | None = None,
+                      torn: bool = False) -> dict:
         """``prebuilt`` is ``(rec, line)`` already encoded for the
         CURRENT seq+1 (the append fast path — one json.dumps per
         record, not two); it is invalid after a rotation bumped the
-        seq, so the rotation path passes None and re-encodes."""
+        seq, so the rotation path passes None and re-encodes.
+        ``torn=True`` is the injected torn-write shape: a prefix of the
+        record's bytes lands, then EIO — after which the tail heal
+        amputates them like any other failed write."""
         if prebuilt is not None and prebuilt[0]["seq"] == self._seq + 1:
             rec, line = prebuilt
         else:
             rec = {"seq": self._seq + 1, "kind": kind, **fields}
             line = encode_record(rec)
         nbytes = len(line.encode("utf-8"))
-        self._fh.write(line)
-        self._fh.flush()
+        start = self._size
+        try:
+            if torn:
+                self._fh.write(line[:max(1, len(line) // 2)])
+                self._fh.flush()
+                raise OSError(
+                    errno.EIO, "injected torn write at wal_write")
+            self._fh.write(line)
+            self._fh.flush()
+        except OSError:
+            # A failed write may have landed PARTIAL bytes.  Heal the
+            # tail back to the last good record boundary now, while we
+            # still know where it is: without this, the next successful
+            # append would land after garbage, turning a survivable
+            # torn TAIL into mid-log corruption that replay must
+            # quarantine.  seq/size/state are untouched — the record
+            # was never appended.
+            self._heal_tail_locked(start)
+            raise
         self._seq += 1
         self._size += nbytes
         self.state.apply(rec)
@@ -622,9 +662,26 @@ class RouterWAL:
             # After flush, before fsync: an fsync failure leaves the
             # record written-but-not-durable — the caller counts it;
             # the sequence stays consistent either way.
-            fault_point("wal_fsync")
-            os.fsync(self._fh.fileno())
+            diskio.guarded_fsync("wal_fsync", self._fh)
         return rec
+
+    def _heal_tail_locked(self, valid_bytes: int) -> None:
+        """Best-effort amputation of a failed append's partial bytes.
+        The fh is dropped first — its buffer may still hold the failed
+        record, and a later flush would resurrect those bytes AFTER
+        the truncate — then the file is cut back to the last good
+        boundary.  If the heal itself fails (the device is truly
+        gone), the partial bytes remain: a crash now reads as the one
+        tolerated torn tail; a later successful append reads as loud
+        quarantine — never a silent replay of garbage."""
+        with contextlib.suppress(OSError, ValueError):
+            self._fh.close()
+        self._fh = None
+        try:
+            os.truncate(self.path, valid_bytes)
+            self.tail_heals += 1
+        except OSError:
+            pass
 
     def _rotate_locked(self) -> None:
         self._fh.close()
@@ -661,8 +718,10 @@ class RouterWAL:
     def append(self, kind: str, **fields) -> dict:
         """Append one record (write-ahead: call BEFORE acting on it).
         Returns the record written.  Raises on an unknown kind, an
-        injected ``wal_write``/``wal_fsync`` fault, or a real I/O
-        error — callers decide whether durability failure is fatal."""
+        injected ``wal_write``/``wal_fsync`` fault (``OSError``-shaped
+        when a ``resilience.diskio`` mode is installed, the raw
+        ``InjectedFault`` otherwise), or a real I/O error — callers
+        decide whether durability failure is fatal."""
         if kind not in RECORD_KINDS:
             raise ValueError(
                 f"unknown WAL record kind {kind!r}; known: "
@@ -670,7 +729,10 @@ class RouterWAL:
         if self.shard is not None:
             fields.setdefault("shard", self.shard)
         with self._lock, self._file_lock():
-            fault_point("wal_write")
+            # One consult per append attempt (ENOSPC/EIO raise here,
+            # before any byte lands; slow stalls; torn defers to the
+            # actual record write below so the garbage hits the tail).
+            torn = diskio.deferred_consult("wal_write") == "torn_write"
             self._ensure_open()
             self._check_lineage_locked()
             rec = {"seq": self._seq + 1, "kind": kind, **fields}
@@ -679,7 +741,28 @@ class RouterWAL:
                     and self._size > 0):
                 self._rotate_locked()   # bumps seq: prebuilt invalid
             return self._write_locked(kind, fields,
-                                      prebuilt=(rec, line))
+                                      prebuilt=(rec, line), torn=torn)
+
+    def compact(self, state: WALState | None = None) -> dict:
+        """Rotate NOW, heading the fresh live file with a compaction
+        snapshot — of ``state`` when given, else the WAL's own folded
+        state.  Returns the wire image the snapshot carried.
+
+        This is the degraded-durability RE-ARM entry point: records
+        that failed to append during a degraded window never folded
+        into ``self.state``, so the folded image is the PRE-window
+        world — stale tokens, jobs whose finals already went out.  The
+        router passes an image built from its LIVE structures the
+        moment a write succeeds again; the degraded-window history
+        stays in ``.1``, and replay of the new head can resurrect
+        nothing stale."""
+        with self._lock, self._file_lock():
+            self._ensure_open()
+            self._check_lineage_locked()
+            if state is not None:
+                self.state = state
+            self._rotate_locked()
+            return self.state.to_wire()
 
     # -- lifecycle ------------------------------------------------------------
     def close(self) -> None:
@@ -697,6 +780,7 @@ class RouterWAL:
                 "shard": self.shard,
                 "seq": self._seq,
                 "records_written": self.records_written,
+                "tail_heals": self.tail_heals,
                 "size_bytes": self._size,
                 "epoch": self.state.epoch,
                 "jobs": len(self.state.jobs),

@@ -59,6 +59,7 @@ from parallel_convolution_tpu.obs import events as obs_events, metrics as obs_me
 from parallel_convolution_tpu.parallel.mesh import (
     block_sharding, grid_shape, padded_extent,
 )
+from parallel_convolution_tpu.resilience import diskio
 from parallel_convolution_tpu.resilience.faults import (
     InjectedFault, fault_point,
 )
@@ -270,17 +271,19 @@ def save_state(ckpt_dir, arr: jax.Array, meta: dict) -> None:
         buf = io.BytesIO()
         np.save(buf, np.asarray(shard.data))
         raw = buf.getvalue()
-        fault_point("checkpoint_write_shard")
+        # Routed through diskio (round 24) so drills can shape the
+        # failure (ENOSPC/EIO/slow); bare injections raise raw as ever.
+        diskio.consult("checkpoint_write_shard")
         tmp = snap / (name + ".tmp")
         tmp.write_bytes(raw)
         os.replace(tmp, snap / name)
         shards[name] = {"crc32": zlib.crc32(raw), "bytes": len(raw)}
     meta = dict(meta, shards=shards)
-    fault_point("checkpoint_write_meta")
+    diskio.consult("checkpoint_write_meta")
     tmp = snap / (META_NAME + ".tmp")
     tmp.write_text(json.dumps(meta))
     os.replace(tmp, snap / META_NAME)
-    fault_point("checkpoint_write_meta")
+    diskio.consult("checkpoint_write_meta")
     ptr_tmp = d / (LATEST_NAME + ".tmp")
     ptr_tmp.write_text(snap.name)
     os.replace(ptr_tmp, d / LATEST_NAME)

@@ -31,6 +31,8 @@ import os
 import tempfile
 from pathlib import Path
 
+from parallel_convolution_tpu.resilience import diskio
+
 __all__ = ["rewrite_shared_jsonl"]
 
 
@@ -59,6 +61,10 @@ def rewrite_shared_jsonl(path, rows, *, lane: str | None = None) -> int:
         if lane is not None:
             r.setdefault("lane", lane)
         out_rows.append(r)
+    # evidence_write guard (round 24): a full/dying disk surfaces HERE,
+    # typed, before any byte moves — the temp+replace discipline below
+    # means a fault can never tear the shared curve itself.
+    diskio.consult("evidence_write")
     fd, tmp = tempfile.mkstemp(dir=str(p.parent), prefix=f".{p.name}.",
                                suffix=".tmp")
     try:

@@ -25,6 +25,11 @@ mid-stream replica KILL.  Gates, in order of importance:
    responses, mid-stream failovers and resumes in ``/stats`` match what
    the chaos wrappers report injecting.
 
+``--volume`` (round 24) adds a rank-3 drill: a (D,H,W) volume converge
+stream through a mid-stream replica kill must resume from its ledger
+token on a survivor and finish byte-identical to the uninterrupted
+volume oracle.
+
 The summary row lands in ``--out`` (``evidence/chaos_smoke.json``) with
 ``"failures": 0`` iff every gate held, then feeds ``perf_gate.py``
 against the smoke's OWN history file (seed + re-gate — never the
@@ -54,6 +59,11 @@ def main() -> int:
     ap.add_argument("--cols", type=int, default=56)
     ap.add_argument("--mesh", default="1x2", help="grid per replica")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--volume", action="store_true",
+                    help="also drill a rank-3 volume converge stream "
+                         "through a mid-stream replica kill: resume "
+                         "from the ledger token, finish byte-identical "
+                         "to the uninterrupted volume oracle (round 24)")
     ap.add_argument("--out", default="evidence/chaos_smoke.json")
     ap.add_argument("--history",
                     default="evidence/chaos_smoke_history.jsonl",
@@ -242,6 +252,55 @@ def main() -> int:
                             "to the oracle run")
     router.replica(victim).revive()
 
+    # ---- phase 3b (--volume): rank-3 volume stream through a kill ---------
+    # r23 only drilled the volume reshape shed in-process; this is the
+    # cross-replica saga: kill the replica serving a (D,H,W) converge
+    # stream mid-flight, resume from the job-ledger token on a
+    # survivor, land byte-identical to the uninterrupted volume oracle.
+    vol_drill = None
+    if args.volume:
+        vol = np.random.default_rng(11).random((2, 4, 16, 16),
+                                               dtype=np.float32)
+        vbody = {"rows": 16, "cols": 16, "depth": 4, "mode": "volume",
+                 "volume_b64": base64.b64encode(vol.tobytes()).decode(),
+                 "filter": "wave", "boundary": "periodic", "tol": 0.0,
+                 "max_iters": 12, "check_every": 4,
+                 "request_id": "cv-vol", "tenant": "drill"}
+        try:
+            vol_oracle = oracle_converge_final(factory, dict(vbody))
+        except RuntimeError as e:
+            failures.append(f"volume oracle run failed: {e}")
+            vol_oracle = {}
+        st, rows = router.converge(dict(vbody))
+        it = iter(rows)
+        first = next(it)
+        vvictim = first.get("router", {}).get("replica", "")
+        router.replica(vvictim).kill()
+        obs_events.emit("router", event="kill", replica=vvictim)
+        got = drain([first, *it])
+        final = got[-1]
+        if final.get("kind") != "final":
+            failures.append(
+                f"volume kill drill did not finish: {final}")
+        else:
+            stamp = final.get("router", {})
+            if (stamp.get("resume_count", 0) < 1
+                    or vvictim not in stamp.get("resumed_from", [])):
+                failures.append(
+                    f"volume kill drill: no resume off {vvictim!r} "
+                    f"({stamp})")
+            else:
+                resumed_jobs += 1
+            if final.get("image_b64") != vol_oracle.get("image_b64"):
+                failures.append(
+                    "volume kill-drill final row is NOT byte-identical "
+                    "to the volume oracle run")
+        router.replica(vvictim).revive()
+        vol_drill = {"killed": vvictim,
+                     "resume_count": final.get("router", {}).get(
+                         "resume_count", 0),
+                     "iters": final.get("iters")}
+
     # ---- gates over the whole run -----------------------------------------
     dup_finals = {rid: n for rid, n in finals_per_rid.items() if n != 1}
     if dup_finals:
@@ -293,6 +352,7 @@ def main() -> int:
         "one_job_units": round(one_job, 6),
         "jobs_ledger": snap["jobs"],
         "killed": victim,
+        **({"volume_drill": vol_drill} if vol_drill else {}),
         "effective_backend": "shifted",
         "mesh": args.mesh,
         "wall_s": round(wall, 3),

@@ -33,7 +33,9 @@ set, or point one ``--target`` at ``scripts/router.py`` — responses
 carrying a ``router`` stamp feed the row's ``failovers_observed``, and
 (round 19) their fencing-epoch stamps feed ``router_restarts_observed``
 — the count of router restarts/takeovers this client watched happen
-while its run kept completing.
+while its run kept completing.  Round 24: the durability stamp feeds
+``degraded_served`` — completions answered while the router's WAL was
+in a degraded-durability window (served correctly, persisted less).
 
 Round 21: ``--shardmap`` makes multiple ``--target`` URLs a SHARDED
 control-plane fleet (scripts/router.py --shards N): the client fetches
@@ -614,13 +616,14 @@ def main() -> int:
     service = None
     if args.in_process:
         from parallel_convolution_tpu.obs import events as obs_events
-        from parallel_convolution_tpu.resilience import faults
+        from parallel_convolution_tpu.resilience import diskio, faults
         from parallel_convolution_tpu.serving.frontend import InProcessClient
         from parallel_convolution_tpu.serving.service import (
             ConvolutionService,
         )
 
         faults.install_from_env()
+        diskio.install_from_env()   # PCTPU_DISK_MODES: disk fault shapes
         obs_events.install_from_env()  # PCTPU_OBS_EVENTS: leave a timeline
         mesh = None
         if args.mesh:
@@ -916,6 +919,14 @@ def main() -> int:
             and r["router"]["replica"] != r["router"]["home"]))
     replicas_seen = sorted({r.get("router", {}).get("replica", "")
                             for _, r in completed} - {""})
+    # Round 24: the router stamps its durability mode on every
+    # response.  Completions served while the WAL was in its degraded
+    # window are still correct answers — but the client can now COUNT
+    # how many of its requests rode on reduced durability, so a smoke
+    # can assert both "kept serving" and "window actually closed".
+    degraded_served = sum(
+        1 for _, r in completed
+        if r.get("router", {}).get("durability") == "degraded")
     # Round 21: which control-plane shards served this client's keys —
     # plus how often the shard map had to be re-fetched mid-run (>1
     # means a redirect/takeover was observed and absorbed).
@@ -975,6 +986,8 @@ def main() -> int:
         "rejected": rejected,
         "rejected_retried": retried[0],
         "failovers_observed": failovers_observed,
+        **({"degraded_served": degraded_served}
+           if degraded_served else {}),
         **({"replicas_seen": replicas_seen} if replicas_seen else {}),
         **({"shards_seen": shards_seen} if shards_seen else {}),
         **({"shardmap_refreshes": sharded.refreshes}

@@ -474,6 +474,82 @@ def cache_lane_flags(rows: list[dict], *, min_top_hit_rate: float,
     return out
 
 
+def storage_smoke_flags(row: dict | None, *, min_modes: int = 4,
+                        min_workloads: int = 5) -> list[dict]:
+    """Gate the storage-chaos matrix row (``evidence/storage_smoke.json``
+    from ``scripts/chaos_matrix.py``).  Holds:
+
+    * the row exists and reports ``failures: 0`` — missing or
+      unreadable evidence is a flag, never a pass;
+    * the matrix actually covered the advertised surface: >=
+      ``min_modes`` fault modes x >= ``min_workloads`` workloads, every
+      cell ``ok``, and each non-kill cell's fault actually fired;
+    * the ENOSPC degrade drill's acceptance chain held end-to-end:
+      a degraded-durability window was OBSERVED (stamped on responses),
+      durability re-armed on heal, the degraded-window finalization
+      survived into the replay, and zero stale jobs resurrected;
+    * both site drills ran: ``events_emit`` dropped lines instead of
+      raising, ``evidence_write`` failed typed with the shared curve
+      intact.
+    """
+    if not row:
+        return [{"check": "storage_smoke",
+                 "why": "no storage-smoke evidence row"}]
+    out = []
+    if row.get("failures"):
+        out.append({"check": "storage_failures",
+                    "failures": row["failures"],
+                    "detail": row.get("failure_detail", [])[:4],
+                    "why": "storage-chaos matrix reported failures"})
+    cells = row.get("cells") or []
+    modes = {c.get("mode") for c in cells}
+    workloads = {c.get("workload") for c in cells}
+    if len(modes) < min_modes or len(workloads) < min_workloads:
+        out.append({"check": "storage_coverage",
+                    "modes": sorted(str(m) for m in modes),
+                    "workloads": sorted(str(w) for w in workloads),
+                    "why": f"matrix thinner than {min_modes} modes x "
+                           f"{min_workloads} workloads"})
+    bad = [c["cell"] for c in cells if not c.get("ok")]
+    if bad:
+        out.append({"check": "storage_cells", "cells": bad[:6],
+                    "why": f"{len(bad)} matrix cell(s) failed"})
+    dead = [c["cell"] for c in cells
+            if c.get("mode") != "kill" and not c.get("injected")]
+    if dead:
+        out.append({"check": "storage_injection", "cells": dead[:6],
+                    "why": "cells whose fault never fired (a dead "
+                           "drill proves nothing)"})
+    drill = row.get("enospc_drill") or {}
+    for field, label in (("degraded_window", "no degraded-durability "
+                                             "window observed"),
+                         ("rearmed", "durability did not re-arm on "
+                                     "heal"),
+                         ("finalized_carried", "degraded-window "
+                          "finalization lost across replay")):
+        if not drill.get(field):
+            out.append({"check": "storage_degrade_ladder",
+                        "field": field, "why": label})
+    if drill.get("stale_live_jobs"):
+        out.append({"check": "storage_degrade_ladder",
+                    "field": "stale_live_jobs",
+                    "count": drill["stale_live_jobs"],
+                    "why": "replay after the healed window resurrected "
+                           "stale jobs"})
+    site = row.get("site_drills") or {}
+    ev = site.get("events_emit") or {}
+    if not ev.get("dropped"):
+        out.append({"check": "storage_site_drills", "site": "events_emit",
+                    "why": "events_emit drill dropped nothing"})
+    evw = site.get("evidence_write") or {}
+    if not (evw.get("typed") and evw.get("curve_intact")):
+        out.append({"check": "storage_site_drills",
+                    "site": "evidence_write",
+                    "why": "evidence_write fault not typed or the "
+                           "shared curve was torn"})
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--history", default=None,
@@ -545,14 +621,23 @@ def main() -> int:
     ap.add_argument("--cache-unique-p99-mult", type=float, default=1.5,
                     help="all-unique p99 with cache on must stay "
                          "within this multiple of cache off")
+    ap.add_argument("--storage-smoke", default=None, metavar="JSON",
+                    help="storage-chaos matrix evidence to gate "
+                         "(evidence/storage_smoke.json from scripts/"
+                         "chaos_matrix.py): every cell green, every "
+                         "fault fired, the ENOSPC degrade ladder "
+                         "(degrade -> serve -> re-arm -> clean replay) "
+                         "held, both site drills passed")
     ap.add_argument("--out", default=None,
                     help="also write the JSON report to this path")
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args()
     if (not args.row and not args.drift_metrics and not args.wire_ab
-            and not args.router_scale and not args.cache_lane):
+            and not args.router_scale and not args.cache_lane
+            and not args.storage_smoke):
         print("need --row, --drift-metrics, --wire-ab, "
-              "--router-scale, and/or --cache-lane", file=sys.stderr)
+              "--router-scale, --cache-lane, and/or --storage-smoke",
+              file=sys.stderr)
         return 2
     if args.row and not args.history:
         print("--row needs --history", file=sys.stderr)
@@ -618,6 +703,14 @@ def main() -> int:
             hit_p99_ratio=args.cache_hit_p99_ratio,
             unique_p99_mult=args.cache_unique_p99_mult)
 
+    stflags = []
+    if args.storage_smoke:
+        try:
+            srow = json.loads(Path(args.storage_smoke).read_text())
+        except (OSError, ValueError):
+            srow = None   # missing/unreadable evidence IS the flag
+        stflags = storage_smoke_flags(srow)
+
     regressions = [v for v in verdicts if v["status"] == "regression"]
     if args.update and hist_path:
         # Append-only, one line per gated row — regressions too: a real
@@ -650,6 +743,7 @@ def main() -> int:
         "wire_ab_flags": wflags,
         "router_scale_flags": sflags,
         "cache_lane_flags": cflags,
+        "storage_smoke_flags": stflags,
         "updated": bool(args.update),
     }
     if not args.quiet:
@@ -670,6 +764,8 @@ def main() -> int:
             print(f"router_scale {fl['check']}: {fl['why']}")
         for fl in cflags:
             print(f"cache_lane {fl['check']}: {fl['why']}")
+        for fl in stflags:
+            print(f"storage    {fl['check']}: {fl['why']}")
     if args.out:
         p = Path(args.out)
         p.parent.mkdir(parents=True, exist_ok=True)
@@ -677,7 +773,7 @@ def main() -> int:
     else:
         print(json.dumps(report))
     return 1 if (regressions or flags or wflags or sflags
-                 or cflags) else 0
+                 or cflags or stflags) else 0
 
 
 if __name__ == "__main__":

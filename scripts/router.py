@@ -151,13 +151,14 @@ def main() -> int:
         force_platform(args.platform, warn=True)
 
     from parallel_convolution_tpu.obs import events as obs_events
-    from parallel_convolution_tpu.resilience import faults
+    from parallel_convolution_tpu.resilience import diskio, faults
     from parallel_convolution_tpu.serving.router import (
         HTTPReplica, InProcessReplica, ReplicaRouter, TenantQuotas,
         make_router_http_server,
     )
 
     faults.install_from_env()
+    diskio.install_from_env()   # PCTPU_DISK_MODES: storage fault shapes
     obs_events.install_from_env()
 
     if args.target:

@@ -207,6 +207,38 @@
 #                                      Row (failures: 0) lands in
 #                                      evidence/volume_smoke.json (the
 #                                      supervisor leg's done_file).
+#   scripts/run_t1.sh --storage-smoke  storage-fault survival (round 24):
+#                                      the unified chaos matrix crosses
+#                                      every disk fault mode {ENOSPC,
+#                                      EIO, torn-write, slow-write,
+#                                      process kill} with every workload
+#                                      shape {batch JSON, batch frames,
+#                                      converge resume, rank-3 volume
+#                                      stream, cross-shard takeover,
+#                                      cache hit/spill}, one seeded cell
+#                                      per pair, gating the standing
+#                                      invariants in every cell: zero
+#                                      non-typed failures, byte-identical
+#                                      or typed-retryable completions,
+#                                      exactly-once finals, no stale-byte
+#                                      serves, and the fault actually
+#                                      fired.  Site drills cover
+#                                      events_emit (dropped lines, never
+#                                      a raise) and evidence_write (typed
+#                                      before any byte moves); the ENOSPC
+#                                      degrade drill proves the
+#                                      durability ladder: serve through a
+#                                      degraded-durability window
+#                                      (stamped on every response),
+#                                      re-arm on heal with a live-state
+#                                      compaction snapshot, and a
+#                                      post-heal replay that resurrects
+#                                      nothing stale.  Row (failures: 0)
+#                                      lands in
+#                                      evidence/storage_smoke.json (the
+#                                      supervisor leg's done_file); the
+#                                      lane gate report in
+#                                      evidence/storage_gate.json.
 #   scripts/run_t1.sh --static         fast static gate (no jax): every
 #                                      .py byte-compiles, no bare
 #                                      'except:', every mutation of a
@@ -406,6 +438,15 @@ if [ "${1:-}" = "--volume-smoke" ]; then
       --out evidence/volume_smoke.json
 fi
 
+if [ "${1:-}" = "--storage-smoke" ]; then
+  exec timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    PCTPU_OBS=1 \
+    python scripts/chaos_matrix.py --rows 40 --cols 56 --mesh 1x2 \
+      --out evidence/storage_smoke.json \
+      --gate-out evidence/storage_gate.json
+fi
+
 if [ "${1:-}" = "--static" ]; then
   exec timeout -k 10 120 \
     python scripts/static_check.py --out evidence/static_check.json
@@ -426,7 +467,7 @@ if [ "${1:-}" = "--chaos-smoke" ]; then
     XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     PCTPU_OBS=1 \
     python scripts/chaos_smoke.py --n 30 --rows 40 --cols 56 \
-      --mesh 1x2 --out evidence/chaos_smoke.json
+      --mesh 1x2 --volume --out evidence/chaos_smoke.json
 fi
 
 if [ "${1:-}" = "--router-smoke" ]; then

@@ -75,12 +75,13 @@ def main() -> int:
         force_platform(args.platform, warn=True)
 
     from parallel_convolution_tpu.obs import events as obs_events
-    from parallel_convolution_tpu.resilience import faults
+    from parallel_convolution_tpu.resilience import diskio, faults
     from parallel_convolution_tpu.serving.frontend import make_http_server
     from parallel_convolution_tpu.serving.service import ConvolutionService
     from parallel_convolution_tpu.utils.platform import enable_compile_cache
 
     faults.install_from_env()
+    diskio.install_from_env()   # PCTPU_DISK_MODES: storage fault shapes
     obs_events.install_from_env()  # PCTPU_OBS_EVENTS: the event timeline
     enable_compile_cache()
 

@@ -550,8 +550,20 @@ def run_chaos_drill(args) -> int:
     def cbody(rid: str) -> dict:
         return converge_body(b64, 40, 56, rid)
 
+    def vbody(rid: str) -> dict:
+        # The rank-3 drill body (--volume): a (D,H,W) wave relaxation,
+        # small enough that every cycle can afford the stream.
+        vol = np.random.default_rng(args.seed).random(
+            (2, 4, 16, 16), dtype=np.float32)
+        return {"rows": 16, "cols": 16, "depth": 4, "mode": "volume",
+                "volume_b64": base64.b64encode(vol.tobytes()).decode(),
+                "filter": "wave", "boundary": "periodic", "tol": 0.0,
+                "max_iters": 12, "check_every": 4, "request_id": rid}
+
     try:
         oracle_final = oracle_converge_final(factory, cbody("oracle"))
+        vol_oracle = (oracle_converge_final(factory, vbody("oracle-v"))
+                      if args.volume else None)
     except RuntimeError as e:
         print(json.dumps({"summary": "chaos", "failures": 1,
                           "detail": str(e)}))
@@ -629,6 +641,48 @@ def run_chaos_drill(args) -> int:
                 failures.append(
                     f"cycle {cycle}: converge ended non-rejected: "
                     f"{ {k: v for k, v in final.items() if k != 'image_b64'} }")
+            if args.volume:
+                # Rank-3 drill (round 24): the volume stream rides the
+                # SAME cycle schedule; odd cycles kill its replica
+                # mid-flight (even cycles killed the 2-D stream's), so
+                # the run covers both volume-kill-resume and
+                # volume-under-transport-faults.
+                vrid = f"vol{cycle}"
+                status, vrows = router.converge(vbody(vrid))
+                vit = iter(vrows)
+                vdrained = []
+                vvictim = ""
+                try:
+                    vfirst = next(vit)
+                    vdrained.append(vfirst)
+                    if cycle % 2 == 1:
+                        vvictim = vfirst.get("router", {}).get(
+                            "replica", "")
+                        if vvictim:
+                            router.replica(vvictim).kill()
+                    vdrained.extend(vit)
+                except StopIteration:
+                    pass
+                if vvictim:
+                    router.replica(vvictim).revive()
+                vfinal = vdrained[-1] if vdrained else {}
+                for r in vdrained:
+                    if r.get("kind") == "final":
+                        finals_per_rid[vrid] = (
+                            finals_per_rid.get(vrid, 0) + 1)
+                if vfinal.get("kind") == "final":
+                    if (vfinal.get("image_b64")
+                            != vol_oracle["image_b64"]):
+                        failures.append(
+                            f"cycle {cycle}: volume final not "
+                            "byte-identical to the volume oracle")
+                    if vfinal.get("router", {}).get(
+                            "resume_count", 0) > 0:
+                        resumes += 1
+                elif not vfinal.get("retryable"):
+                    failures.append(
+                        f"cycle {cycle}: volume converge ended "
+                        f"non-rejected: {vfinal.get('rejected')!r}")
     dup = {r: n for r, n in finals_per_rid.items() if n != 1}
     if dup:
         failures.append(f"exactly-once final rows violated: {dup}")
@@ -638,6 +692,7 @@ def run_chaos_drill(args) -> int:
     router.close()
     summary = {
         "summary": "chaos", "cycles": args.chaos, "seed": args.seed,
+        "volume": bool(args.volume),
         "specs": specs,
         "resumes_observed": resumes,
         "router_resumes": snap["router"]["resumes"],
@@ -650,6 +705,49 @@ def run_chaos_drill(args) -> int:
                                         "transport_recv",
                                         "transport_stream",
                                         "readyz_probe")},
+        "wall_s": round(time.time() - t0, 1),
+        "failures": len(failures),
+        "failure_detail": failures[:8],
+    }
+    if args.summary_out:
+        p = Path(args.summary_out)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(json.dumps(summary) + "\n")
+    print(json.dumps(summary), flush=True)
+    return 1 if failures else 0
+
+
+def run_chaos_matrix(args) -> int:
+    """Storage-chaos soak (round 24): N cycles of the full
+    ``scripts/chaos_matrix.py`` matrix — every disk fault mode crossed
+    with every workload shape — each cycle under a DIFFERENT seed, so
+    the hit-indexed schedules land the faults at different appends,
+    spills, and stream rows every time.  Gates are the matrix's own
+    standing invariants; any cycle reporting failures fails the soak."""
+    import chaos_matrix
+
+    failures: list[str] = []
+    cycles = []
+    t0 = time.time()
+    for cycle in range(args.chaos_matrix):
+        row = chaos_matrix.run_matrix(
+            seed=args.seed + cycle,
+            log=lambda m: None)   # per-cell chatter off; summary below
+        cycles.append({"seed": row["seed"],
+                       "cells_failed": row["cells_failed"],
+                       "failures": row["failures"],
+                       "wall_s": row["wall_s"]})
+        if row["failures"]:
+            failures.append(
+                f"cycle {cycle} (seed {row['seed']}): "
+                f"{row['failures']} failures, e.g. "
+                f"{row['failure_detail'][:2]}")
+        print(json.dumps({"cycle": cycle, "seed": row["seed"],
+                          "cells": row["cells_total"],
+                          "failures": row["failures"]}), flush=True)
+    summary = {
+        "summary": "chaos-matrix", "cycles": args.chaos_matrix,
+        "seed": args.seed, "per_cycle": cycles,
         "wall_s": round(time.time() - t0, 1),
         "failures": len(failures),
         "failure_detail": failures[:8],
@@ -1483,6 +1581,20 @@ def main() -> int:
                          "failures, byte-identical completions incl. "
                          "resumed converge finals, >= 1 mid-stream "
                          "resume, exactly one final row per request_id")
+    ap.add_argument("--volume", action="store_true",
+                    help="with --chaos: every cycle also streams a "
+                         "rank-3 (D,H,W) volume converge job, killed "
+                         "mid-flight on odd cycles — resumed finals "
+                         "must stay byte-identical to the volume "
+                         "oracle")
+    ap.add_argument("--chaos-matrix", type=int, default=0, metavar="N",
+                    help="storage-chaos soak: N cycles of the full "
+                         "scripts/chaos_matrix.py fault-mode x "
+                         "workload matrix, each under a different "
+                         "seed; gates on every cycle reporting zero "
+                         "failures (standing invariants: typed-only "
+                         "failures, byte-identical completions, "
+                         "exactly-once finals, no stale-byte serves)")
     ap.add_argument("--router-restart", type=int, default=0, metavar="N",
                     help="crash-safe control-plane drill: N router "
                          "lives over one WAL lineage; each life "
@@ -1542,6 +1654,8 @@ def main() -> int:
         return run_autoscale_drill(args)
     if args.chaos:
         return run_chaos_drill(args)
+    if args.chaos_matrix:
+        return run_chaos_matrix(args)
     if args.faults or args.reshape:
         return run_fault_soak(args)
 

@@ -33,8 +33,20 @@ Five checks, all stdlib, no jax import, a few seconds total:
    ZERO new ``rank ==`` / ``backend ==`` arms in the step dispatcher;
    this check freezes those counts at the baseline so the next variant
    does too.
+6. **no unguarded disk writes in the serving plane** (round 24) —
+   every write-mode ``open()`` / ``Path.open()`` / ``os.fdopen`` /
+   ``write_text`` / ``write_bytes`` / ``os.replace`` under ``serving/``,
+   ``obs/``, or ``utils/`` must live in an allowlisted guarded-owner
+   module (``diskio.py`` itself, plus the modules whose write paths
+   consult a ``resilience.diskio`` fault site internally:
+   ``evidence_io.py``, ``wal.py``, ``events.py``, ``cache.py``,
+   ``checkpoint.py``) or carry a ``# diskio: exempt`` pragma naming why
+   the write sits outside the durability plane (process-exit snapshot
+   dumps, test-image scaffolding).  This is what keeps the storage
+   chaos matrix honest: a new serving-plane write path that skips
+   ``diskio`` would be invisible to every fault drill.
 
-Exit 0 and ``{"failures": 0}`` in ``--out`` iff all five hold.
+Exit 0 and ``{"failures": 0}`` in ``--out`` iff all six hold.
 """
 
 from __future__ import annotations
@@ -261,6 +273,78 @@ def check_dispatch_ladders(files) -> list[str]:
     return problems
 
 
+# Disk-write guard (round 24): the storage chaos matrix can only drill
+# write paths that consult resilience.diskio — so every write-mode
+# open/os.replace in the serving plane must live in a module whose
+# writes DO consult it (the owners below), or be pragma'd out of the
+# durability plane with a reason.  Owners are basenames: each of these
+# modules routes its write path through a diskio fault site
+# (wal_write/wal_fsync, cache_spill/cache_promote, events_emit,
+# evidence_write, checkpoint_write_*) or IS the guard layer.
+_DISKIO_DIRS = ("serving", "obs", "utils")
+_DISKIO_OWNERS = ("diskio.py", "evidence_io.py", "wal.py", "events.py",
+                  "cache.py", "checkpoint.py")
+_DISKIO_PRAGMA = "# diskio: exempt"
+
+
+def check_guarded_disk_writes(files) -> list[str]:
+    """Every write-mode open / os.replace under serving|obs|utils sits
+    in a guarded-owner module or carries the exempt pragma."""
+    problems = []
+    for f in files:
+        if not any(d in f.parts for d in _DISKIO_DIRS):
+            continue
+        if f.name in _DISKIO_OWNERS:
+            continue
+        src = f.read_text(encoding="utf-8")
+        if not any(n in src for n in ("open(", "os.replace",
+                                      "write_text", "write_bytes")):
+            continue
+        lines = src.splitlines()
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue  # check 1 reports it
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            what = None
+            if (isinstance(fn, ast.Name) and fn.id == "open"
+                    and node.args
+                    and any(c in _write_mode(node, 1) for c in "wax+")):
+                what = "open"
+            elif isinstance(fn, ast.Attribute):
+                is_os = (isinstance(fn.value, ast.Name)
+                         and fn.value.id == "os")
+                if (fn.attr == "open"
+                        and any(c in _write_mode(node, 0)
+                                for c in "wax+")):
+                    what = ".open"
+                elif (fn.attr == "fdopen" and is_os
+                      and any(c in _write_mode(node, 1)
+                              for c in "wax+")):
+                    what = "os.fdopen"
+                elif fn.attr in ("write_text", "write_bytes"):
+                    what = fn.attr
+                elif fn.attr == "replace" and is_os:
+                    what = "os.replace"
+            if what is None:
+                continue
+            line = lines[node.lineno - 1] if node.lineno <= len(
+                lines) else ""
+            if _DISKIO_PRAGMA in line:
+                continue
+            problems.append(
+                f"{_rel(f)}:{node.lineno}: unguarded {what} in the "
+                "serving plane — route the write through "
+                "resilience.diskio (guarded_open/guarded_replace or a "
+                "consult in the owning module), or annotate "
+                f"'{_DISKIO_PRAGMA} <why>' if it sits outside the "
+                "durability plane")
+    return problems
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--out", default="evidence/static_check.json")
@@ -274,10 +358,12 @@ def main() -> int:
     failures += check_stats_locking(files)
     failures += check_shared_curve_writes(files)
     failures += check_dispatch_ladders(files)
+    failures += check_guarded_disk_writes(files)
 
     row = {
         "workload": "static-check compileall+bare-except+stats-lock"
-                    "+shared-curve-writes+dispatch-ladders",
+                    "+shared-curve-writes+dispatch-ladders"
+                    "+guarded-disk-writes",
         "files_checked": len(files),
         "wall_s": round(time.time() - t0, 3),
         "failures": len(failures),

@@ -26,11 +26,14 @@ from __future__ import annotations
 
 import importlib.util
 import json
+import threading
 from pathlib import Path
 
 import jax
 import numpy as np
 import pytest
+
+from parallel_convolution_tpu.resilience import diskio, faults
 
 from parallel_convolution_tpu.ops import filters, oracle
 from parallel_convolution_tpu.parallel import mesh as mesh_lib
@@ -46,6 +49,13 @@ from parallel_convolution_tpu.utils import imageio
 from parallel_convolution_tpu.utils.evidence_io import rewrite_shared_jsonl
 
 SCRIPTS = Path(__file__).resolve().parent.parent / "scripts"
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    yield
+    faults.uninstall_plan()
+    diskio.uninstall_modes()
 
 
 def _mesh(shape=(1, 2)):
@@ -196,6 +206,143 @@ def test_invalidate_all_and_len():
     c.invalidate_all()
     assert len(c) == 0
     assert c.get("a") is None and c.stats["dead_refusals"] >= 1
+
+
+def test_disk_tier_promote_races_eviction_and_invalidation(tmp_path):
+    """ISSUE 20 satellite: promote-on-hit racing eviction and
+    invalidation on a one-slot memory tier.  Whatever interleaving the
+    scheduler picks, a hit must serve the key's OWN bytes (anything
+    else is a stale/torn serve) and no thread may see an exception
+    escape the cache."""
+    c = ResultCache(capacity_entries=1, disk_dir=tmp_path / "rc")
+    keys = [f"k{i}" for i in range(4)]
+    want = {k: _arrays(i, n=256) for i, k in enumerate(keys)}
+    for k in keys:
+        c.put(k, want[k], {"who": k})
+    errs: list[str] = []
+    stop = threading.Event()
+
+    def hammer(tid):
+        rng = np.random.default_rng(tid)
+        try:
+            for step in range(250):
+                k = keys[int(rng.integers(len(keys)))]
+                roll = int(rng.integers(10))
+                if roll < 6:
+                    got = c.get(k)        # may promote from disk
+                    if got is not None and not np.array_equal(
+                            got[0]["image"], want[k]["image"]):
+                        errs.append(f"{k}: foreign bytes served")
+                elif roll < 9:
+                    c.put(k, want[k], {"who": k})
+                else:
+                    c.invalidate(k)
+        except Exception as e:  # noqa: BLE001 — the gate IS "no escape"
+            errs.append(f"t{tid}: {type(e).__name__}: {e}")
+        finally:
+            stop.set()
+
+    threads = [threading.Thread(target=hammer, args=(t,))
+               for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert errs == []
+    # The ladder stayed healthy: churn is evictions/promotes, never
+    # corruption.
+    assert c.stats["corrupt_drops"] == 0
+    assert c.stats["spill_failures"] == 0
+    # Post-race, a re-store of every key serves its own bytes again.
+    for k in keys:
+        c.put(k, want[k], {"who": k})
+        got = c.get(k)
+        assert got is not None
+        np.testing.assert_array_equal(got[0]["image"],
+                                      want[k]["image"])
+
+
+def test_crash_between_spill_write_and_journal_is_refused(tmp_path):
+    """The torn-publish crash window: a spill's bytes land at the final
+    path but the process dies before the death record journals.  On
+    restart adoption sees an un-tombstoned file whose CRC must refuse
+    service — a torn write never becomes served bytes."""
+    c = ResultCache(capacity_entries=1, disk_dir=tmp_path / "rc")
+    a0 = _arrays(0)
+    c.put("k0", a0, {"who": "k0"})
+    c.put("k1", _arrays(1), {})          # spills k0 to disk, valid
+    blob = (tmp_path / "rc" / "k0.rc").read_bytes()
+    # Simulated crash: rewrite the published file as its torn prefix
+    # (what guarded_write's power-loss shape leaves), journal lost.
+    (tmp_path / "rc" / "k0.rc").write_bytes(blob[:len(blob) // 2])
+    c2 = ResultCache(disk_dir=tmp_path / "rc")
+    assert c2.get("k0") is None
+    assert c2.stats["corrupt_drops"] == 1
+    assert not (tmp_path / "rc" / "k0.rc").exists()   # dropped loudly
+
+
+def test_injected_torn_spill_kills_entry_and_cleans_path(tmp_path):
+    """The same window driven through the fault site: a torn spill is
+    swallowed (put never raises), the entry leaves the cache dead, and
+    the half-written bytes do NOT await adoption at the final path."""
+    journal = []
+    c = ResultCache(capacity_entries=1, disk_dir=tmp_path / "rc",
+                    journal=lambda op, k: journal.append((op, k)))
+    diskio.install_modes({"cache_spill": "torn_write"})
+    with faults.injected("cache_spill:1"):
+        c.put("k0", _arrays(0), {})
+        c.put("k1", _arrays(1), {})      # evicts k0 -> torn spill
+    assert c.stats["spill_failures"] == 1
+    assert ("dead", "k0") in journal
+    assert c.get("k0") is None
+    assert list((tmp_path / "rc").glob("*.rc")) == []
+    # Restart over the directory: nothing to adopt, nothing resurrects.
+    c2 = ResultCache(disk_dir=tmp_path / "rc")
+    assert c2.get("k0") is None and c2.stats["corrupt_drops"] == 0
+
+
+def test_spill_failure_streak_demotes_reprobes_and_restores(tmp_path):
+    """The disk-tier degrade ladder end to end on a fake clock:
+    ``demote_after`` consecutive spill failures take the tier
+    memory-only (journaled), the closed re-probe window drops spills
+    without touching the device, one probe per window retries, and the
+    first success journals the restore and re-arms."""
+    clk = [0.0]
+    journal = []
+    c = ResultCache(capacity_entries=1, disk_dir=tmp_path / "rc",
+                    demote_after=2, reprobe_s=5.0,
+                    clock=lambda: clk[0],
+                    journal=lambda op, k: journal.append((op, k)))
+    diskio.install_modes({"cache_spill": "eio"})
+    with faults.injected("cache_spill:*"):
+        for i in range(3):               # two failures demote; the
+            c.put(f"k{i}", _arrays(i), {})   # third never probes
+    assert c.stats["spill_failures"] == 2
+    assert c.stats["tier_demotions"] == 1
+    assert ("tier_demoted", "disk") in journal
+    assert c.stats["reprobes"] == 0      # window closed: no IO attempt
+    # Window opens but the device is still dying: probe fails, window
+    # re-closes.
+    clk[0] = 6.0
+    with faults.injected("cache_spill:*"):
+        c.put("k3", _arrays(3), {})
+    assert c.stats["reprobes"] == 1
+    assert c.stats["spill_failures"] == 3
+    assert c.stats["tier_demotions"] == 1          # already demoted
+    # Healed device, open window: the probe spill succeeds and the
+    # tier is journaled back.
+    diskio.uninstall_modes()
+    clk[0] = 12.0
+    c.put("k4", _arrays(4), {})
+    assert c.stats["tier_restores"] == 1
+    assert ("tier_restored", "disk") in journal
+    assert c.stats["spills"] == 1
+    # Fully healed: the next eviction spills without a probe window.
+    c.put("k5", _arrays(5), {})
+    assert c.stats["spills"] == 2
+    got = c.get("k4")                    # disk hit after the restore
+    assert got is not None
+    np.testing.assert_array_equal(got[0]["image"], _arrays(4)["image"])
 
 
 # ------------------------------------------------------------- WAL
@@ -380,3 +527,47 @@ def test_static_gate_catches_direct_shared_curve_write(tmp_path):
 def test_repo_tree_passes_shared_curve_rule():
     sc = _load_static_check()
     assert sc.check_shared_curve_writes(sc.py_files()) == []
+
+
+def test_static_gate_catches_unguarded_disk_write(tmp_path):
+    sc = _load_static_check()
+    serving = tmp_path / "serving"
+    serving.mkdir()
+    bad = serving / "new_subsystem.py"
+    bad.write_text("with open('ledger.json', 'w') as f:\n"
+                   "    f.write('{}')\n")
+    probs = sc.check_guarded_disk_writes([bad])
+    assert len(probs) == 1 and "diskio" in probs[0]
+    # os.replace, os.fdopen('w'), Path.open('w'), write_text: writes too.
+    bad.write_text("import os\nos.replace('a', 'b')\n")
+    assert sc.check_guarded_disk_writes([bad])
+    bad.write_text("import os\nf = os.fdopen(3, 'wb')\n")
+    assert sc.check_guarded_disk_writes([bad])
+    bad.write_text("from pathlib import Path\n"
+                   "Path('x').open('a').write('')\n")
+    assert sc.check_guarded_disk_writes([bad])
+    bad.write_text("from pathlib import Path\n"
+                   "Path('x').write_text('')\n")
+    assert sc.check_guarded_disk_writes([bad])
+    # Read-mode opens and str.replace are not writes.
+    bad.write_text("open('ledger.json').read()\n"
+                   "s = 'a-b'.replace('-', '_')\n")
+    assert not sc.check_guarded_disk_writes([bad])
+    # A pragma on the call line exempts it (with a stated reason).
+    bad.write_text("f = open('x', 'w')  # diskio: exempt — snapshot\n")
+    assert not sc.check_guarded_disk_writes([bad])
+    # Guarded-owner modules write directly (they consult diskio inside).
+    owner = serving / "wal.py"
+    owner.write_text("f = open('wal.jsonl', 'a')\n")
+    assert not sc.check_guarded_disk_writes([owner])
+    # Out-of-scope dirs are not this check's business.
+    other_dir = tmp_path / "parallel"
+    other_dir.mkdir()
+    other = other_dir / "tool.py"
+    other.write_text("f = open('x', 'w')\n")
+    assert not sc.check_guarded_disk_writes([other])
+
+
+def test_repo_tree_passes_guarded_disk_write_rule():
+    sc = _load_static_check()
+    assert sc.check_guarded_disk_writes(sc.py_files()) == []

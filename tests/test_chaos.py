@@ -38,9 +38,9 @@ import pytest
 from parallel_convolution_tpu.ops import filters, oracle
 from parallel_convolution_tpu.parallel import mesh as mesh_lib, step
 from parallel_convolution_tpu.resilience import degrade, faults
-from parallel_convolution_tpu.serving import jobs
+from parallel_convolution_tpu.serving import frames, jobs
 from parallel_convolution_tpu.serving.chaos import (
-    ChaosTransport, modes_from_spec,
+    ChaosTransport, modes_from_spec, truncate_frame_bytes,
 )
 from parallel_convolution_tpu.serving.frontend import (
     decode_converge, encode_stream_row,
@@ -698,6 +698,74 @@ def test_router_mid_stream_corrupt_counts_and_resumes():
         snap = router.snapshot()
         assert sum(p["corrupt_responses"]
                    for p in snap["replicas"].values()) == 1
+    finally:
+        router.close()
+
+
+def test_truncate_frame_bytes_seed_sweep_always_bad_frame():
+    """Detection isn't positional luck: a PCTE envelope cut short at
+    ANY seeded depth must raise BadFrame, never decode clean (a clean
+    decode would mean the framing has a length-check hole)."""
+    img = _img(16, 24, seed=7)
+    raw = frames.encode_envelope(
+        {"kind": "snapshot", "iters": 10, "request_id": "t1"},
+        {"image": np.ascontiguousarray(img)})
+    for seed in range(96):
+        cut = truncate_frame_bytes(raw, seed=seed)
+        assert 0 < len(cut) < len(raw)
+        with pytest.raises(frames.BadFrame):
+            frames.decode_envelope(cut)
+    # Degenerate inputs never produce a servable buffer either.
+    assert truncate_frame_bytes(b"", seed=3) == b""
+    assert truncate_frame_bytes(b"x", seed=3) == b""
+
+
+def test_router_mid_stream_truncate_typed_retryable_then_resumes():
+    """Satellite (b): a seeded mid-stream truncation of a converge
+    envelope is a TYPED retryable end (never a hang, never garbage
+    rows), and the client retry resumes from the ledger token instead
+    of iteration 0."""
+    img = _img(40, 56, seed=3)
+    body = _converge_body(img, request_id="tr1")
+    want = _oracle_converge(img, body)
+    router = _chaos_router(n=1, modes={"transport_stream": "truncate"})
+    try:
+        with faults.injected("transport_stream:3"):
+            status, rows = router.converge(dict(body))
+            got = list(rows)
+        assert [g["kind"] for g in got[:-1]] == ["snapshot", "snapshot"]
+        end = got[-1]
+        assert end["kind"] == "rejected" and end["retryable"], end
+        snap = router.snapshot()
+        assert sum(p["corrupt_responses"]
+                   for p in snap["replicas"].values()) == 1
+        # the retry resumes: first row continues PAST the token
+        status, rows = router.converge(dict(body))
+        got2 = list(rows)
+        assert got2[0]["iters"] == 30        # not 10 — resumed at 20
+        final = got2[-1]
+        assert final["kind"] == "final"
+        assert final["router"]["resume_count"] == 1
+        assert final["image_b64"] == want[-1]["image_b64"]
+        assert sum(1 for g in got + got2
+                   if g.get("kind") == "final") == 1
+    finally:
+        router.close()
+
+
+def test_router_mid_stream_truncate_fails_over_byte_identical():
+    img = _img(40, 56, seed=3)
+    body = _converge_body(img, request_id="tr2")
+    want = _oracle_converge(img, body)
+    router = _chaos_router(n=2, modes={"transport_stream": "truncate"})
+    try:
+        with faults.injected("transport_stream:2"):
+            status, rows = router.converge(dict(body))
+            got = list(rows)
+        final = got[-1]
+        assert final["kind"] == "final"
+        assert final["router"]["resume_count"] == 1
+        assert final["image_b64"] == want[-1]["image_b64"]
     finally:
         router.close()
 

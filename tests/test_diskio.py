@@ -1,0 +1,305 @@
+"""Guarded disk IO + durability degrade ladders (round 24).
+
+The ISSUE 20 acceptance properties, unit-sized:
+
+* mode grammar — ``PCTPU_DISK_MODES`` specs validate site AND mode, so
+  a typo'd drill can't silently never fire; dict installs re-validate;
+* guard semantics — ``enospc``/``eio`` raise their ``OSError`` before
+  any byte lands; ``torn_write`` through :func:`guarded_write` lands a
+  flushed PREFIX then raises (the bytes a power loss leaves behind);
+  ``slow_write`` stalls then succeeds; a triggered site with NO
+  installed mode re-raises the raw ``InjectedFault`` so every
+  pre-round-24 drill keeps its exact semantics;
+* WAL degrade ladder — sustained append failure flips the router into
+  a ``durability: degraded`` window that keeps serving (stamped on
+  every response); the first healthy append re-arms with a fresh
+  compaction snapshot, and a takeover replay after the degraded window
+  resurrects nothing stale;
+* events ladder — ``events.emit`` under ENOSPC counts dropped lines
+  instead of raising into whatever the caller was doing.
+"""
+
+from __future__ import annotations
+
+import base64
+import errno
+import io
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from parallel_convolution_tpu.obs.events import EventLog
+from parallel_convolution_tpu.ops import filters, oracle
+from parallel_convolution_tpu.parallel import mesh as mesh_lib
+from parallel_convolution_tpu.resilience import diskio, faults
+from parallel_convolution_tpu.resilience.faults import InjectedFault
+from parallel_convolution_tpu.serving.pricing import WorkPricer
+from parallel_convolution_tpu.serving.router import (
+    InProcessReplica, ReplicaRouter, TenantQuotas,
+)
+from parallel_convolution_tpu.serving.service import ConvolutionService
+from parallel_convolution_tpu.utils import imageio
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_state():
+    yield
+    faults.uninstall_plan()
+    diskio.uninstall_modes()
+
+
+def _mesh(shape=(1, 2)):
+    return mesh_lib.make_grid_mesh(jax.devices()[: shape[0] * shape[1]],
+                                   shape)
+
+
+def _img(rows=32, cols=48, seed=5):
+    return imageio.generate_test_image(rows, cols, "grey", seed=seed)
+
+
+def _factory(shape=(1, 2), **kw):
+    kw.setdefault("max_delay_s", 0.002)
+
+    def make():
+        return ConvolutionService(_mesh(shape), **kw)
+
+    return make
+
+
+def _batch_body(img, rid, tenant="t"):
+    return {"image_b64": base64.b64encode(
+        np.ascontiguousarray(img).tobytes()).decode("ascii"),
+        "rows": img.shape[0], "cols": img.shape[1], "mode": "grey",
+        "filter": "blur3", "iters": 1, "request_id": rid,
+        "tenant": tenant}
+
+
+def _converge_body(img, rid, tenant="t"):
+    return {"image_b64": base64.b64encode(
+        np.ascontiguousarray(img).tobytes()).decode("ascii"),
+        "rows": img.shape[0], "cols": img.shape[1], "mode": "grey",
+        "filter": "jacobi3", "backend": "shifted", "quantize": False,
+        "tol": 0.0, "max_iters": 40, "check_every": 10,
+        "request_id": rid, "tenant": tenant}
+
+
+def _wal_router(reps, wal_path, **kw):
+    kw.setdefault("start_health", False)
+    kw.setdefault("breaker_cooldown_s", 0.2)
+    return ReplicaRouter(
+        reps, wal=str(wal_path),
+        quotas=TenantQuotas(rate=1.0, burst=1e6, clock=lambda: 0.0),
+        pricer=WorkPricer(min_units=1e-9), **kw)
+
+
+# ------------------------------------------------------- mode grammar
+
+
+def test_modes_from_spec_parses_and_rejects():
+    modes = diskio.modes_from_spec(
+        "wal_write=torn_write, cache_spill=enospc")
+    assert modes == {"wal_write": "torn_write", "cache_spill": "enospc"}
+    assert diskio.modes_from_spec("") == {}
+    with pytest.raises(ValueError, match="unknown disk site"):
+        diskio.modes_from_spec("wal_wrte=enospc")
+    with pytest.raises(ValueError, match="unknown disk mode"):
+        diskio.modes_from_spec("wal_write=slow")
+    with pytest.raises(ValueError, match="expected site=mode"):
+        diskio.modes_from_spec("wal_write")
+    # torn_write only where a partial payload can actually land.
+    with pytest.raises(ValueError, match="unknown disk mode"):
+        diskio.modes_from_spec("wal_fsync=torn_write")
+    with pytest.raises(ValueError, match="unknown disk mode"):
+        diskio.modes_from_spec("cache_promote=enospc")
+
+
+def test_install_modes_validates_dict_and_spec():
+    with pytest.raises(ValueError, match="unknown disk site/mode"):
+        diskio.install_modes({"wal_write": "nope"})
+    with pytest.raises(ValueError, match="unknown disk site/mode"):
+        diskio.install_modes({"nope": "eio"})
+    diskio.install_modes("events_emit=eio")
+    assert diskio.installed_modes() == {"events_emit": "eio"}
+    diskio.install_modes(None)
+    assert diskio.installed_modes() == {}
+    assert diskio.modes_from_env(
+        {"PCTPU_DISK_MODES": "evidence_write=eio"}) == {
+            "evidence_write": "eio"}
+    assert diskio.modes_from_env({}) == {}
+
+
+def test_disk_sites_are_registered_fault_sites():
+    assert set(diskio.DISK_SITES) <= set(faults.SITE_TABLE)
+
+
+# ---------------------------------------------------- guard semantics
+
+
+def test_consult_translates_each_mode():
+    diskio.install_modes({"wal_write": "enospc"})
+    with faults.injected("wal_write:*"):
+        with pytest.raises(OSError) as e:
+            diskio.consult("wal_write")
+        assert e.value.errno == errno.ENOSPC
+    diskio.install_modes({"wal_write": "eio"})
+    with faults.injected("wal_write:*"):
+        with pytest.raises(OSError) as e:
+            diskio.consult("wal_write")
+        assert e.value.errno == errno.EIO
+    # A torn READ surface can't half-succeed: plain consult raises EIO.
+    diskio.install_modes({"wal_write": "torn_write"})
+    with faults.injected("wal_write:*"):
+        with pytest.raises(OSError) as e:
+            diskio.consult("wal_write")
+        assert e.value.errno == errno.EIO
+    diskio.install_modes({"wal_write": "slow_write"})
+    with faults.injected("wal_write:*"):
+        t0 = time.monotonic()
+        diskio.consult("wal_write")           # stalls, then returns
+        assert time.monotonic() - t0 >= diskio.SLOW_WRITE_S * 0.8
+    # No plan installed: the guard is a no-op.
+    diskio.consult("wal_write")
+
+
+def test_deferred_consult_hands_torn_to_the_caller():
+    diskio.install_modes({"cache_spill": "torn_write"})
+    with faults.injected("cache_spill:*"):
+        assert diskio.deferred_consult("cache_spill") == "torn_write"
+    diskio.install_modes({"cache_spill": "enospc"})
+    with faults.injected("cache_spill:*"):
+        with pytest.raises(OSError) as e:
+            diskio.deferred_consult("cache_spill")
+        assert e.value.errno == errno.ENOSPC
+    assert diskio.deferred_consult("cache_spill") is None
+
+
+def test_guarded_write_torn_lands_flushed_prefix_then_raises():
+    diskio.install_modes({"wal_write": "torn_write"})
+    buf = io.BytesIO()
+    payload = b"x" * 100
+    with faults.injected("wal_write:1"):
+        with pytest.raises(OSError, match="torn write"):
+            diskio.guarded_write("wal_write", buf, payload)
+    # Exactly the prefix a power loss leaves behind — half the payload.
+    assert buf.getvalue() == payload[:50]
+    # Subsequent (un-triggered) writes pass through whole.
+    n = diskio.guarded_write("wal_write", buf, b"yz")
+    assert n == 2 and buf.getvalue() == payload[:50] + b"yz"
+
+
+def test_guarded_replace_torn_is_metadata_eio_src_stays(tmp_path):
+    src, dst = tmp_path / "a", tmp_path / "b"
+    src.write_bytes(b"payload")
+    diskio.install_modes({"evidence_write": "torn_write"})
+    with faults.injected("evidence_write:1"):
+        with pytest.raises(OSError) as e:
+            diskio.guarded_replace("evidence_write", src, dst)
+    # rename is atomic: no half-state, the src file simply stays.
+    assert e.value.errno == errno.EIO
+    assert src.exists() and not dst.exists()
+    diskio.guarded_replace("evidence_write", src, dst)
+    assert dst.read_bytes() == b"payload" and not src.exists()
+
+
+def test_triggered_site_without_mode_reraises_raw_fault():
+    """Pre-round-24 drills keep their exact semantics: no installed
+    mode means the raw InjectedFault, not a translated OSError."""
+    diskio.uninstall_modes()
+    with faults.injected("wal_write:1"):
+        with pytest.raises(InjectedFault):
+            diskio.consult("wal_write")
+    buf = io.BytesIO()
+    with faults.injected("wal_write:1"):
+        with pytest.raises(InjectedFault):
+            diskio.guarded_write("wal_write", buf, b"data")
+    assert buf.getvalue() == b""          # nothing landed
+
+
+def test_injected_counts_track_translated_faults():
+    before = diskio.injected_counts().get("wal_fsync=eio", 0)
+    diskio.install_modes({"wal_fsync": "eio"})
+    with faults.injected("wal_fsync:*"):
+        for _ in range(3):
+            with pytest.raises(OSError):
+                diskio.consult("wal_fsync")
+    assert diskio.injected_counts()["wal_fsync=eio"] - before == 3
+
+
+# ------------------------------------------------ events degrade ladder
+
+
+def test_events_emit_enospc_counts_dropped_never_raises(tmp_path):
+    log = EventLog(tmp_path / "events.ndjson")
+    diskio.install_modes({"events_emit": "enospc"})
+    try:
+        with faults.injected("events_emit:2+"):
+            for i in range(4):
+                log.emit("chaos", n=i)    # never raises into the caller
+    finally:
+        log.close()
+    assert log.dropped == 3
+    lines = (tmp_path / "events.ndjson").read_text().splitlines()
+    written = [ln for ln in lines if '"chaos"' in ln]
+    # The full-disk ledger balances: written + dropped == emitted.
+    assert len(written) + log.dropped == 4
+
+
+# --------------------------------------------- WAL durability ladder
+
+
+def test_wal_degrade_window_rearm_and_clean_replay(tmp_path):
+    """The ENOSPC drill, unit-sized: sustained append failure flips
+    ``durability: degraded`` but serving continues byte-correct; the
+    first healthy append re-arms with a compaction snapshot; a takeover
+    replay after the window carries the finalized id and resurrects no
+    stale live jobs."""
+    img = _img()
+    want = oracle.run_serial_u8(img, filters.get_filter("blur3"), 1)
+    reps = [InProcessReplica(_factory(), name=f"g{i}") for i in range(2)]
+    wal_path = tmp_path / "r.wal"
+    r1 = _wal_router(reps, wal_path)
+    diskio.install_modes({"wal_write": "enospc"})
+    stamps = []
+    try:
+        with faults.injected("wal_write:1+"):
+            for i in range(4):
+                st, wire = r1.request(_batch_body(img, f"b{i}"))
+                assert st == 200 and wire["ok"], wire
+                got = np.frombuffer(base64.b64decode(wire["image_b64"]),
+                                    np.uint8).reshape(img.shape)
+                assert np.array_equal(got, want)   # degraded ≠ wrong
+                stamps.append(wire["router"]["durability"])
+            # A converge finishing INSIDE the window: its final must
+            # survive the later replay even though no append landed.
+            st, rows = r1.converge(_converge_body(img, "cv-deg"))
+            rows = list(rows)
+            assert rows[-1]["kind"] == "final"
+            assert rows[-1]["router"]["durability"] == "degraded"
+        assert stamps[0] == "ok" and stamps[-1] == "degraded"
+        assert r1.stats["wal_degraded_windows"] == 1
+        assert r1.snapshot()["durability"] == "degraded"
+        # Heal: the very response whose append succeeded stamps ok.
+        diskio.uninstall_modes()
+        st, wire = r1.request(_batch_body(img, "heal"))
+        assert wire["router"]["durability"] == "ok"
+        assert r1.stats["wal_rearms"] == 1
+        assert r1.snapshot()["durability"] == "ok"
+    finally:
+        r1.close(close_replicas=False)
+    # Takeover replay: the re-armed snapshot is the truth on disk.
+    r2 = _wal_router(reps, wal_path)
+    try:
+        live, finalized = r2.jobs.export()
+        assert any(k.endswith("cv-deg") for k in finalized)
+        assert not live                   # nothing stale came back
+        # And the recovered plane still serves the degraded-window
+        # request's bytes fresh (exactly-once: dup final refused
+        # upstream, recompute is byte-identical).
+        st, wire = r2.request(_batch_body(img, "post"))
+        got = np.frombuffer(base64.b64decode(wire["image_b64"]),
+                            np.uint8).reshape(img.shape)
+        assert np.array_equal(got, want)
+    finally:
+        r2.close()
